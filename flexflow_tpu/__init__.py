@@ -1,0 +1,60 @@
+"""flexflow_tpu — a TPU-native distributed DNN training framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of FlexFlow
+(reference: williamberman/FlexFlow): a frontend layer graph is compiled into a
+Parallel Computation Graph (PCG) over sharded tensors, a strategy search
+(MCMC + Unity-style graph DP + substitutions) picks per-op shardings costed by
+a TPU machine model, and the winning PCG is lowered to ONE jitted XLA SPMD
+program per training step over a `jax.sharding.Mesh`.
+
+Reference architecture map (see SURVEY.md):
+  - Legion tasks/regions/mapper  -> single jitted step + Mesh + NamedSharding
+  - ParallelTensor dim degrees   -> PartitionSpec over named mesh axes
+  - parallel ops (Repartition/Combine/Replicate/Reduction) -> explicit PCG
+    nodes lowered to sharding constraints / collectives
+  - NCCL allreduce in optimizer  -> psum over ICI inside the step function
+  - cuDNN/cuBLAS kernels         -> XLA HLO + Pallas kernels for the hot ops
+"""
+
+from flexflow_tpu.ffconst import (
+    ActiMode,
+    AggrMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OpType,
+    ParamSyncType,
+    PoolType,
+)
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.runtime.optimizer import SGDOptimizer, AdamOptimizer
+from flexflow_tpu.runtime.initializer import (
+    GlorotUniformInitializer,
+    ZeroInitializer,
+    ConstantInitializer,
+    UniformInitializer,
+    NormInitializer,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "FFModel",
+    "FFConfig",
+    "DataType",
+    "OpType",
+    "ActiMode",
+    "AggrMode",
+    "PoolType",
+    "LossType",
+    "MetricsType",
+    "ParamSyncType",
+    "SGDOptimizer",
+    "AdamOptimizer",
+    "GlorotUniformInitializer",
+    "ZeroInitializer",
+    "ConstantInitializer",
+    "UniformInitializer",
+    "NormInitializer",
+]

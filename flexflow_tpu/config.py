@@ -1,0 +1,140 @@
+"""Runtime configuration.
+
+Reference analog: `FFConfig` (include/flexflow/config.h:92-160) and its argv
+parser (`FFModel::parse_args`, model.cc:3556-3719). GPU-count/Legion flags
+become device-mesh configuration; the search/profiling/fusion flags carry
+over with the same names where they make sense on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from flexflow_tpu.ffconst import CompMode, DataType, ParamSyncType
+
+
+@dataclasses.dataclass
+class FFConfig:
+    # ---- training loop ----
+    batch_size: int = 64
+    epochs: int = 1
+    seed: int = 42
+    # truncated-sequence iteration config (reference FFIterationConfig
+    # config.h:162-167): forward/backward may run a shorter seq length
+    seq_length: Optional[int] = None
+
+    # ---- devices / mesh ----
+    # number of devices to use (None = all visible jax devices); the
+    # reference analog is `-ll:gpu` × numNodes
+    num_devices: Optional[int] = None
+    # explicit mesh shape: ordered {axis_name: size}; None = let compile()
+    # derive it from the chosen strategy (e.g. {"data": 8} for pure DP)
+    mesh_shape: Optional[Dict[str, int]] = None
+
+    # ---- numerics ----
+    compute_dtype: DataType = DataType.FLOAT
+    param_sync: ParamSyncType = ParamSyncType.PSUM
+
+    # ---- strategy search (reference model.cc:3599-3719 flags) ----
+    search_budget: int = 0
+    search_alpha: float = 1.05
+    only_data_parallel: bool = True
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    memory_search: bool = False
+    search_num_devices: Optional[int] = None  # search for a bigger machine
+    machine_model_file: Optional[str] = None
+    import_strategy_file: Optional[str] = None
+    export_strategy_file: Optional[str] = None
+    export_strategy_computation_graph_file: Optional[str] = None
+    include_costs_dot_graph: bool = False
+
+    # ---- execution ----
+    profiling: bool = False
+    # op fusion: on TPU XLA fuses inside one jitted program for free; this
+    # flag only controls whether the PCG keeps explicit FusedOp groups for
+    # search costing (reference --fusion, model.cc:2965)
+    perform_fusion: bool = False
+    comp_mode: CompMode = CompMode.TRAINING
+    # donate params/opt-state buffers to the jitted step (halves HBM)
+    donate_buffers: bool = True
+
+    # populated by FFModel at compile time
+    _devices: Optional[List] = dataclasses.field(default=None, repr=False)
+
+    @property
+    def devices(self) -> List:
+        if self._devices is None:
+            import jax
+
+            devs = jax.devices()
+            n = self.num_devices or len(devs)
+            self._devices = devs[:n]
+        return self._devices
+
+    @property
+    def workers_per_node(self) -> int:
+        return len(self.devices)
+
+    @classmethod
+    def from_args(cls, argv: Sequence[str]) -> "FFConfig":
+        """Parse reference-style command-line flags (model.cc:3556-3719)."""
+        cfg = cls()
+        args = list(argv)
+        i = 0
+
+        def take() -> str:
+            nonlocal i
+            i += 1
+            if i >= len(args):
+                raise ValueError(f"flag {args[i - 1]!r} requires a value")
+            return args[i]
+
+        while i < len(args):
+            a = args[i]
+            if a in ("-b", "--batch-size"):
+                cfg.batch_size = int(take())
+            elif a in ("-e", "--epochs"):
+                cfg.epochs = int(take())
+            elif a == "--seed":
+                cfg.seed = int(take())
+            elif a in ("--devices", "-ll:gpu", "-ll:tpu"):
+                cfg.num_devices = int(take())
+            elif a == "--budget" or a == "--search-budget":
+                cfg.search_budget = int(take())
+            elif a == "--alpha" or a == "--search-alpha":
+                cfg.search_alpha = float(take())
+            elif a == "--only-data-parallel":
+                cfg.only_data_parallel = True
+            elif a == "--search":
+                cfg.only_data_parallel = False
+            elif a == "--enable-parameter-parallel":
+                cfg.enable_parameter_parallel = True
+            elif a == "--enable-attribute-parallel":
+                # the reference sets parameter-parallel here too (noted as an
+                # upstream bug in SURVEY.md §2.3); we keep them independent
+                cfg.enable_attribute_parallel = True
+            elif a == "--memory-search":
+                cfg.memory_search = True
+            elif a == "--search-num-devices":
+                cfg.search_num_devices = int(take())
+            elif a == "--machine-model-file":
+                cfg.machine_model_file = take()
+            elif a == "--import-strategy" or a == "--import":
+                cfg.import_strategy_file = take()
+            elif a == "--export-strategy" or a == "--export":
+                cfg.export_strategy_file = take()
+            elif a == "--compgraph":
+                cfg.export_strategy_computation_graph_file = take()
+            elif a == "--include-costs-dot-graph":
+                cfg.include_costs_dot_graph = True
+            elif a == "--profiling":
+                cfg.profiling = True
+            elif a == "--fusion":
+                cfg.perform_fusion = True
+            elif a == "--inference":
+                cfg.comp_mode = CompMode.INFERENCE
+            # unknown flags are ignored (the reference passes extras to Legion)
+            i += 1
+        return cfg

@@ -1,0 +1,594 @@
+"""FFModel — the central user-facing model object.
+
+Reference analog: `FFModel` (include/flexflow/model.h:326, cffi surface
+python/flexflow/core/flexflow_cffi.py:883): layer-building methods record a
+lazy graph; `compile()` turns it into a PCG, picks a parallelization
+strategy, and lowers to jitted SPMD step functions; `fit()/eval()` drive the
+training loop (flexflow_cffi.py:2044-2088).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.ffconst import (
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OpType,
+    PoolType,
+)
+from flexflow_tpu.ops import attrs as A
+from flexflow_tpu.parallel.mesh import make_mesh
+from flexflow_tpu.parallel.sharding import ShardingView, batch_spec
+from flexflow_tpu.pcg.graph import Graph, Node
+from flexflow_tpu.pcg.tensor import TensorShape
+from flexflow_tpu.runtime.executor import Executor, node_key
+from flexflow_tpu.runtime.metrics import PerfMetrics
+from flexflow_tpu.runtime.optimizer import Optimizer, SGDOptimizer
+
+
+@dataclasses.dataclass
+class Tensor:
+    """Frontend tensor handle (reference tensor.h:85): points at a graph
+    node output."""
+
+    node: Node
+    idx: int = 0
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(d.size for d in self.node.outputs[self.idx].dims)
+
+    @property
+    def dims(self) -> Tuple[int, ...]:
+        return self.shape
+
+    @property
+    def dtype(self) -> DataType:
+        return self.node.outputs[self.idx].dtype
+
+    def __repr__(self):
+        return f"Tensor({self.node.name}:{self.idx} {self.shape})"
+
+
+class FFModel:
+    """Build a layer graph, compile it to a sharded training program, train."""
+
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self.graph = Graph()
+        self._executor: Optional[Executor] = None
+        self._mesh = None
+        self._params = None  # (trainable, nontrainable)
+        self._opt_state = None
+        self._optimizer: Optional[Optimizer] = None
+        self._loss_type: Optional[LossType] = None
+        self._metrics: List[MetricsType] = []
+        self._init_overrides: Dict[str, Dict] = {}
+        self._rng_seed = self.config.seed
+        self._step_count = 0
+        self.current_metrics: Optional[PerfMetrics] = None
+
+    # ------------------------------------------------------------------
+    # graph building helpers
+
+    def _add(self, op_type: OpType, op_attrs, inputs: Sequence[Tensor], name: Optional[str]) -> Node:
+        node = self.graph.create_node(op_type, op_attrs, name or op_type.value)
+        for i, t in enumerate(inputs):
+            self.graph.add_edge(t.node, node, t.idx, i)
+        node.outputs = tuple(
+            op_attrs.infer(*[t.node.outputs[t.idx] for t in inputs])
+        )
+        return node
+
+    def _one(self, op_type, op_attrs, inputs, name) -> Tensor:
+        return Tensor(self._add(op_type, op_attrs, inputs, name))
+
+    def _record_init(self, node: Node, **inits):
+        d = {k: v for k, v in inits.items() if v is not None}
+        if d:
+            self._init_overrides[node_key(node)] = d
+
+    # ------------------------------------------------------------------
+    # inputs / weights
+
+    def create_tensor(self, dims: Sequence[int], dtype: DataType = DataType.FLOAT,
+                      name: Optional[str] = None) -> Tensor:
+        shape = TensorShape(tuple(dims), dtype)
+        return self._one(OpType.INPUT, A.InputAttrs(shape), [], name or "input")
+
+    def create_weight(self, dims: Sequence[int], dtype: DataType = DataType.FLOAT,
+                      initializer=None, name: Optional[str] = None) -> Tensor:
+        shape = TensorShape(tuple(dims), dtype)
+        node = self._add(OpType.WEIGHT, A.WeightAttrs(shape), [], name or "weight")
+        self._record_init(node, weight=initializer)
+        return Tensor(node)
+
+    # ------------------------------------------------------------------
+    # layers (reference model.h:336-552 surface)
+
+    def dense(self, input: Tensor, out_dim: int, activation: ActiMode = ActiMode.NONE,
+              use_bias: bool = True, kernel_initializer=None, bias_initializer=None,
+              name: Optional[str] = None) -> Tensor:
+        node = self._add(
+            OpType.LINEAR,
+            A.LinearAttrs(out_dim, use_bias, activation),
+            [input],
+            name or "dense",
+        )
+        self._record_init(node, kernel=kernel_initializer, bias=bias_initializer)
+        return Tensor(node)
+
+    def conv2d(self, input: Tensor, out_channels: int, kernel_h: int, kernel_w: int,
+               stride_h: int = 1, stride_w: int = 1, padding_h: int = 0,
+               padding_w: int = 0, activation: ActiMode = ActiMode.NONE,
+               groups: int = 1, use_bias: bool = True, kernel_initializer=None,
+               bias_initializer=None, name: Optional[str] = None) -> Tensor:
+        node = self._add(
+            OpType.CONV2D,
+            A.Conv2DAttrs(
+                out_channels, (kernel_h, kernel_w), (stride_h, stride_w),
+                (padding_h, padding_w), groups, use_bias, activation,
+            ),
+            [input],
+            name or "conv2d",
+        )
+        self._record_init(node, kernel=kernel_initializer, bias=bias_initializer)
+        return Tensor(node)
+
+    def pool2d(self, input: Tensor, kernel_h: int, kernel_w: int, stride_h: int,
+               stride_w: int, padding_h: int = 0, padding_w: int = 0,
+               pool_type: PoolType = PoolType.MAX,
+               activation: ActiMode = ActiMode.NONE,
+               name: Optional[str] = None) -> Tensor:
+        return self._one(
+            OpType.POOL2D,
+            A.Pool2DAttrs((kernel_h, kernel_w), (stride_h, stride_w),
+                          (padding_h, padding_w), pool_type, activation),
+            [input], name or "pool2d",
+        )
+
+    def embedding(self, input: Tensor, num_entries: int, out_dim: int,
+                  aggr: AggrMode = AggrMode.NONE, dtype: DataType = DataType.FLOAT,
+                  kernel_initializer=None, name: Optional[str] = None) -> Tensor:
+        node = self._add(
+            OpType.EMBEDDING,
+            A.EmbeddingAttrs(num_entries, out_dim, aggr, dtype),
+            [input], name or "embedding",
+        )
+        self._record_init(node, kernel=kernel_initializer)
+        return Tensor(node)
+
+    def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
+                            embed_dim: int, num_heads: int, kdim: int = 0,
+                            vdim: int = 0, dropout: float = 0.0, bias: bool = True,
+                            causal: bool = False, kv_heads: Optional[int] = None,
+                            kernel_initializer=None,
+                            name: Optional[str] = None) -> Tensor:
+        node = self._add(
+            OpType.MULTIHEAD_ATTENTION,
+            A.MultiHeadAttentionAttrs(
+                embed_dim, num_heads, kv_heads, kdim // num_heads if kdim else None,
+                causal, bias, dropout,
+            ),
+            [query, key, value], name or "attention",
+        )
+        self._record_init(node, wq=kernel_initializer, wk=kernel_initializer,
+                          wv=kernel_initializer, wo=kernel_initializer)
+        return Tensor(node)
+
+    def ring_attention(self, query: Tensor, key: Tensor, value: Tensor,
+                       embed_dim: int, num_heads: int, causal: bool = True,
+                       kv_heads: Optional[int] = None,
+                       name: Optional[str] = None) -> Tensor:
+        return self._one(
+            OpType.RING_ATTENTION,
+            A.RingAttentionAttrs(embed_dim, num_heads, kv_heads, None, causal, False),
+            [query, key, value], name or "ring_attention",
+        )
+
+    def batch_matmul(self, a: Tensor, b: Tensor, a_seq_length_dim: int = -1,
+                     b_seq_length_dim: int = -1, name: Optional[str] = None) -> Tensor:
+        return self._one(
+            OpType.BATCH_MATMUL,
+            A.BatchMatmulAttrs(a_seq_length_dim, b_seq_length_dim),
+            [a, b], name or "batch_matmul",
+        )
+
+    # ---- elementwise binary ----
+
+    def _binary(self, kind: str, x: Tensor, y: Tensor, name) -> Tensor:
+        return self._one(OpType.ELEMENT_BINARY, A.ElementBinaryAttrs(kind), [x, y],
+                         name or kind)
+
+    def add(self, x, y, name=None):
+        return self._binary("add", x, y, name)
+
+    def subtract(self, x, y, name=None):
+        return self._binary("subtract", x, y, name)
+
+    def multiply(self, x, y, name=None):
+        return self._binary("multiply", x, y, name)
+
+    def divide(self, x, y, name=None):
+        return self._binary("divide", x, y, name)
+
+    def max(self, x, y, name=None):
+        return self._binary("max", x, y, name)
+
+    def min(self, x, y, name=None):
+        return self._binary("min", x, y, name)
+
+    # ---- elementwise unary ----
+
+    def _unary(self, kind: str, x: Tensor, name, scalar: float = 0.0,
+               inplace: bool = False) -> Tensor:
+        return self._one(OpType.ELEMENT_UNARY,
+                         A.ElementUnaryAttrs(kind, scalar, inplace), [x], name or kind)
+
+    def exp(self, x, name=None):
+        return self._unary("exp", x, name)
+
+    def sin(self, x, name=None):
+        return self._unary("sin", x, name)
+
+    def cos(self, x, name=None):
+        return self._unary("cos", x, name)
+
+    def relu(self, x, inplace: bool = True, name=None):
+        return self._unary("relu", x, name, inplace=inplace)
+
+    def gelu(self, x, name=None):
+        return self._unary("gelu", x, name)
+
+    def sigmoid(self, x, name=None):
+        return self._unary("sigmoid", x, name)
+
+    def tanh(self, x, name=None):
+        return self._unary("tanh", x, name)
+
+    def elu(self, x, name=None):
+        return self._unary("elu", x, name)
+
+    def rsqrt(self, x, name=None):
+        return self._unary("rsqrt", x, name)
+
+    def pow(self, x, exponent: float, name=None):
+        return self._unary("pow", x, name, scalar=exponent)
+
+    def identity(self, x, name=None):
+        return self._unary("identity", x, name)
+
+    def scalar_add(self, x, scalar: float, name=None):
+        return self._unary("scalar_add", x, name, scalar=scalar)
+
+    def scalar_sub(self, x, scalar: float, name=None):
+        return self._unary("scalar_sub", x, name, scalar=scalar)
+
+    def scalar_multiply(self, x, scalar: float, name=None):
+        return self._unary("scalar_multiply", x, name, scalar=scalar)
+
+    def scalar_true_divide(self, x, scalar: float, name=None):
+        return self._unary("scalar_truediv", x, name, scalar=scalar)
+
+    # ---- shape ----
+
+    def reshape(self, input: Tensor, shape: Sequence[int], name=None) -> Tensor:
+        return self._one(OpType.RESHAPE, A.ReshapeAttrs(tuple(shape)), [input],
+                         name or "reshape")
+
+    def flat(self, input: Tensor, name=None) -> Tensor:
+        return self._one(OpType.FLAT, A.FlatAttrs(), [input], name or "flat")
+
+    def transpose(self, input: Tensor, perm: Sequence[int], name=None) -> Tensor:
+        return self._one(OpType.TRANSPOSE, A.TransposeAttrs(tuple(perm)), [input],
+                         name or "transpose")
+
+    def reverse(self, input: Tensor, axis: int, name=None) -> Tensor:
+        return self._one(OpType.REVERSE, A.ReverseAttrs(axis), [input],
+                         name or "reverse")
+
+    def concat(self, tensors: Sequence[Tensor], axis: int, name=None) -> Tensor:
+        return self._one(OpType.CONCAT, A.ConcatAttrs(axis), list(tensors),
+                         name or "concat")
+
+    def split(self, input: Tensor, sizes: Union[int, Sequence[int]], axis: int,
+              name=None) -> List[Tensor]:
+        if isinstance(sizes, int):
+            total = input.shape[axis]
+            sizes = [total // sizes] * sizes
+        node = self._add(OpType.SPLIT, A.SplitAttrs(tuple(sizes), axis), [input],
+                         name or "split")
+        return [Tensor(node, i) for i in range(len(sizes))]
+
+    def cast(self, input: Tensor, dtype: DataType, name=None) -> Tensor:
+        return self._one(OpType.CAST, A.CastAttrs(dtype), [input], name or "cast")
+
+    # ---- norm / softmax / dropout ----
+
+    def batch_norm(self, input: Tensor, relu: bool = True, name=None) -> Tensor:
+        return self._one(OpType.BATCH_NORM, A.BatchNormAttrs(relu), [input],
+                         name or "batch_norm")
+
+    def layer_norm(self, input: Tensor, axes: Sequence[int] = (-1,),
+                   elementwise_affine: bool = True, eps: float = 1e-5,
+                   name=None) -> Tensor:
+        return self._one(
+            OpType.LAYER_NORM,
+            A.LayerNormAttrs(tuple(axes), elementwise_affine, eps),
+            [input], name or "layer_norm",
+        )
+
+    def rms_norm(self, input: Tensor, eps: float = 1e-6, name=None) -> Tensor:
+        return self._one(OpType.RMS_NORM, A.RMSNormAttrs(eps), [input],
+                         name or "rms_norm")
+
+    def softmax(self, input: Tensor, axis: int = -1, name=None) -> Tensor:
+        return self._one(OpType.SOFTMAX, A.SoftmaxAttrs(axis), [input],
+                         name or "softmax")
+
+    def dropout(self, input: Tensor, rate: float, seed: int = 0, name=None) -> Tensor:
+        return self._one(OpType.DROPOUT, A.DropoutAttrs(rate, seed), [input],
+                         name or "dropout")
+
+    # ---- gather / reduce / topk ----
+
+    def gather(self, input: Tensor, index: Tensor, axis: int, name=None) -> Tensor:
+        return self._one(OpType.GATHER, A.GatherAttrs(axis), [input, index],
+                         name or "gather")
+
+    def reduce_sum(self, input: Tensor, axes: Sequence[int], keepdims: bool = False,
+                   name=None) -> Tensor:
+        return self._one(OpType.REDUCE_SUM, A.ReduceAttrs("sum", tuple(axes), keepdims),
+                         [input], name or "reduce_sum")
+
+    def mean(self, input: Tensor, axes: Sequence[int], keepdims: bool = False,
+             name=None) -> Tensor:
+        return self._one(OpType.MEAN, A.ReduceAttrs("mean", tuple(axes), keepdims),
+                         [input], name or "mean")
+
+    def top_k(self, input: Tensor, k: int, sorted: bool = True,
+              name=None) -> Tuple[Tensor, Tensor]:
+        node = self._add(OpType.TOPK, A.TopKAttrs(k, sorted), [input], name or "topk")
+        return Tensor(node, 0), Tensor(node, 1)
+
+    # ---- MoE ----
+
+    def group_by(self, input: Tensor, assign: Tensor, n: int, alpha: float,
+                 name=None) -> List[Tensor]:
+        node = self._add(OpType.GROUP_BY, A.GroupByAttrs(n, alpha), [input, assign],
+                         name or "group_by")
+        return [Tensor(node, i) for i in range(n)]
+
+    def aggregate(self, inputs: Sequence[Tensor], n: int, lambda_bal: float = 0.0,
+                  name=None) -> Tensor:
+        return self._one(OpType.AGGREGATE, A.AggregateAttrs(n, lambda_bal),
+                         list(inputs), name or "aggregate")
+
+    def aggregate_spec(self, inputs: Sequence[Tensor], n: int,
+                       lambda_bal: float = 0.0, name=None) -> Tensor:
+        return self._one(OpType.AGGREGATE_SPEC, A.AggregateSpecAttrs(n, lambda_bal),
+                         list(inputs), name or "aggregate_spec")
+
+    def experts(self, input: Tensor, gate: Tensor, n_experts: int, k: int,
+                hidden_dim: int, out_dim: int, alpha: float = 1.0,
+                activation: ActiMode = ActiMode.GELU, lambda_bal: float = 1e-2,
+                name=None) -> Tensor:
+        return self._one(
+            OpType.EXPERTS,
+            A.ExpertsAttrs(n_experts, k, hidden_dim, out_dim, alpha, activation,
+                           lambda_bal),
+            [input, gate], name or "experts",
+        )
+
+    def moe(self, input: Tensor, num_exp: int, num_select: int, expert_hidden_size: int,
+            alpha: float = 2.0, lambda_bal: float = 0.04, name=None) -> Tensor:
+        """Composite MoE layer (reference src/ops/moe.cc:20-44): gate dense →
+        top-k → group_by → per-expert dense → aggregate."""
+        gate_preds = self.dense(input, num_exp, name=f"{name or 'moe'}_gate")
+        gate_sm = self.softmax(gate_preds, name=f"{name or 'moe'}_gate_sm")
+        topk_values, topk_assign = self.top_k(gate_sm, num_select)
+        grouped = self.group_by(input, topk_assign, num_exp, alpha)
+        expert_outs = []
+        for i, g in enumerate(grouped):
+            h = self.dense(g, expert_hidden_size, ActiMode.RELU,
+                           name=f"{name or 'moe'}_expert{i}")
+            expert_outs.append(h)
+        agg_inputs = [topk_values, topk_assign, topk_assign, gate_sm] + expert_outs
+        return self.aggregate(agg_inputs, num_exp, lambda_bal, name=name)
+
+    def cache(self, input: Tensor, name=None) -> Tensor:
+        return self._one(OpType.CACHE, A.CacheAttrs(), [input], name or "cache")
+
+    # ------------------------------------------------------------------
+    # compile / fit / eval  (reference flexflow_cffi.py:2004-2088)
+
+    def compile(self, optimizer: Optional[Optimizer] = None,
+                loss_type: LossType = LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics: Sequence[MetricsType] = (),
+                comp_mode: CompMode = CompMode.TRAINING,
+                strategy: Optional[Dict[str, ShardingView]] = None):
+        """Convert the layer graph to a PCG, pick a parallelization strategy,
+        and lower to jitted SPMD step functions.
+
+        `strategy` maps node name -> ShardingView for manual strategies; when
+        omitted, DP over all devices is used unless config.search_budget > 0
+        (then the strategy search runs — see flexflow_tpu.search).
+        """
+        import jax
+
+        cfg = self.config
+        self._optimizer = optimizer or SGDOptimizer()
+        self._loss_type = loss_type
+        self._metrics = list(metrics)
+
+        self.graph.infer_shapes()
+
+        devices = cfg.devices
+        if cfg.mesh_shape:
+            mesh_axes = dict(cfg.mesh_shape)
+        else:
+            mesh_axes = {"data": len(devices)}
+        self._mesh = make_mesh(mesh_axes, devices)
+
+        if strategy is None and not cfg.only_data_parallel and cfg.search_budget > 0:
+            from flexflow_tpu.search.api import search_strategy
+
+            strategy = search_strategy(self.graph, self._mesh, cfg)
+
+        # default DP: shard every INPUT's batch dim over "data"; explicit
+        # strategy views override per node name
+        data_degree = dict(zip(self._mesh.axis_names, self._mesh.devices.shape)).get(
+            "data", 1
+        )
+        for n in self.graph.nodes:
+            if strategy and n.name in strategy:
+                n.sharding = strategy[n.name]
+            elif n.op_type == OpType.INPUT and data_degree > 1:
+                shape = n.outputs[0]
+                if shape.dims[0].size % data_degree == 0:
+                    n.sharding = ShardingView((batch_spec(shape.ndim),))
+
+        self._executor = Executor(
+            self.graph,
+            self._mesh,
+            loss_type=loss_type,
+            metrics=self._metrics,
+            optimizer=self._optimizer,
+            seq_length=cfg.seq_length,
+            donate=cfg.donate_buffers,
+        )
+        rng = jax.random.key(cfg.seed)
+        self._params = self._executor.init_params(rng, self._init_overrides)
+        self._opt_state = self._optimizer.init_state(self._params[0])
+        return self
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def executor(self) -> Executor:
+        if self._executor is None:
+            raise RuntimeError("call compile() first")
+        return self._executor
+
+    def _batches(self, arrays: List[np.ndarray], batch_size: int):
+        """Full batches only; the trailing partial batch is dropped (same as
+        the reference dataloader, which sizes steps as n // batch_size)."""
+        n = arrays[0].shape[0]
+        steps = n // batch_size
+        for i in range(steps):
+            yield [a[i * batch_size : (i + 1) * batch_size] for a in arrays]
+
+    def _device_put_batch(self, arrs):
+        import jax
+
+        out = []
+        for a in arrs:
+            sh = self._executor.batch_sharding(a.ndim, a.shape[0])
+            out.append(jax.device_put(a, sh) if sh is not None else jax.device_put(a))
+        return out
+
+    def fit(self, x: Union[np.ndarray, Sequence[np.ndarray]], y: np.ndarray,
+            epochs: Optional[int] = None, batch_size: Optional[int] = None,
+            verbose: bool = True):
+        """Training loop (reference flexflow_cffi.py:2044: per iteration
+        next_batch -> forward -> zero_grads -> backward -> update, wrapped in
+        a Legion trace — here one jitted step call)."""
+        import jax
+
+        xs = [x] if isinstance(x, np.ndarray) else list(x)
+        epochs = epochs or self.config.epochs
+        batch_size = batch_size or self.config.batch_size
+        step = self.executor.train_step()
+        tr, ntr = self._params
+        opt_state = self._opt_state
+        rng = jax.random.key(self._rng_seed + 1)
+        for epoch in range(epochs):
+            self.current_metrics = PerfMetrics()
+            for batch in self._batches(xs + [y], batch_size):
+                *bx, by = self._device_put_batch(batch)
+                rng, sub = jax.random.split(rng)
+                tr, ntr, opt_state, m = step(tr, ntr, opt_state, sub, by, *bx)
+                self._step_count += 1
+                self.current_metrics.update(
+                    {k: float(v) for k, v in m.items() if k != "loss"}, batch_size
+                )
+            if verbose:
+                print(f"epoch {epoch}: {self.current_metrics.report(self._metrics)}")
+        self._params = (tr, ntr)
+        self._opt_state = opt_state
+        return self.current_metrics
+
+    def eval(self, x: Union[np.ndarray, Sequence[np.ndarray]], y: np.ndarray,
+             batch_size: Optional[int] = None, verbose: bool = True):
+        xs = [x] if isinstance(x, np.ndarray) else list(x)
+        batch_size = batch_size or self.config.batch_size
+        step = self.executor.eval_step()
+        tr, ntr = self._params
+        pm = PerfMetrics()
+        for batch in self._batches(xs + [y], batch_size):
+            *bx, by = self._device_put_batch(batch)
+            m = step(tr, ntr, by, *bx)
+            pm.update({k: float(v) for k, v in m.items() if k != "loss"}, batch_size)
+        if verbose:
+            print(f"eval: {pm.report(self._metrics)}")
+        return pm
+
+    def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],
+                batch_size: Optional[int] = None) -> np.ndarray:
+        xs = [x] if isinstance(x, np.ndarray) else list(x)
+        batch_size = batch_size or self.config.batch_size
+        fwd = self.executor.forward_fn()
+        tr, ntr = self._params
+        n = xs[0].shape[0]
+        # pad to a whole number of batches so every row gets a prediction
+        # (unlike fit/eval, predict must not drop the remainder)
+        pad = (-n) % batch_size
+        if pad:
+            xs = [np.concatenate([a, np.repeat(a[-1:], pad, axis=0)]) for a in xs]
+        outs = []
+        for batch in self._batches(xs, batch_size):
+            bx = self._device_put_batch(batch)
+            outs.append(np.asarray(fwd(tr, ntr, *bx)))
+        return np.concatenate(outs, axis=0)[:n]
+
+    # ---- weight access (reference ParallelTensor::set_tensor/get_tensor) ----
+
+    def get_weight(self, tensor_or_name: Union[Tensor, str], weight_name: str = "kernel") -> np.ndarray:
+        key = self._resolve_param_key(tensor_or_name)
+        tr, ntr = self._params
+        src = tr if key in tr and weight_name in tr.get(key, {}) else ntr
+        return np.asarray(src[key][weight_name])
+
+    def set_weight(self, tensor_or_name: Union[Tensor, str], value: np.ndarray,
+                   weight_name: str = "kernel"):
+        import jax
+
+        key = self._resolve_param_key(tensor_or_name)
+        tr, ntr = self._params
+        target = tr if key in tr and weight_name in tr.get(key, {}) else ntr
+        old = target[key][weight_name]
+        target[key][weight_name] = jax.device_put(
+            value.astype(old.dtype), old.sharding
+        )
+
+    def _resolve_param_key(self, tensor_or_name) -> str:
+        if isinstance(tensor_or_name, Tensor):
+            return node_key(tensor_or_name.node)
+        for n in self.graph.nodes:
+            if n.name == tensor_or_name:
+                return node_key(n)
+        raise KeyError(tensor_or_name)
+
+    def to_dot(self) -> str:
+        return self.graph.to_dot()

@@ -1,0 +1,18 @@
+"""Operator library.
+
+Each operator is (1) a frozen attrs dataclass owning shape inference, weight
+declaration, and FLOP/byte accounting (`flexflow_tpu.ops.attrs`), and (2) a
+registered JAX lowering (`flexflow_tpu.ops.jax_ops`) that turns the op into
+XLA HLO (or a Pallas kernel for the hot paths).
+
+Reference analog: `src/ops/*` Op subclasses + `src/ops/kernels/*` CUDA/HIP
+kernels (SURVEY.md §2.2). The Legion launch boilerplate disappears: lowering
+happens inside one traced function; the `Params` structs' role (hashable op
+descriptors for node dedup + cost cache keys) is played by the frozen attrs.
+"""
+
+from flexflow_tpu.ops.base import OpAttrs, WeightSpec
+from flexflow_tpu.ops import attrs
+from flexflow_tpu.ops.registry import get_lowering, register_lowering
+
+__all__ = ["OpAttrs", "WeightSpec", "attrs", "get_lowering", "register_lowering"]

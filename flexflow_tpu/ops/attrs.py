@@ -1,0 +1,652 @@
+"""Attribute dataclasses for every operator (shape inference + weights + FLOPs).
+
+Covers the reference op inventory (SURVEY.md §2.2, src/ops/*) plus TPU-native
+additions (RMSNorm, RingAttention). Shapes are numpy-ordered (dim 0 = batch);
+degree/axes of sharded dims propagate through inference wherever an output
+dim corresponds one-to-one to an input dim (the role of the reference's
+ParallelDimMappingRecords, operator.h:22-49).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+from flexflow_tpu.ffconst import ActiMode, AggrMode, DataType, PoolType
+from flexflow_tpu.ops.base import (
+    OpAttrs,
+    WeightSpec,
+    broadcast_dims,
+    elementwise_like,
+    fresh,
+)
+from flexflow_tpu.pcg.tensor import ParallelDim, ParallelTensorShape, TensorShape
+
+Shape = ParallelTensorShape
+
+
+def _carry(dim: ParallelDim, size: Optional[int] = None) -> ParallelDim:
+    """Copy a dim's sharding onto a (possibly resized) output dim; drops the
+    sharding if the new size is not divisible by the degree."""
+    size = dim.size if size is None else size
+    if size % dim.degree == 0:
+        return ParallelDim(size, dim.degree, dim.axes)
+    return ParallelDim(size)
+
+
+# ---------------------------------------------------------------------------
+# sources
+
+
+@dataclasses.dataclass(frozen=True)
+class InputAttrs(OpAttrs):
+    """PCG source node for a user input (reference NoOp/Input, noop.cc)."""
+
+    shape: TensorShape
+
+    def infer(self, *ins):
+        return (ParallelTensorShape.from_shape(self.shape),)
+
+
+@dataclasses.dataclass(frozen=True)
+class WeightAttrs(OpAttrs):
+    """PCG source node for a standalone weight (reference create_weight)."""
+
+    shape: TensorShape
+    initializer: str = "glorot_uniform"
+
+    def infer(self, *ins):
+        return (ParallelTensorShape.from_shape(self.shape),)
+
+    def weights(self, *ins):
+        return {"weight": WeightSpec(self.shape, self.initializer)}
+
+
+@dataclasses.dataclass(frozen=True)
+class NoOpAttrs(OpAttrs):
+    def infer(self, *ins):
+        return (elementwise_like(ins[0]),)
+
+
+# ---------------------------------------------------------------------------
+# dense / conv / embedding
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearAttrs(OpAttrs):
+    """Dense layer (reference src/ops/linear.cc): y = act(x @ W + b).
+
+    x: (..., in_dim) -> y: (..., out_dim); W: (in_dim, out_dim), b: (out_dim,).
+    Parallelizable on batch dims (data), out_dim (parameter/TP column), and
+    in_dim with a Reduction afterwards (TP row) — the degree mappings the
+    reference builds in LinearParams::construct_mappings (linear.cc:1095).
+    """
+
+    out_dim: int
+    use_bias: bool = True
+    activation: ActiMode = ActiMode.NONE
+    dtype: Optional[DataType] = None
+
+    def infer(self, x: Shape):
+        out_dims = tuple(_carry(d) for d in x.dims[:-1]) + (ParallelDim(self.out_dim),)
+        return (Shape(out_dims, self.dtype or x.dtype, x.replica),)
+
+    def weights(self, x: Shape):
+        in_dim = x.dims[-1].size
+        w = {"kernel": WeightSpec(TensorShape((in_dim, self.out_dim), x.dtype))}
+        if self.use_bias:
+            w["bias"] = WeightSpec(TensorShape((self.out_dim,), x.dtype), "zeros")
+        return w
+
+    def flops(self, ins, outs):
+        x = ins[0]
+        batch = math.prod(d.size for d in x.dims[:-1])
+        return 2 * batch * x.dims[-1].size * self.out_dim
+
+
+@dataclasses.dataclass(frozen=True)
+class Conv2DAttrs(OpAttrs):
+    """2-D convolution, NCHW (reference src/ops/conv_2d.cc; lowered to
+    lax.conv_general_dilated on TPU)."""
+
+    out_channels: int
+    kernel: Tuple[int, int]
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    groups: int = 1
+    use_bias: bool = True
+    activation: ActiMode = ActiMode.NONE
+
+    def infer(self, x: Shape):
+        n, c, h, w = (d.size for d in x.dims)
+        oh = (h + 2 * self.padding[0] - self.kernel[0]) // self.stride[0] + 1
+        ow = (w + 2 * self.padding[1] - self.kernel[1]) // self.stride[1] + 1
+        dims = (
+            _carry(x.dims[0]),
+            ParallelDim(self.out_channels),
+            ParallelDim(oh),
+            ParallelDim(ow),
+        )
+        return (Shape(dims, x.dtype, x.replica),)
+
+    def weights(self, x: Shape):
+        cin = x.dims[1].size
+        w = {
+            "kernel": WeightSpec(
+                TensorShape(
+                    (self.out_channels, cin // self.groups, *self.kernel), x.dtype
+                )
+            )
+        }
+        if self.use_bias:
+            w["bias"] = WeightSpec(TensorShape((self.out_channels,), x.dtype), "zeros")
+        return w
+
+    def flops(self, ins, outs):
+        x, y = ins[0], outs[0]
+        cin = x.dims[1].size
+        per_out = 2 * cin // self.groups * self.kernel[0] * self.kernel[1]
+        return per_out * y.to_shape().num_elements()
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingAttrs(OpAttrs):
+    """Embedding lookup (reference src/ops/embedding.cc). Input int ids
+    (batch, bag); NONE -> (batch, bag, out_dim); SUM/AVG pool the bag dim ->
+    (batch, out_dim)."""
+
+    num_entries: int
+    out_dim: int
+    aggr: AggrMode = AggrMode.NONE
+    dtype: DataType = DataType.FLOAT
+
+    def infer(self, x: Shape):
+        if self.aggr == AggrMode.NONE:
+            dims = tuple(_carry(d) for d in x.dims) + (ParallelDim(self.out_dim),)
+        else:
+            dims = tuple(_carry(d) for d in x.dims[:-1]) + (ParallelDim(self.out_dim),)
+        return (Shape(dims, self.dtype, x.replica),)
+
+    def weights(self, x: Shape):
+        return {
+            "kernel": WeightSpec(
+                TensorShape((self.num_entries, self.out_dim), self.dtype), "normal"
+            )
+        }
+
+    def flops(self, ins, outs):
+        return outs[0].to_shape().num_elements()
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchMatmulAttrs(OpAttrs):
+    """(b..., m, k) @ (b..., k, n) (reference src/ops/batch_matmul.cc).
+    a_seq_length_dim/b_seq_length_dim support iteration-config truncation."""
+
+    a_seq_length_dim: int = -1
+    b_seq_length_dim: int = -1
+
+    def infer(self, a: Shape, b: Shape):
+        if a.ndim != b.ndim or a.ndim < 2:
+            raise ValueError(f"batch_matmul rank mismatch: {a} vs {b}")
+        if a.dims[-1].size != b.dims[-2].size:
+            raise ValueError(f"batch_matmul inner dim mismatch: {a} vs {b}")
+        dims = tuple(_carry(d) for d in a.dims[:-1]) + (_carry(b.dims[-1]),)
+        return (Shape(dims, a.dtype, a.replica),)
+
+    def flops(self, ins, outs):
+        a, b = ins
+        batch = math.prod(d.size for d in a.dims[:-2])
+        return 2 * batch * a.dims[-2].size * a.dims[-1].size * b.dims[-1].size
+
+
+# ---------------------------------------------------------------------------
+# attention
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiHeadAttentionAttrs(OpAttrs):
+    """Multi-head attention (reference src/ops/attention.cc — cuDNN
+    multiHeadAttn; here lowered to fused einsum/flash attention).
+
+    Inputs q, k, v: (batch, seq, embed). Weights packed per-head like the
+    reference's {num_heads, qkvo} layout so head-parallelism ("attribute
+    parallelism", attention.cc:210-230) shards one weight dim.
+    GQA (kv_heads < num_heads) and causal masking are TPU-native extensions
+    needed for the Llama family.
+    """
+
+    embed_dim: int
+    num_heads: int
+    kv_heads: Optional[int] = None
+    head_dim: Optional[int] = None
+    causal: bool = False
+    use_bias: bool = False
+    dropout: float = 0.0
+
+    @property
+    def kdim(self) -> int:
+        return self.head_dim or self.embed_dim // self.num_heads
+
+    @property
+    def num_kv(self) -> int:
+        return self.kv_heads or self.num_heads
+
+    def infer(self, q: Shape, k: Shape = None, v: Shape = None):
+        dims = tuple(_carry(d) for d in q.dims[:-1]) + (ParallelDim(self.embed_dim),)
+        return (Shape(dims, q.dtype, q.replica),)
+
+    def weights(self, q: Shape, k: Shape = None, v: Shape = None):
+        k = k or q
+        v = v or q
+        dt = q.dtype
+        hd = self.kdim
+        w = {
+            "wq": WeightSpec(TensorShape((q.dims[-1].size, self.num_heads, hd), dt)),
+            "wk": WeightSpec(TensorShape((k.dims[-1].size, self.num_kv, hd), dt)),
+            "wv": WeightSpec(TensorShape((v.dims[-1].size, self.num_kv, hd), dt)),
+            "wo": WeightSpec(TensorShape((self.num_heads, hd, self.embed_dim), dt)),
+        }
+        if self.use_bias:
+            w["bq"] = WeightSpec(TensorShape((self.num_heads, hd), dt), "zeros")
+            w["bk"] = WeightSpec(TensorShape((self.num_kv, hd), dt), "zeros")
+            w["bv"] = WeightSpec(TensorShape((self.num_kv, hd), dt), "zeros")
+            w["bo"] = WeightSpec(TensorShape((self.embed_dim,), dt), "zeros")
+        return w
+
+    def flops(self, ins, outs):
+        q = ins[0]
+        b = q.dims[0].size
+        s = q.dims[1].size
+        e = q.dims[-1].size
+        hd = self.kdim
+        proj = 2 * b * s * e * (self.num_heads + 2 * self.num_kv + self.num_heads) * hd
+        attn = 2 * 2 * b * self.num_heads * s * s * hd
+        return proj + attn
+
+
+@dataclasses.dataclass(frozen=True)
+class RingAttentionAttrs(MultiHeadAttentionAttrs):
+    """Sequence-parallel ring attention (net-new vs reference, SURVEY §5.7):
+    identical math to MultiHeadAttention with the sequence dim sharded over a
+    mesh axis; lowering overlaps blockwise attention with ICI ppermute."""
+
+    pass
+
+
+# ---------------------------------------------------------------------------
+# elementwise
+
+
+@dataclasses.dataclass(frozen=True)
+class ElementBinaryAttrs(OpAttrs):
+    """add/sub/mul/div/max/min with numpy broadcast (reference
+    src/ops/element_binary.cc)."""
+
+    kind: str  # add|subtract|multiply|divide|max|min
+
+    def infer(self, a: Shape, b: Shape):
+        out = broadcast_dims(
+            tuple(d.size for d in a.dims), tuple(d.size for d in b.dims)
+        )
+        src = a if a.ndim >= b.ndim else b
+        dims = []
+        for i, size in enumerate(out):
+            sd = src.dims[i]
+            dims.append(_carry(sd, size) if sd.size == size else ParallelDim(size))
+        return (Shape(tuple(dims), a.dtype, src.replica),)
+
+    def flops(self, ins, outs):
+        return outs[0].to_shape().num_elements()
+
+
+@dataclasses.dataclass(frozen=True)
+class ElementUnaryAttrs(OpAttrs):
+    """exp/sin/cos/relu/gelu/sigmoid/tanh/elu/rsqrt/pow/identity and
+    scalar_{add,sub,multiply,truediv} (reference src/ops/element_unary.cc);
+    `scalar` feeds pow exponent / scalar operand."""
+
+    kind: str
+    scalar: float = 0.0
+    inplace: bool = False
+
+    def infer(self, x: Shape):
+        return (elementwise_like(x),)
+
+    def flops(self, ins, outs):
+        return outs[0].to_shape().num_elements()
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshapeAttrs(OpAttrs):
+    shape: Tuple[int, ...]
+
+    def infer(self, x: Shape):
+        if math.prod(self.shape) != x.to_shape().num_elements():
+            raise ValueError(f"reshape {x} -> {self.shape}: element count mismatch")
+        return (fresh(self.shape, x.dtype),)
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatAttrs(OpAttrs):
+    """Flatten all non-batch dims (reference src/ops/flat.cc)."""
+
+    def infer(self, x: Shape):
+        rest = math.prod(d.size for d in x.dims[1:])
+        return (Shape((_carry(x.dims[0]), ParallelDim(rest)), x.dtype, x.replica),)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransposeAttrs(OpAttrs):
+    perm: Tuple[int, ...]
+
+    def infer(self, x: Shape):
+        dims = tuple(_carry(x.dims[p]) for p in self.perm)
+        return (Shape(dims, x.dtype, x.replica),)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReverseAttrs(OpAttrs):
+    axis: int
+
+    def infer(self, x: Shape):
+        return (elementwise_like(x),)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConcatAttrs(OpAttrs):
+    axis: int
+
+    def infer(self, *ins: Shape):
+        total = sum(s.dims[self.axis].size for s in ins)
+        dims = []
+        for i, d in enumerate(ins[0].dims):
+            dims.append(ParallelDim(total) if i == self.axis else _carry(d))
+        return (Shape(tuple(dims), ins[0].dtype, ins[0].replica),)
+
+
+@dataclasses.dataclass(frozen=True)
+class SplitAttrs(OpAttrs):
+    sizes: Tuple[int, ...]
+    axis: int
+
+    def infer(self, x: Shape):
+        outs = []
+        for sz in self.sizes:
+            dims = tuple(
+                ParallelDim(sz) if i == self.axis else _carry(d)
+                for i, d in enumerate(x.dims)
+            )
+            outs.append(Shape(dims, x.dtype, x.replica))
+        return tuple(outs)
+
+
+@dataclasses.dataclass(frozen=True)
+class CastAttrs(OpAttrs):
+    dtype: DataType
+
+    def infer(self, x: Shape):
+        return (elementwise_like(x, self.dtype),)
+
+
+# ---------------------------------------------------------------------------
+# norm / pooling / softmax / dropout
+
+
+@dataclasses.dataclass(frozen=True)
+class Pool2DAttrs(OpAttrs):
+    kernel: Tuple[int, int]
+    stride: Tuple[int, int]
+    padding: Tuple[int, int] = (0, 0)
+    pool_type: PoolType = PoolType.MAX
+    activation: ActiMode = ActiMode.NONE
+
+    def infer(self, x: Shape):
+        n, c, h, w = (d.size for d in x.dims)
+        oh = (h + 2 * self.padding[0] - self.kernel[0]) // self.stride[0] + 1
+        ow = (w + 2 * self.padding[1] - self.kernel[1]) // self.stride[1] + 1
+        dims = (_carry(x.dims[0]), _carry(x.dims[1]), ParallelDim(oh), ParallelDim(ow))
+        return (Shape(dims, x.dtype, x.replica),)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchNormAttrs(OpAttrs):
+    """BatchNorm over the channel dim of NCHW (reference src/ops/batch_norm.cc).
+    Running stats are non-trainable weights updated by the train step."""
+
+    relu: bool = False
+    momentum: float = 0.1
+    eps: float = 1e-5
+
+    def infer(self, x: Shape):
+        return (elementwise_like(x),)
+
+    def weights(self, x: Shape):
+        c = TensorShape((x.dims[1].size,), x.dtype)
+        return {
+            "scale": WeightSpec(c, "ones"),
+            "bias": WeightSpec(c, "zeros"),
+            "running_mean": WeightSpec(c, "zeros", trainable=False),
+            "running_var": WeightSpec(c, "ones", trainable=False),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerNormAttrs(OpAttrs):
+    """LayerNorm over trailing axes (reference src/ops/layer_norm.cc)."""
+
+    axes: Tuple[int, ...] = (-1,)
+    elementwise_affine: bool = True
+    eps: float = 1e-5
+
+    def infer(self, x: Shape):
+        return (elementwise_like(x),)
+
+    def weights(self, x: Shape):
+        if not self.elementwise_affine:
+            return {}
+        norm_shape = tuple(x.dims[a].size for a in self.axes)
+        return {
+            "scale": WeightSpec(TensorShape(norm_shape, x.dtype), "ones"),
+            "bias": WeightSpec(TensorShape(norm_shape, x.dtype), "zeros"),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class RMSNormAttrs(OpAttrs):
+    """RMSNorm (TPU-native addition for the Llama family)."""
+
+    eps: float = 1e-6
+
+    def infer(self, x: Shape):
+        return (elementwise_like(x),)
+
+    def weights(self, x: Shape):
+        return {"scale": WeightSpec(TensorShape((x.dims[-1].size,), x.dtype), "ones")}
+
+
+@dataclasses.dataclass(frozen=True)
+class SoftmaxAttrs(OpAttrs):
+    axis: int = -1
+
+    def infer(self, x: Shape):
+        return (elementwise_like(x),)
+
+
+@dataclasses.dataclass(frozen=True)
+class DropoutAttrs(OpAttrs):
+    rate: float
+    seed: int = 0
+
+    def infer(self, x: Shape):
+        return (elementwise_like(x),)
+
+
+# ---------------------------------------------------------------------------
+# gather / reduce / topk
+
+
+@dataclasses.dataclass(frozen=True)
+class GatherAttrs(OpAttrs):
+    """torch.gather semantics along `axis` (reference src/ops/gather.cc)."""
+
+    axis: int
+
+    def infer(self, x: Shape, index: Shape):
+        return (Shape(tuple(_carry(d) for d in index.dims), x.dtype, x.replica),)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReduceAttrs(OpAttrs):
+    """reduce_sum / mean over axes (reference src/ops/reduce.cc, mean.cc)."""
+
+    kind: str  # sum|mean
+    axes: Tuple[int, ...]
+    keepdims: bool = False
+
+    def infer(self, x: Shape):
+        ax = {a % x.ndim for a in self.axes}
+        dims = []
+        for i, d in enumerate(x.dims):
+            if i in ax:
+                if self.keepdims:
+                    dims.append(ParallelDim(1))
+            else:
+                dims.append(_carry(d))
+        return (Shape(tuple(dims), x.dtype, x.replica),)
+
+
+@dataclasses.dataclass(frozen=True)
+class TopKAttrs(OpAttrs):
+    """Top-k along the last dim -> (values, indices) (reference src/ops/topk.cc)."""
+
+    k: int
+    sorted: bool = True
+
+    def infer(self, x: Shape):
+        dims = tuple(_carry(d) for d in x.dims[:-1]) + (ParallelDim(self.k),)
+        return (
+            Shape(dims, x.dtype, x.replica),
+            Shape(dims, DataType.INT32, x.replica),
+        )
+
+
+# ---------------------------------------------------------------------------
+# MoE ops
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupByAttrs(OpAttrs):
+    """Route tokens to per-expert buffers (reference src/ops/group_by.cc).
+
+    Inputs: data (batch, dim), assignments (batch, k) int. Outputs: n_experts
+    tensors (capacity, dim) where capacity = ceil(k*batch*alpha/n) — dense,
+    capacity-dropped dispatch (TPU-native: one-hot matmul, no scatter).
+    """
+
+    n_experts: int
+    alpha: float = 1.0  # capacity factor
+
+    def capacity(self, batch: int, k: int) -> int:
+        return max(1, int(math.ceil(k * batch * self.alpha / self.n_experts)))
+
+    def infer(self, x: Shape, assign: Shape):
+        batch = x.dims[0].size
+        k = assign.dims[-1].size
+        cap = self.capacity(batch, k)
+        out = Shape((ParallelDim(cap), _carry(x.dims[-1])), x.dtype, x.replica)
+        return tuple(out for _ in range(self.n_experts))
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateAttrs(OpAttrs):
+    """Weighted combine of expert outputs (reference src/ops/aggregate.cc).
+
+    Inputs: gate_preds (batch, k), gate_assign (batch, k), true_gate_assign
+    (batch, k), gate gradients (batch, n), then n expert outputs (cap, dim).
+    Output: (batch, dim). `lambda_bal` weighs the load-balancing gradient.
+    """
+
+    n_experts: int
+    lambda_bal: float = 0.0
+
+    def infer(self, *ins: Shape):
+        gate_preds = ins[0]
+        expert0 = ins[4]
+        batch = gate_preds.dims[0].size
+        dims = (_carry(gate_preds.dims[0], batch), _carry(expert0.dims[-1]))
+        return (Shape(dims, expert0.dtype, expert0.replica),)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateSpecAttrs(AggregateAttrs):
+    """Speculative aggregate (reference src/ops/aggregate_spec.cc): outputs
+    per-expert predictions stacked for replicated-label loss."""
+
+    def infer(self, *ins: Shape):
+        gate_preds = ins[0]
+        expert0 = ins[4]
+        batch = gate_preds.dims[0].size
+        k = gate_preds.dims[-1].size
+        dims = (ParallelDim(batch * k), _carry(expert0.dims[-1]))
+        return (Shape(dims, expert0.dtype, expert0.replica),)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpertsAttrs(OpAttrs):
+    """Fused expert-parallel FFN bank (TPU-native fusion of
+    group_by -> per-expert dense stack -> aggregate into one op so the MoE
+    hot path is a single einsum pair over an expert-sharded weight stack).
+
+    Input: tokens (batch, dim), gate logits (batch, n_experts).
+    Output: (batch, out_dim).
+    """
+
+    n_experts: int
+    k: int
+    hidden_dim: int
+    out_dim: int
+    alpha: float = 1.0
+    activation: ActiMode = ActiMode.GELU
+    lambda_bal: float = 1e-2
+
+    def capacity(self, batch: int) -> int:
+        return max(1, int(math.ceil(self.k * batch * self.alpha / self.n_experts)))
+
+    def infer(self, x: Shape, gate: Shape):
+        dims = tuple(_carry(d) for d in x.dims[:-1]) + (ParallelDim(self.out_dim),)
+        return (Shape(dims, x.dtype, x.replica),)
+
+    def weights(self, x: Shape, gate: Shape):
+        dim = x.dims[-1].size
+        dt = x.dtype
+        return {
+            "w1": WeightSpec(TensorShape((self.n_experts, dim, self.hidden_dim), dt)),
+            "w2": WeightSpec(
+                TensorShape((self.n_experts, self.hidden_dim, self.out_dim), dt)
+            ),
+        }
+
+    def flops(self, ins, outs):
+        x = ins[0]
+        tokens = math.prod(d.size for d in x.dims[:-1])
+        dim = x.dims[-1].size
+        return 2 * tokens * self.k * (dim * self.hidden_dim + self.hidden_dim * self.out_dim)
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheAttrs(OpAttrs):
+    """Activation cache with user score (reference src/ops/cache.cc):
+    carries a non-trainable buffer of the input; the trigger/alter flow is
+    handled by RecompileState in the runtime."""
+
+    def infer(self, x: Shape):
+        return (elementwise_like(x),)
+
+    def weights(self, x: Shape):
+        return {"cached": WeightSpec(x.to_shape(), "zeros", trainable=False)}

@@ -1,0 +1,51 @@
+"""Lowering registry: OpType -> JAX lowering function.
+
+A lowering has signature `fn(attrs, inputs, params, ctx) -> list[Array]`
+where `params` is the op's weight dict and `ctx` a LowerCtx. This replaces
+the reference's per-op Legion task bodies + kernel wrappers
+(e.g. Linear::forward_task -> forward_kernel_wrapper, linear.cc:370,
+kernels/linear_kernels.cu:83): on TPU every op lowers inline into the single
+traced step function and XLA fuses/schedules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+from flexflow_tpu.ffconst import OpType
+
+
+@dataclasses.dataclass
+class LowerCtx:
+    """Per-trace lowering context."""
+
+    training: bool = True
+    rng: Optional[object] = None  # jax PRNG key, folded per-op by the executor
+    mesh: Optional[object] = None
+    seq_length: Optional[int] = None  # FFIterationConfig truncation
+    node_guid: int = 0
+    # lowering writes non-trainable state updates here (BatchNorm running
+    # stats, Cache buffers): key = weight name within the op
+    state_updates: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+_LOWERINGS: Dict[OpType, Callable] = {}
+
+
+def register_lowering(op_type: OpType):
+    def deco(fn):
+        _LOWERINGS[op_type] = fn
+        return fn
+
+    return deco
+
+
+def get_lowering(op_type: OpType) -> Callable:
+    # imports populate the registry on first use
+    from flexflow_tpu.ops import jax_ops  # noqa: F401
+    from flexflow_tpu.parallel import parallel_ops  # noqa: F401
+
+    if op_type not in _LOWERINGS:
+        raise NotImplementedError(f"no lowering registered for {op_type}")
+    return _LOWERINGS[op_type]

@@ -1,0 +1,13 @@
+"""Parallelism: device mesh, sharding views, parallel ops.
+
+Reference analog: MachineView/MachineResource (machine_view.h), the mapper
+(src/mapper/), and src/parallel_ops/. On TPU the mapper disappears into
+XLA's SPMD partitioner: a `ShardingView` (MachineView analog) names mesh
+axes per tensor dim, parallel ops lower to sharding constraints, and GSPMD
+inserts the collectives over ICI.
+"""
+
+from flexflow_tpu.parallel.sharding import ShardingView, Spec
+from flexflow_tpu.parallel.mesh import make_mesh, MeshConfig
+
+__all__ = ["ShardingView", "Spec", "make_mesh", "MeshConfig"]

@@ -1,0 +1,76 @@
+"""Device mesh construction.
+
+Reference analog: MachineResource/MachineView (machine_view.h:14-96) — the
+set of devices a computation spans. TPU-native: one `jax.sharding.Mesh`
+with named axes; sub-axis placement (the reference's start_device_id/stride)
+is replaced by axis factorization, since XLA lays collectives on ICI
+neighbors when the mesh matches the physical torus (mesh_utils respects
+device order from jax.devices()).
+
+Canonical axis names used across the framework:
+  data     — batch (data parallel)
+  model    — hidden/heads (tensor parallel)
+  seq      — sequence (context parallelism / ring attention)
+  expert   — MoE expert parallel
+  pipe     — pipeline stages
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+AXIS_ORDER = ("pipe", "data", "expert", "seq", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Ordered axis sizes; product must equal the device count."""
+
+    axes: Dict[str, int]
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.axes.values())
+
+    def degree(self, axis: str) -> int:
+        return self.axes.get(axis, 1)
+
+
+def normalize_axes(axes: Dict[str, int]) -> Dict[str, int]:
+    """Drop size-1 axes and order canonically (outermost = slowest-varying
+    so `model`/`seq` land on adjacent devices, riding the fastest ICI
+    links)."""
+    out = {}
+    for name in AXIS_ORDER:
+        if axes.get(name, 1) > 1:
+            out[name] = axes[name]
+    for name, size in axes.items():
+        if name not in AXIS_ORDER and size > 1:
+            out[name] = size
+    return out
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
+    """Build a jax.sharding.Mesh with the canonical axis order. Size-1 axes
+    are kept (they're harmless and keep PartitionSpecs stable)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    ordered = {}
+    for name in AXIS_ORDER:
+        if name in axes:
+            ordered[name] = axes[name]
+    for name in axes:
+        if name not in ordered:
+            ordered[name] = axes[name]
+    n = math.prod(ordered.values())
+    if n > len(devices):
+        raise ValueError(f"mesh {ordered} needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(tuple(ordered.values()))
+    return Mesh(arr, tuple(ordered.keys()))

@@ -1,0 +1,173 @@
+"""Parallel operators — first-class PCG resharding nodes.
+
+Reference analog: src/parallel_ops/ (SURVEY.md §2.3). In the reference these
+build Legion partitions and device-local copy/sum tasks, with Legion moving
+data between devices. TPU-native: each lowers to an identity +
+`with_sharding_constraint`; XLA GSPMD materializes the movement as the
+matching ICI collective:
+
+  Repartition(dim, axis)   -> all-to-all / slice  (partition a dim)
+  Combine(dim)             -> all-gather          (unpartition a dim)
+  Replicate()              -> broadcast (fwd), psum of grads (bwd) — both
+                              emitted by the partitioner automatically
+  Reduction()              -> all-reduce of a partial-sum (appears when a
+                              contraction dim is sharded; the constraint
+                              forces where it happens)
+  AllToAll(src, dst)       -> ICI all-to-all moving sharding between dims
+                              (Ulysses-style sequence<->head exchange)
+
+Keeping them as explicit PCG nodes (instead of letting GSPMD guess) is what
+makes strategies searchable and costable, mirroring how the reference treats
+them as substitution-insertable graph nodes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.ops.base import OpAttrs, elementwise_like
+from flexflow_tpu.ops.registry import register_lowering
+from flexflow_tpu.parallel.sharding import Spec, spec_to_partition_spec
+from flexflow_tpu.pcg.tensor import ParallelDim, ParallelTensorShape
+
+
+def _constrain(x, spec: Optional[Spec], mesh):
+    import jax
+    from jax.sharding import NamedSharding
+
+    if mesh is None:
+        return x
+    ps = spec_to_partition_spec(spec)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, ps))
+
+
+def _respec(shape: ParallelTensorShape, spec: Spec, mesh) -> ParallelTensorShape:
+    dims = []
+    for i, d in enumerate(shape.dims):
+        axes = spec[i] if i < len(spec) else ()
+        if axes and mesh is not None:
+            degree = 1
+            for a in axes:
+                degree *= dict(zip(mesh.axis_names, mesh.devices.shape))[a]
+        else:
+            degree = 1
+        dims.append(ParallelDim(d.size, degree if d.size % max(degree, 1) == 0 else 1, tuple(axes)))
+    return dataclasses.replace(shape, dims=tuple(dims))
+
+
+@dataclasses.dataclass(frozen=True)
+class RepartitionAttrs(OpAttrs):
+    """Partition `dim` over mesh axes `axes` (reference partition.cc)."""
+
+    dim: int
+    axes: Tuple[str, ...]
+
+    def infer(self, x: ParallelTensorShape):
+        dims = list(x.dims)
+        dims[self.dim] = ParallelDim(dims[self.dim].size, dims[self.dim].degree, self.axes)
+        return (dataclasses.replace(x, dims=tuple(dims)),)
+
+    def spec(self, ndim: int) -> Spec:
+        return tuple(self.axes if i == self.dim else () for i in range(ndim))
+
+
+@dataclasses.dataclass(frozen=True)
+class CombineAttrs(OpAttrs):
+    """Unpartition `dim` (reference combine.cc: fwd gather, bwd scatter)."""
+
+    dim: int
+
+    def infer(self, x: ParallelTensorShape):
+        dims = list(x.dims)
+        dims[self.dim] = ParallelDim(dims[self.dim].size)
+        return (dataclasses.replace(x, dims=tuple(dims)),)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicateAttrs(OpAttrs):
+    """Replicate over `axes` (reference replicate.cc). Forward broadcast;
+    grad-psum over the replica axes is emitted by the partitioner."""
+
+    axes: Tuple[str, ...] = ()
+
+    def infer(self, x: ParallelTensorShape):
+        return (elementwise_like(x),)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReductionAttrs(OpAttrs):
+    """Sum partial results (reference reduction.cc) — lowers to an
+    all-reduce placed where this node sits; output fully replicated unless
+    `out_spec` re-shards it (reduce-scatter)."""
+
+    out_spec: Optional[Spec] = None
+
+    def infer(self, x: ParallelTensorShape):
+        return (elementwise_like(x),)
+
+
+@dataclasses.dataclass(frozen=True)
+class AllToAllAttrs(OpAttrs):
+    """Move sharding from `src_dim` to `dst_dim` (Ulysses sequence<->head
+    exchange; net-new vs reference, whose closest analog is
+    FusedParallelOp)."""
+
+    src_dim: int
+    dst_dim: int
+    axes: Tuple[str, ...]
+
+    def infer(self, x: ParallelTensorShape):
+        dims = list(x.dims)
+        dims[self.src_dim] = ParallelDim(dims[self.src_dim].size)
+        dims[self.dst_dim] = ParallelDim(
+            dims[self.dst_dim].size, dims[self.dst_dim].degree, self.axes
+        )
+        return (dataclasses.replace(x, dims=tuple(dims)),)
+
+
+def _spec_of_node(attrs, node, x, mesh) -> Optional[Spec]:
+    if node.sharding is not None and node.sharding.output_specs:
+        return node.sharding.output_spec(0)
+    if isinstance(attrs, RepartitionAttrs):
+        return attrs.spec(x.ndim)
+    if isinstance(attrs, CombineAttrs):
+        return tuple(() for _ in range(x.ndim))
+    if isinstance(attrs, ReplicateAttrs):
+        return tuple(() for _ in range(x.ndim))
+    if isinstance(attrs, ReductionAttrs):
+        return attrs.out_spec or tuple(() for _ in range(x.ndim))
+    if isinstance(attrs, AllToAllAttrs):
+        return tuple(
+            attrs.axes if i == attrs.dst_dim else () for i in range(x.ndim)
+        )
+    return None
+
+
+def _make_parallel_lowering(op_type):
+    @register_lowering(op_type)
+    def _lower(attrs, inputs, params, ctx):
+        (x,) = inputs
+        spec = None
+        if hasattr(attrs, "spec") and isinstance(attrs, RepartitionAttrs):
+            spec = attrs.spec(x.ndim)
+        elif isinstance(attrs, AllToAllAttrs):
+            spec = tuple(attrs.axes if i == attrs.dst_dim else () for i in range(x.ndim))
+        elif isinstance(attrs, ReductionAttrs):
+            spec = attrs.out_spec or tuple(() for _ in range(x.ndim))
+        else:  # Combine / Replicate -> replicated on the moved dim(s)
+            spec = tuple(() for _ in range(x.ndim))
+        return [_constrain(x, spec, ctx.mesh)]
+
+    return _lower
+
+
+for _t in (
+    OpType.REPARTITION,
+    OpType.COMBINE,
+    OpType.REPLICATE,
+    OpType.REDUCTION,
+    OpType.ALL_TO_ALL,
+):
+    _make_parallel_lowering(_t)

@@ -1,0 +1,124 @@
+"""Ring attention — sequence-parallel attention over an ICI ring.
+
+Net-new subsystem vs the reference (SURVEY.md §5.7: FlexFlow has no sequence
+parallelism). Design: q/k/v are sequence-sharded over the `seq` mesh axis;
+each device computes blockwise (flash-style) attention of its local queries
+against the k/v block it currently holds, while k/v blocks rotate around the
+ring with `lax.ppermute` — compute overlaps the ICI transfer of the next
+block. Online softmax (running max + denominator in fp32) makes the result
+exactly equal to full attention.
+
+The lowering is used by OpType.RING_ATTENTION and falls back to plain fused
+attention when the sequence axis is unsharded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    except AttributeError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def _mesh_axis_size(mesh, name: str) -> int:
+    if mesh is None or name not in mesh.axis_names:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape))[name]
+
+
+def ring_attention_core(q, k, v, *, axis_name: str, n_shards: int, causal: bool, scale: float):
+    """Per-shard body (inside shard_map). q,k,v: (B, s_loc, H, D) local
+    blocks; device i initially holds sequence block i."""
+    B, s_loc, H, D = q.shape
+    my = lax.axis_index(axis_name)
+    NEG = jnp.float32(-1e30)
+
+    qf = q.astype(jnp.float32)
+    m0 = jnp.full((B, H, s_loc), NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, s_loc), jnp.float32)
+    acc0 = jnp.zeros((B, s_loc, H, D), jnp.float32)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src = (my - i) % n_shards  # which sequence block we hold now
+        logits = jnp.einsum(
+            "bshd,bthd->bhst", qf, k_blk.astype(jnp.float32)
+        ) * scale
+        if causal:
+            q_pos = my * s_loc + lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+            k_pos = src * s_loc + lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+            mask = q_pos >= k_pos
+            logits = jnp.where(mask[None, None], logits, NEG)
+            pmask = mask[None, None].astype(jnp.float32)
+        else:
+            pmask = jnp.float32(1.0)
+        blk_max = logits.max(axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(logits - new_m[..., None]) * pmask
+        new_l = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhst,bthd->bshd", p, v_blk.astype(jnp.float32))
+        new_acc = acc * corr.transpose(0, 2, 1)[..., None] + pv
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, new_m, new_l, new_acc)
+
+    _, _, m, l, acc = lax.fori_loop(0, n_shards, body, (k, v, m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
+                               seq_axis: str = "seq", batch_axis: str = "data",
+                               head_axis: str = "model"):
+    """q,k,v: (B, S, H, D) global, S sharded over `seq_axis`. Exact
+    attention via ring rotation. Falls back to a single local computation
+    when the seq axis has size 1."""
+    n = _mesh_axis_size(mesh, seq_axis)
+    from flexflow_tpu.ops.jax_ops import _dot_product_attention
+
+    if n == 1:
+        return _dot_product_attention(q, k, v, causal, scale)
+
+    ba = batch_axis if _mesh_axis_size(mesh, batch_axis) > 1 else None
+    ha = head_axis if _mesh_axis_size(mesh, head_axis) > 1 else None
+    spec = P(ba, seq_axis, ha, None)
+
+    def fn(ql, kl, vl):
+        return ring_attention_core(
+            ql, kl, vl, axis_name=seq_axis, n_shards=n, causal=causal, scale=scale
+        )
+
+    return _shard_map(fn, mesh, (spec, spec, spec), spec)(q, k, v)
+
+
+def ring_attention_lowering(attrs, inputs, params, ctx):
+    """Lowering for OpType.RING_ATTENTION: same projections as
+    MULTIHEAD_ATTENTION, ring core for the attention itself."""
+    q_in = inputs[0]
+    k_in = inputs[1] if len(inputs) > 1 else q_in
+    v_in = inputs[2] if len(inputs) > 2 else k_in
+    dt = q_in.dtype
+    hd = attrs.kdim
+    q = jnp.einsum("bse,ehd->bshd", q_in, params["wq"].astype(dt))
+    k = jnp.einsum("bse,ehd->bshd", k_in, params["wk"].astype(dt))
+    v = jnp.einsum("bse,ehd->bshd", v_in, params["wv"].astype(dt))
+    if attrs.num_kv != attrs.num_heads:
+        rep = attrs.num_heads // attrs.num_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    out = ring_dot_product_attention(
+        q, k, v, mesh=ctx.mesh, causal=attrs.causal, scale=1.0 / (hd**0.5)
+    )
+    y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
+    return [y]

@@ -1,0 +1,93 @@
+"""ShardingView — the MachineView analog.
+
+Reference analog: `MachineView` (machine_view.h:14-96) tagged every op launch
+with {device_type, start_device_id, dim[], stride[]}; the mapper turned it
+into processor placement. On TPU a view instead names, for each tensor dim
+of the op's outputs and weights, the mesh axes that shard it; the executor
+turns views into `NamedSharding` constraints and XLA GSPMD does placement.
+
+A `Spec` is a per-dim tuple of mesh-axis tuples, e.g. for a (batch, seq,
+hidden) activation sharded DP×TP: ((("data",), (), ("model",))).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+Spec = Tuple[Tuple[str, ...], ...]
+
+
+def spec_to_partition_spec(spec: Optional[Spec]):
+    from jax.sharding import PartitionSpec
+
+    if spec is None:
+        return PartitionSpec()
+    entries = []
+    for axes in spec:
+        if not axes:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(tuple(axes))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def replicated_spec(ndim: int) -> Spec:
+    return tuple(() for _ in range(ndim))
+
+
+def batch_spec(ndim: int, axis: str = "data") -> Spec:
+    """Shard dim 0 over `axis`, replicate the rest (pure DP)."""
+    return ((axis,),) + tuple(() for _ in range(ndim - 1))
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingView:
+    """Per-node strategy record assigned by the search (or default-DP).
+
+    output_specs[i] shards the node's i-th output; weight_specs[name] shards
+    that weight (None entries = replicated). Degrees are implied by the mesh
+    the strategy was built for.
+    """
+
+    output_specs: Tuple[Optional[Spec], ...] = ()
+    weight_specs: Dict[str, Optional[Spec]] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        # freeze dict for hashing
+        object.__setattr__(self, "weight_specs", dict(self.weight_specs))
+
+    def __hash__(self):
+        return hash(
+            (self.output_specs, tuple(sorted(self.weight_specs.items())))
+        )
+
+    def output_spec(self, idx: int = 0) -> Optional[Spec]:
+        if idx < len(self.output_specs):
+            return self.output_specs[idx]
+        return None
+
+    def __repr__(self):
+        def fmt(spec):
+            if spec is None:
+                return "R"
+            return "(" + ",".join("+".join(a) if a else "·" for a in spec) + ")"
+
+        outs = ";".join(fmt(s) for s in self.output_specs)
+        ws = ",".join(f"{k}:{fmt(v)}" for k, v in self.weight_specs.items())
+        return f"View[{outs}{('|' + ws) if ws else ''}]"
+
+
+def used_axes(view: ShardingView) -> Tuple[str, ...]:
+    axes = []
+    for spec in list(view.output_specs) + list(view.weight_specs.values()):
+        if spec:
+            for entry in spec:
+                for a in entry:
+                    if a not in axes:
+                        axes.append(a)
+    return tuple(axes)

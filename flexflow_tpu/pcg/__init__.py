@@ -1,0 +1,22 @@
+"""Parallel Computation Graph (PCG) intermediate representation.
+
+The PCG is the central IR: a DAG of operator nodes over sharded tensor shapes.
+Frontends build a lazy `LayerGraph`; `compile()` converts it into a PCG; the
+strategy search rewrites the PCG (substitutions) and assigns a `ShardingView`
+per node; the executor lowers the final PCG to one jitted XLA SPMD program.
+
+Reference analog: `include/flexflow/graph.h` (PCG::Graph), `tensor.h`,
+`parallel_tensor.h`, `layer.h`.
+"""
+
+from flexflow_tpu.pcg.tensor import TensorShape, ParallelDim, ParallelTensorShape
+from flexflow_tpu.pcg.graph import Graph, Node, Edge
+
+__all__ = [
+    "TensorShape",
+    "ParallelDim",
+    "ParallelTensorShape",
+    "Graph",
+    "Node",
+    "Edge",
+]

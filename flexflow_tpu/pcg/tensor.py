@@ -1,0 +1,156 @@
+"""Tensor shape IR: logical shapes and sharded (parallel) shapes.
+
+Reference analogs:
+  - `TensorShape`         <- frontend `Tensor` (include/flexflow/tensor.h:85):
+    plain dims + dtype, recorded by the lazy layer graph.
+  - `ParallelDim`         <- parallel_tensor.h:36-71 `{size, degree,
+    parallel_idx, is_replica_dim}`; here `axes` names the mesh axes sharding
+    the dim (the TPU-native replacement for parallel_idx: a PartitionSpec
+    entry), and replication is a dedicated `replica` dim on the shape.
+  - `ParallelTensorShape`  <- parallel_tensor.h:134.
+
+Degrees are kept explicitly (not only axis names) because the strategy search
+reasons about degrees before mesh axes are bound; `to_partition_spec` converts
+an axis-bound shape into a `jax.sharding.PartitionSpec`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+from flexflow_tpu.ffconst import DataType
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorShape:
+    """Logical (unsharded) tensor shape. Dim order is row-major like numpy;
+    dim 0 is the outermost (batch) dim — note the reference stores dims
+    reversed (Legion order); we use numpy order everywhere."""
+
+    dims: Tuple[int, ...]
+    dtype: DataType = DataType.FLOAT
+
+    @property
+    def ndim(self) -> int:
+        return len(self.dims)
+
+    def num_elements(self) -> int:
+        return math.prod(self.dims) if self.dims else 1
+
+    def size_bytes(self) -> int:
+        return self.num_elements() * self.dtype.size_bytes
+
+    def __str__(self) -> str:
+        return f"{list(self.dims)}:{self.dtype.value}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDim:
+    """One sharded dimension: global `size` split `degree` ways over mesh
+    axes `axes` (empty until mesh binding; product of axis sizes == degree)."""
+
+    size: int
+    degree: int = 1
+    axes: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if self.degree < 1:
+            raise ValueError(f"degree must be >= 1, got {self.degree}")
+        if self.size % self.degree != 0:
+            raise ValueError(f"size {self.size} not divisible by degree {self.degree}")
+
+    @property
+    def shard_size(self) -> int:
+        return self.size // self.degree
+
+    def with_degree(self, degree: int, axes: Tuple[str, ...] = ()) -> "ParallelDim":
+        return ParallelDim(self.size, degree, axes)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelTensorShape:
+    """A sharded tensor shape: per-dim partition degrees plus a replica
+    degree (the reference's is_replica_dim, kept out-of-band so logical dim
+    indices match the frontend shape)."""
+
+    dims: Tuple[ParallelDim, ...]
+    dtype: DataType = DataType.FLOAT
+    replica: ParallelDim = dataclasses.field(default_factory=lambda: ParallelDim(1, 1))
+
+    @staticmethod
+    def from_shape(shape: TensorShape) -> "ParallelTensorShape":
+        return ParallelTensorShape(
+            tuple(ParallelDim(d) for d in shape.dims), shape.dtype
+        )
+
+    def to_shape(self) -> TensorShape:
+        return TensorShape(tuple(d.size for d in self.dims), self.dtype)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.dims)
+
+    def degree(self, dim: int) -> int:
+        return self.dims[dim].degree
+
+    @property
+    def replica_degree(self) -> int:
+        return self.replica.degree
+
+    def total_degree(self) -> int:
+        """Number of shards (devices this tensor's computation spans)."""
+        return math.prod(d.degree for d in self.dims) * self.replica.degree
+
+    def shard_shape(self) -> Tuple[int, ...]:
+        return tuple(d.shard_size for d in self.dims)
+
+    def shard_bytes(self) -> int:
+        return math.prod(self.shard_shape()) * self.dtype.size_bytes
+
+    def global_bytes(self) -> int:
+        return self.to_shape().size_bytes()
+
+    def is_fully_replicated(self) -> bool:
+        return all(d.degree == 1 for d in self.dims)
+
+    def with_dim_degree(
+        self, dim: int, degree: int, axes: Tuple[str, ...] = ()
+    ) -> "ParallelTensorShape":
+        dims = list(self.dims)
+        dims[dim] = dims[dim].with_degree(degree, axes)
+        return dataclasses.replace(self, dims=tuple(dims))
+
+    def with_replica(
+        self, degree: int, axes: Tuple[str, ...] = ()
+    ) -> "ParallelTensorShape":
+        return dataclasses.replace(self, replica=ParallelDim(degree, degree, axes))
+
+    def to_partition_spec(self):
+        """Axis-bound shape -> jax.sharding.PartitionSpec (replica axes are
+        simply unused mesh axes: XLA replicates over them)."""
+        from jax.sharding import PartitionSpec
+
+        entries = []
+        for d in self.dims:
+            if len(d.axes) == 0:
+                entries.append(None)
+            elif len(d.axes) == 1:
+                entries.append(d.axes[0])
+            else:
+                entries.append(tuple(d.axes))
+        # trim trailing Nones for canonical specs
+        while entries and entries[-1] is None:
+            entries.pop()
+        return PartitionSpec(*entries)
+
+    def __str__(self) -> str:
+        parts = []
+        for d in self.dims:
+            s = str(d.size)
+            if d.degree > 1:
+                s += f"/{d.degree}" + (f"{list(d.axes)}" if d.axes else "")
+            parts.append(s)
+        r = f" r{self.replica.degree}" if self.replica.degree > 1 else ""
+        return f"[{', '.join(parts)}]{r}:{self.dtype.value}"

@@ -1,0 +1,6 @@
+"""Runtime: executor, optimizers, initializers, loss, metrics, dataloader.
+
+Reference analog: src/runtime/ (FFModel training-loop primitives, optimizer/
+initializer/loss/metrics tasks) — re-designed so the whole training step is
+one jitted XLA SPMD program instead of per-op Legion task launches.
+"""

@@ -1,0 +1,300 @@
+"""Executor: lowers a PCG (+ per-node ShardingViews) to jitted XLA programs.
+
+This is the TPU-native replacement for the reference's entire task execution
+pipeline (SURVEY.md §3.3-3.4): instead of per-op Legion IndexLauncher +
+mapper + Realm data movement, the whole training iteration becomes ONE
+`jax.jit`-compiled SPMD program over a device mesh:
+
+  - forward: topo-order walk of the PCG, each node's registered lowering
+    applied, node ShardingViews becoming `with_sharding_constraint`s (the
+    parallel-op nodes are pure constraints);
+  - backward: `jax.value_and_grad` over the forward (replacing hand-written
+    backward tasks);
+  - gradient sync: emitted automatically by GSPMD (psum over the data axis)
+    — the reference's NCCL allreduce (optimizer_kernel.cu:88);
+  - update: optimizer math fused into the same program;
+  - Legion trace replay (flexflow_c.cc:1743) -> jit compile-once/replay.
+
+Master weights stay fp32; lowerings cast to the activation dtype at use
+sites, so bf16 compute with fp32 accumulation comes for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import LossType, MetricsType, OpType
+from flexflow_tpu.ops.registry import LowerCtx, get_lowering
+from flexflow_tpu.parallel.sharding import (
+    ShardingView,
+    batch_spec,
+    spec_to_partition_spec,
+)
+from flexflow_tpu.pcg.graph import Graph, Node
+from flexflow_tpu.runtime import initializer as init_mod
+from flexflow_tpu.runtime.loss import compute_loss
+from flexflow_tpu.runtime.metrics import compute_step_metrics
+from flexflow_tpu.runtime.optimizer import Optimizer
+
+
+def node_key(node: Node) -> str:
+    return f"{node.name}_{node.guid}"
+
+
+class Executor:
+    """Owns the lowered step functions for one compiled PCG."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        mesh,
+        *,
+        loss_type: LossType,
+        metrics: Sequence[MetricsType],
+        optimizer: Optional[Optimizer],
+        label_dtype=jnp.int32,
+        seq_length: Optional[int] = None,
+        donate: bool = True,
+    ):
+        self.graph = graph
+        self.mesh = mesh
+        self.loss_type = loss_type
+        self.metrics = list(metrics)
+        self.optimizer = optimizer
+        self.seq_length = seq_length
+        self.donate = donate
+        self.topo = graph.topo_order()
+        self.input_nodes = [n for n in self.topo if n.op_type == OpType.INPUT]
+        sinks = graph.sinks()
+        if len(sinks) != 1:
+            raise ValueError(f"PCG must have exactly one sink, got {sinks}")
+        self.sink = sinks[0]
+        self.last_op_is_softmax = self.sink.op_type == OpType.SOFTMAX
+        self._train_step = None
+        self._eval_step = None
+        self._forward = None
+
+    # ------------------------------------------------------------------
+    # parameter creation
+
+    def weight_specs(self) -> Dict[str, Dict[str, Any]]:
+        """(node_key -> weight name -> WeightSpec) for all ops with weights."""
+        out = {}
+        for n in self.topo:
+            if n.attrs is None or n.op_type == OpType.INPUT:
+                continue
+            ins = self.graph.input_shapes(n)
+            ws = n.attrs.weights(*ins)
+            if ws:
+                out[node_key(n)] = ws
+        return out
+
+    def param_shardings(self):
+        """NamedSharding pytrees for (trainable, nontrainable) params from
+        the nodes' ShardingViews (replicated when unspecified)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        tr, ntr = {}, {}
+        for n in self.topo:
+            key = node_key(n)
+            if n.attrs is None or n.op_type == OpType.INPUT:
+                continue
+            ws = n.attrs.weights(*self.graph.input_shapes(n))
+            if not ws:
+                continue
+            view: Optional[ShardingView] = n.sharding
+            for name, spec_decl in ws.items():
+                pspec = PartitionSpec()
+                if view is not None and name in view.weight_specs:
+                    pspec = spec_to_partition_spec(view.weight_specs[name])
+                sh = NamedSharding(self.mesh, pspec)
+                (tr if spec_decl.trainable else ntr).setdefault(key, {})[name] = sh
+        return tr, ntr
+
+    def init_params(self, rng, overrides: Optional[Dict] = None):
+        """Initialize (trainable, nontrainable) param pytrees, jitted with
+        output shardings so big weights materialize directly sharded.
+        `overrides` maps node_key -> weight name -> Initializer (the layer
+        methods' kernel_initializer arguments)."""
+        specs = self.weight_specs()
+        overrides = overrides or {}
+
+        keys = {}
+        i = 0
+        for nk, ws in sorted(specs.items()):
+            for wn in sorted(ws):
+                keys[(nk, wn)] = i
+                i += 1
+
+        def build(rng):
+            tr, ntr = {}, {}
+            for nk, ws in specs.items():
+                for wn, spec in ws.items():
+                    ini = overrides.get(nk, {}).get(wn) or init_mod.resolve(
+                        spec.initializer
+                    )
+                    sub = jax.random.fold_in(rng, keys[(nk, wn)])
+                    # master weights in fp32 (bf16 cast happens at use site)
+                    dtype = spec.shape.dtype.jnp_dtype
+                    if dtype == jnp.bfloat16 or dtype == jnp.float16:
+                        dtype = jnp.float32
+                    arr = ini(sub, spec.shape.dims, dtype)
+                    d = tr if spec.trainable else ntr
+                    d.setdefault(nk, {})[wn] = arr
+            return tr, ntr
+
+        tr_sh, ntr_sh = self.param_shardings()
+        return jax.jit(build, out_shardings=(tr_sh, ntr_sh))(rng)
+
+    # ------------------------------------------------------------------
+    # forward
+
+    def _apply_view(self, node: Node, vals: List):
+        view: Optional[ShardingView] = node.sharding
+        if view is None or self.mesh is None:
+            return vals
+        from jax.sharding import NamedSharding
+
+        out = []
+        for i, v in enumerate(vals):
+            spec = view.output_spec(i)
+            if spec is None:
+                out.append(v)
+            else:
+                ps = spec_to_partition_spec(spec)
+                out.append(jax.lax.with_sharding_constraint(v, NamedSharding(self.mesh, ps)))
+        return out
+
+    def run_forward(self, trainable, nontrainable, inputs: Sequence, *, training: bool, rng):
+        """Topo-order lowering. Returns (sink output, state_updates, aux_loss)."""
+        values: Dict[Tuple[int, int], Any] = {}
+        if len(inputs) != len(self.input_nodes):
+            raise ValueError(
+                f"expected {len(self.input_nodes)} inputs, got {len(inputs)}"
+            )
+        for n, x in zip(self.input_nodes, inputs):
+            values[(n.guid, 0)] = x
+        state_updates: Dict[str, Dict[str, Any]] = {}
+        aux_loss = 0.0
+        for n in self.topo:
+            if n.op_type == OpType.INPUT:
+                vals = self._apply_view(n, [values[(n.guid, 0)]])
+                values[(n.guid, 0)] = vals[0]
+                continue
+            key = node_key(n)
+            ins = [values[(e.src, e.src_idx)] for e in self.graph.in_edges(n)]
+            params = {}
+            params.update(trainable.get(key, {}))
+            params.update(nontrainable.get(key, {}))
+            ctx = LowerCtx(
+                training=training,
+                rng=jax.random.fold_in(rng, n.guid) if rng is not None else None,
+                mesh=self.mesh,
+                seq_length=self.seq_length,
+                node_guid=n.guid,
+            )
+            outs = get_lowering(n.op_type)(n.attrs, ins, params, ctx)
+            outs = self._apply_view(n, outs)
+            for i, o in enumerate(outs):
+                values[(n.guid, i)] = o
+            if ctx.state_updates:
+                aux = ctx.state_updates.pop("__aux_loss__", None)
+                if aux is not None:
+                    aux_loss = aux_loss + aux
+                if ctx.state_updates:
+                    state_updates[key] = dict(ctx.state_updates)
+        return values[(self.sink.guid, 0)], state_updates, aux_loss
+
+    # ------------------------------------------------------------------
+    # compiled steps
+
+    @staticmethod
+    def _merge_state(nontrainable, updates):
+        if not updates:
+            return nontrainable
+        new = {k: dict(v) for k, v in nontrainable.items()}
+        for nk, ws in updates.items():
+            new.setdefault(nk, {}).update(ws)
+        return new
+
+    def train_step(self):
+        if self._train_step is not None:
+            return self._train_step
+        opt = self.optimizer
+
+        def step(trainable, nontrainable, opt_state, rng, labels, *inputs):
+            def loss_fn(tr):
+                logits, updates, aux = self.run_forward(
+                    tr, nontrainable, inputs, training=True, rng=rng
+                )
+                loss = compute_loss(
+                    self.loss_type, logits, labels, self.last_op_is_softmax
+                )
+                return loss + aux, (logits, updates, loss)
+
+            grads, (logits, updates, loss) = jax.grad(loss_fn, has_aux=True)(trainable)
+            new_tr, new_opt = opt.update(grads, trainable, opt_state)
+            new_ntr = self._merge_state(nontrainable, updates)
+            step_metrics = compute_step_metrics(
+                self.metrics, self.loss_type, logits, labels, self.last_op_is_softmax
+            )
+            step_metrics["loss"] = loss
+            return new_tr, new_ntr, new_opt, step_metrics
+
+        donate = (0, 1, 2) if self.donate else ()
+        self._train_step = jax.jit(step, donate_argnums=donate)
+        return self._train_step
+
+    def eval_step(self):
+        if self._eval_step is not None:
+            return self._eval_step
+
+        def step(trainable, nontrainable, labels, *inputs):
+            logits, _, _ = self.run_forward(
+                trainable, nontrainable, inputs, training=False, rng=jax.random.key(0)
+            )
+            loss = compute_loss(self.loss_type, logits, labels, self.last_op_is_softmax)
+            m = compute_step_metrics(
+                self.metrics, self.loss_type, logits, labels, self.last_op_is_softmax
+            )
+            m["loss"] = loss
+            return m
+
+        self._eval_step = jax.jit(step)
+        return self._eval_step
+
+    def forward_fn(self):
+        """Inference forward (predict)."""
+        if self._forward is not None:
+            return self._forward
+
+        def fwd(trainable, nontrainable, *inputs):
+            out, _, _ = self.run_forward(
+                trainable, nontrainable, inputs, training=False, rng=jax.random.key(0)
+            )
+            return out
+
+        self._forward = jax.jit(fwd)
+        return self._forward
+
+    # ------------------------------------------------------------------
+
+    def batch_sharding(self, ndim: int, batch_size: Optional[int] = None):
+        """Sharding for a host batch array; None when the batch dim is not
+        divisible by the data-axis degree (then it stays replicated, matching
+        compile()'s input-view rule)."""
+        from jax.sharding import NamedSharding
+
+        if self.mesh is None or "data" not in self.mesh.axis_names:
+            return None
+        degree = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))["data"]
+        if degree <= 1:
+            return None
+        if batch_size is not None and batch_size % degree != 0:
+            return None
+        return NamedSharding(self.mesh, spec_to_partition_spec(batch_spec(ndim)))

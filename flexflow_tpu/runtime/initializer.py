@@ -1,0 +1,98 @@
+"""Weight initializers.
+
+Reference analog: include/flexflow/initializer.h:122 + initializer_kernel.cu
+(Glorot/Zero/Constant/Uniform/Normal as Legion tasks). Here each initializer
+is a pure function of (PRNG key, shape, dtype); the executor calls them
+jit-compiled with output shardings so huge weights are initialized directly
+sharded on device (no host materialization).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    def __call__(self, key, shape: Tuple[int, ...], dtype):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class GlorotUniformInitializer(Initializer):
+    seed: int = 0
+
+    def __call__(self, key, shape, dtype):
+        if len(shape) < 2:
+            return jnp.zeros(shape, dtype)
+        fan_in, fan_out = _fans(shape)
+        limit = (6.0 / (fan_in + fan_out)) ** 0.5
+        return jax.random.uniform(key, shape, jnp.float32, -limit, limit).astype(dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroInitializer(Initializer):
+    def __call__(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConstantInitializer(Initializer):
+    value: float = 0.0
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class UniformInitializer(Initializer):
+    minv: float = -0.05
+    maxv: float = 0.05
+    seed: int = 0
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, jnp.float32, self.minv, self.maxv).astype(
+            dtype
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class NormInitializer(Initializer):
+    mean: float = 0.0
+    stddev: float = 0.02
+    seed: int = 0
+
+    def __call__(self, key, shape, dtype):
+        return (
+            self.mean + self.stddev * jax.random.normal(key, shape, jnp.float32)
+        ).astype(dtype)
+
+
+def _fans(shape) -> Tuple[int, int]:
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv OIHW: receptive field × channels
+    receptive = 1
+    for s in shape[2:]:
+        receptive *= s
+    return shape[1] * receptive, shape[0] * receptive
+
+
+_BY_NAME = {
+    "glorot_uniform": GlorotUniformInitializer(),
+    "zeros": ZeroInitializer(),
+    "ones": ConstantInitializer(1.0),
+    "normal": NormInitializer(),
+    "uniform": UniformInitializer(),
+}
+
+
+def resolve(init) -> Initializer:
+    if isinstance(init, Initializer):
+        return init
+    if init is None:
+        return _BY_NAME["glorot_uniform"]
+    return _BY_NAME[init]

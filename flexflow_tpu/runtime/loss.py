@@ -1,0 +1,42 @@
+"""Loss functions.
+
+Reference analog: src/loss_functions/ (Loss::backward computes dLoss/dLogit
+directly on shards with 1/batch scaling, loss_functions.cu:23-60). On TPU we
+compute the scalar loss and let jax.grad derive dLogit; the math matches the
+reference's gradients: sparse-CCE pairs with a final softmax op (the
+reference asserts this and fuses softmax-grad), MSE scales by 2/batch,
+IDENTITY passes label values through as the gradient.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import LossType
+
+
+def compute_loss(loss_type: LossType, logits, labels, last_op_is_softmax: bool = True):
+    """Scalar mean loss. `logits` is the final op output; for the CCE
+    variants it is expected to already be probabilities (the reference
+    requires the last op to be Softmax, model.cc:2875)."""
+    b = logits.shape[0]
+    lf = logits.astype(jnp.float32)
+    if loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+        labels = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
+        probs = lf if last_op_is_softmax else jax.nn.softmax(lf, axis=-1)
+        ll = jnp.take_along_axis(probs, labels[:, None], axis=-1)[:, 0]
+        return -jnp.mean(jnp.log(jnp.maximum(ll, 1e-30)))
+    if loss_type == LossType.CATEGORICAL_CROSSENTROPY:
+        probs = lf if last_op_is_softmax else jax.nn.softmax(lf, axis=-1)
+        return -jnp.mean(
+            jnp.sum(labels.astype(jnp.float32) * jnp.log(jnp.maximum(probs, 1e-30)), axis=-1)
+        )
+    if loss_type == LossType.MEAN_SQUARED_ERROR_AVG_REDUCE:
+        return jnp.mean(jnp.square(lf - labels.astype(jnp.float32)))
+    if loss_type == LossType.MEAN_SQUARED_ERROR_SUM_REDUCE:
+        return jnp.sum(jnp.square(lf - labels.astype(jnp.float32))) / b
+    if loss_type == LossType.IDENTITY:
+        # reference identity loss: gradient = label values (loss_functions.cu)
+        return jnp.mean(lf * labels.astype(jnp.float32))
+    raise ValueError(f"unknown loss {loss_type}")

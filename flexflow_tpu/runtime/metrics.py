@@ -1,0 +1,111 @@
+"""Training metrics.
+
+Reference analog: src/metrics_functions/ — `PerfMetrics` accumulated on
+device (metrics_functions.h:27-42, CUDA atomics kernels metrics_functions.cu)
+and merged through Legion future reductions. Here per-step metrics are
+computed inside the jitted step (device-side, no host sync) and accumulated
+into a host-side PerfMetrics between steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Sequence
+
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import LossType, MetricsType
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Host-side accumulator (reference PerfMetrics struct)."""
+
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+    start_time: float = dataclasses.field(default_factory=time.time)
+
+    def update(self, step_metrics: Dict[str, float], batch: int):
+        self.train_all += batch
+        if "accuracy_correct" in step_metrics:
+            self.train_correct += int(step_metrics["accuracy_correct"])
+        for k in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
+            if k in step_metrics:
+                setattr(self, k, getattr(self, k) + float(step_metrics[k]) * batch)
+
+    def report(self, measured: Sequence[MetricsType]) -> str:
+        out = [f"samples={self.train_all}"]
+        n = max(self.train_all, 1)
+        if MetricsType.ACCURACY in measured:
+            out.append(f"accuracy={100.0 * self.train_correct / n:.2f}%")
+        if MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY in measured:
+            out.append(f"sparse_cce={self.sparse_cce_loss / n:.4f}")
+        if MetricsType.CATEGORICAL_CROSSENTROPY in measured:
+            out.append(f"cce={self.cce_loss / n:.4f}")
+        if MetricsType.MEAN_SQUARED_ERROR in measured:
+            out.append(f"mse={self.mse_loss / n:.4f}")
+        if MetricsType.ROOT_MEAN_SQUARED_ERROR in measured:
+            out.append(f"rmse={self.rmse_loss / n:.4f}")
+        if MetricsType.MEAN_ABSOLUTE_ERROR in measured:
+            out.append(f"mae={self.mae_loss / n:.4f}")
+        elapsed = max(time.time() - self.start_time, 1e-9)
+        out.append(f"throughput={self.train_all / elapsed:.1f} samples/s")
+        return " ".join(out)
+
+
+def compute_step_metrics(
+    measured: Sequence[MetricsType],
+    loss_type: LossType,
+    logits,
+    labels,
+    last_op_is_softmax: bool = True,
+) -> Dict[str, jnp.ndarray]:
+    """Device-side per-batch metric values (means over the batch; the host
+    accumulator re-weights by batch size). Runs inside the jitted step."""
+    import jax
+
+    out: Dict[str, jnp.ndarray] = {}
+    lf = logits.astype(jnp.float32)
+    needs_probs = any(
+        m
+        in (
+            MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            MetricsType.CATEGORICAL_CROSSENTROPY,
+        )
+        for m in measured
+    )
+    if needs_probs and not last_op_is_softmax:
+        lf = jax.nn.softmax(lf, axis=-1)
+    sparse = loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY
+    if sparse:
+        lbl = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
+    for m in measured:
+        if m == MetricsType.ACCURACY:
+            pred = jnp.argmax(lf, axis=-1)
+            truth = lbl if sparse else jnp.argmax(labels, axis=-1)
+            out["accuracy_correct"] = jnp.sum(pred == truth)
+        elif m == MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            ll = jnp.take_along_axis(lf, lbl[:, None], axis=-1)[:, 0]
+            out["sparse_cce_loss"] = -jnp.mean(jnp.log(jnp.maximum(ll, 1e-30)))
+        elif m == MetricsType.CATEGORICAL_CROSSENTROPY:
+            out["cce_loss"] = -jnp.mean(
+                jnp.sum(
+                    labels.astype(jnp.float32) * jnp.log(jnp.maximum(lf, 1e-30)),
+                    axis=-1,
+                )
+            )
+        elif m == MetricsType.MEAN_SQUARED_ERROR:
+            out["mse_loss"] = jnp.mean(jnp.square(lf - labels.astype(jnp.float32)))
+        elif m == MetricsType.ROOT_MEAN_SQUARED_ERROR:
+            out["rmse_loss"] = jnp.sqrt(
+                jnp.mean(jnp.square(lf - labels.astype(jnp.float32)))
+            )
+        elif m == MetricsType.MEAN_ABSOLUTE_ERROR:
+            out["mae_loss"] = jnp.mean(jnp.abs(lf - labels.astype(jnp.float32)))
+    return out

@@ -1,0 +1,116 @@
+"""Optimizers: SGD (momentum/nesterov) and Adam(W).
+
+Reference analog: include/flexflow/optimizer.h:36,77 + optimizer_kernel.cu
+(sgd_update :25, Adam :186). The reference's two sync modes map as:
+  - NCCL mode (ncclAllReduce on grads, optimizer_kernel.cu:88) -> on TPU the
+    gradient psum over the data axis is emitted automatically by the SPMD
+    partitioner because params are replicated and batch is sharded; nothing
+    explicit is needed inside the update.
+  - Parameter-server mode -> obsolete on TPU; ParamSyncType.SHARDED instead
+    shards optimizer state over the data axis (ZeRO-1 style), which the
+    executor arranges via shardings, not optimizer math.
+
+Optimizers are pure: `init_state(params)` and
+`update(grads, params, state) -> (new_params, new_state)`, jitted as part of
+the train step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer:
+    def init_state(self, params):
+        raise NotImplementedError
+
+    def update(self, grads, params, state) -> Tuple[Any, Any]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class SGDOptimizer(Optimizer):
+    """SGD with momentum + weight decay (reference optimizer.h:36: lr,
+    momentum, nesterov, weight_decay)."""
+
+    lr: float = 0.01
+    momentum: float = 0.0
+    nesterov: bool = False
+    weight_decay: float = 0.0
+
+    def init_state(self, params):
+        if self.momentum == 0.0:
+            return {"step": jnp.zeros((), jnp.int32)}
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "v": jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params),
+        }
+
+    def update(self, grads, params, state):
+        def upd(g, p, v):
+            g = g.astype(jnp.float32) + self.weight_decay * p.astype(jnp.float32)
+            if v is None:
+                return (p.astype(jnp.float32) - self.lr * g).astype(p.dtype), None
+            v = self.momentum * v + g
+            step = v * self.momentum + g if self.nesterov else v
+            return (p.astype(jnp.float32) - self.lr * step).astype(p.dtype), v
+
+        if self.momentum == 0.0:
+            new_params = jax.tree.map(lambda g, p: upd(g, p, None)[0], grads, params)
+            return new_params, {"step": state["step"] + 1}
+        pairs = jax.tree.map(upd, grads, params, state["v"])
+        new_params = jax.tree.map(lambda t: t[0], pairs, is_leaf=lambda t: isinstance(t, tuple))
+        new_v = jax.tree.map(lambda t: t[1], pairs, is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, {"step": state["step"] + 1, "v": new_v}
+
+
+@dataclasses.dataclass(frozen=True)
+class AdamOptimizer(Optimizer):
+    """Adam with bias correction (reference optimizer.h:77: alpha, beta1,
+    beta2, weight_decay, epsilon; kernel optimizer_kernel.cu:186-200).
+    `adamw=True` decouples weight decay (TPU-native default for LLMs)."""
+
+    lr: float = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    weight_decay: float = 0.0
+    adamw: bool = True
+
+    def init_state(self, params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "m": jax.tree.map(zeros, params),
+            "v": jax.tree.map(zeros, params),
+        }
+
+    def update(self, grads, params, state):
+        step = state["step"] + 1
+        bc1 = 1.0 - self.beta1 ** step.astype(jnp.float32)
+        bc2 = 1.0 - self.beta2 ** step.astype(jnp.float32)
+
+        def upd(g, p, m, v):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if not self.adamw:
+                g = g + self.weight_decay * p32
+            m = self.beta1 * m + (1 - self.beta1) * g
+            v = self.beta2 * v + (1 - self.beta2) * g * g
+            mhat = m / bc1
+            vhat = v / bc2
+            new_p = p32 - self.lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+            if self.adamw and self.weight_decay:
+                new_p = new_p - self.lr * self.weight_decay * p32
+            return new_p.astype(p.dtype), m, v
+
+        triples = jax.tree.map(upd, grads, params, state["m"], state["v"])
+        is_triple = lambda t: isinstance(t, tuple)
+        new_params = jax.tree.map(lambda t: t[0], triples, is_leaf=is_triple)
+        new_m = jax.tree.map(lambda t: t[1], triples, is_leaf=is_triple)
+        new_v = jax.tree.map(lambda t: t[2], triples, is_leaf=is_triple)
+        return new_params, {"step": step, "m": new_m, "v": new_v}

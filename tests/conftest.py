@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device CPU platform so multi-chip sharding
+paths are exercised without TPU hardware (the reference's analog: multi-node
+emulation via MPI ranks on one box, tests/multinode_helpers/; SURVEY.md
+§4.5-4.6).
+
+Env vars alone are not enough here because site customization may import jax
+before pytest loads this file, so we use jax.config (effective until the
+first backend initialization)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,108 @@
+"""Pure-logic graph algorithm tests (reference tests/unit/test_dominators.cc)."""
+
+import pytest
+
+from flexflow_tpu.pcg import algorithms as alg
+from flexflow_tpu.pcg.graph import Graph
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.ops.attrs import NoOpAttrs
+
+
+def diamond():
+    """a -> b, a -> c, b -> d, c -> d"""
+    g = Graph()
+    a = g.create_node(OpType.NOOP, NoOpAttrs(), "a")
+    b = g.create_node(OpType.NOOP, NoOpAttrs(), "b")
+    c = g.create_node(OpType.NOOP, NoOpAttrs(), "c")
+    d = g.create_node(OpType.NOOP, NoOpAttrs(), "d")
+    g.add_edge(a, b)
+    g.add_edge(a, c)
+    g.add_edge(b, d)
+    g.add_edge(c, d)
+    return g, (a, b, c, d)
+
+
+def test_topo_sort_diamond():
+    g, (a, b, c, d) = diamond()
+    order = g.topo_order()
+    pos = {n.name: i for i, n in enumerate(order)}
+    assert pos["a"] < pos["b"] < pos["d"]
+    assert pos["a"] < pos["c"] < pos["d"]
+
+
+def test_topo_sort_cycle_raises():
+    g = Graph()
+    a = g.create_node(OpType.NOOP, NoOpAttrs(), "a")
+    b = g.create_node(OpType.NOOP, NoOpAttrs(), "b")
+    g.add_edge(a, b)
+    g.add_edge(b, a)
+    with pytest.raises(ValueError):
+        g.topo_order()
+
+
+def test_dominators_diamond():
+    g, (a, b, c, d) = diamond()
+    dom = g.dominators()
+    assert dom[d] == {a, d}
+    assert dom[b] == {a, b}
+    assert dom[a] == {a}
+
+
+def test_post_dominators_diamond():
+    g, (a, b, c, d) = diamond()
+    pdom = g.post_dominators()
+    assert pdom[a] == {a, d}
+    assert pdom[b] == {b, d}
+
+
+def test_imm_dominators_chain_and_diamond():
+    g, (a, b, c, d) = diamond()
+    idom = alg.imm_dominators(g.nodes, g.succs, g.preds)
+    assert idom[d] == a
+    assert idom[b] == a
+    assert idom[a] == a
+
+
+def test_bottleneck_node():
+    # a -> b -> c ; b is the bottleneck
+    g = Graph()
+    a = g.create_node(OpType.NOOP, NoOpAttrs(), "a")
+    b = g.create_node(OpType.NOOP, NoOpAttrs(), "b")
+    c = g.create_node(OpType.NOOP, NoOpAttrs(), "c")
+    g.add_edge(a, b)
+    g.add_edge(b, c)
+    assert g.find_bottleneck_node() == b
+
+    g2, (a2, b2, c2, d2) = diamond()
+    assert g2.find_bottleneck_node() is None
+
+
+def test_transitive_reduction():
+    g = Graph()
+    a = g.create_node(OpType.NOOP, NoOpAttrs(), "a")
+    b = g.create_node(OpType.NOOP, NoOpAttrs(), "b")
+    c = g.create_node(OpType.NOOP, NoOpAttrs(), "c")
+    g.add_edge(a, b)
+    g.add_edge(b, c)
+    g.add_edge(a, c)  # redundant
+    r = g.reduced()
+    assert len(r.out_edges(a)) == 1
+    assert r.succs(a) == [b]
+
+
+def test_split_at_node():
+    g = Graph()
+    a = g.create_node(OpType.NOOP, NoOpAttrs(), "a")
+    b = g.create_node(OpType.NOOP, NoOpAttrs(), "b")
+    c = g.create_node(OpType.NOOP, NoOpAttrs(), "c")
+    g.add_edge(a, b)
+    g.add_edge(b, c)
+    first, second = g.split_at_node(b)
+    assert {n.name for n in first.nodes} == {"a", "b"}
+    assert {n.name for n in second.nodes} == {"b", "c"}
+
+
+def test_structure_hash_guid_independent():
+    g1, _ = diamond()
+    g2, _ = diamond()
+    assert g1.structure_hash() == g2.structure_hash()

@@ -1,0 +1,230 @@
+"""Per-op numerics vs torch CPU references (reference tests/align/ +
+tests/ops/: each op run in the framework and in torch, outputs diffed)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ffconst import ActiMode, AggrMode, DataType, OpType, PoolType
+from flexflow_tpu.ops import attrs as A
+from flexflow_tpu.ops.registry import LowerCtx, get_lowering
+from flexflow_tpu.pcg.tensor import ParallelTensorShape, TensorShape
+
+
+def run_op(op_type, attrs, inputs, params=None, training=False):
+    ctx = LowerCtx(training=training, rng=jax.random.key(0), mesh=None)
+    outs = get_lowering(op_type)(
+        attrs, [jnp.asarray(x) for x in inputs],
+        {k: jnp.asarray(v) for k, v in (params or {}).items()}, ctx,
+    )
+    return [np.asarray(o) for o in outs], ctx
+
+
+def rand(*shape):
+    return np.random.RandomState(0).randn(*shape).astype(np.float32)
+
+
+def test_linear_vs_torch():
+    x, w, b = rand(4, 8), rand(8, 16), rand(16)
+    (y,), _ = run_op(
+        OpType.LINEAR, A.LinearAttrs(16, True, ActiMode.RELU), [x],
+        {"kernel": w, "bias": b},
+    )
+    ref = F.relu(torch.from_numpy(x) @ torch.from_numpy(w) + torch.from_numpy(b))
+    np.testing.assert_allclose(y, ref.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_vs_torch():
+    x, w, b = rand(2, 3, 8, 8), rand(5, 3, 3, 3), rand(5)
+    (y,), _ = run_op(
+        OpType.CONV2D,
+        A.Conv2DAttrs(5, (3, 3), (1, 1), (1, 1)),
+        [x], {"kernel": w, "bias": b},
+    )
+    ref = F.conv2d(torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+                   padding=1)
+    np.testing.assert_allclose(y, ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_pool2d_max_vs_torch():
+    x = rand(2, 3, 8, 8)
+    (y,), _ = run_op(
+        OpType.POOL2D, A.Pool2DAttrs((2, 2), (2, 2), (0, 0), PoolType.MAX), [x]
+    )
+    ref = F.max_pool2d(torch.from_numpy(x), 2)
+    np.testing.assert_allclose(y, ref.numpy(), rtol=1e-6)
+
+
+def test_pool2d_avg_vs_torch():
+    x = rand(2, 3, 8, 8)
+    (y,), _ = run_op(
+        OpType.POOL2D, A.Pool2DAttrs((2, 2), (2, 2), (0, 0), PoolType.AVG), [x]
+    )
+    ref = F.avg_pool2d(torch.from_numpy(x), 2)
+    np.testing.assert_allclose(y, ref.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_layer_norm_vs_torch():
+    x, s, b = rand(4, 10), rand(10), rand(10)
+    (y,), _ = run_op(
+        OpType.LAYER_NORM, A.LayerNormAttrs((-1,)), [x], {"scale": s, "bias": b}
+    )
+    ref = F.layer_norm(torch.from_numpy(x), (10,), torch.from_numpy(s),
+                       torch.from_numpy(b))
+    np.testing.assert_allclose(y, ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_rms_norm_vs_torch():
+    x, s = rand(4, 10), rand(10)
+    (y,), _ = run_op(OpType.RMS_NORM, A.RMSNormAttrs(1e-6), [x], {"scale": s})
+    xt = torch.from_numpy(x)
+    ref = xt * torch.rsqrt(xt.pow(2).mean(-1, keepdim=True) + 1e-6) * torch.from_numpy(s)
+    np.testing.assert_allclose(y, ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_train_vs_torch():
+    x = rand(4, 3, 5, 5)
+    scale, bias = np.ones(3, np.float32), np.zeros(3, np.float32)
+    rm, rv = np.zeros(3, np.float32), np.ones(3, np.float32)
+    (y,), ctx = run_op(
+        OpType.BATCH_NORM, A.BatchNormAttrs(relu=False), [x],
+        {"scale": scale, "bias": bias, "running_mean": rm, "running_var": rv},
+        training=True,
+    )
+    bn = torch.nn.BatchNorm1d  # placeholder; use functional below
+    ref = F.batch_norm(torch.from_numpy(x), None, None,
+                       torch.from_numpy(scale), torch.from_numpy(bias),
+                       training=True)
+    np.testing.assert_allclose(y, ref.numpy(), rtol=1e-3, atol=1e-4)
+    assert "running_mean" in ctx.state_updates
+
+
+def test_softmax_embedding_gather_topk():
+    x = rand(3, 7)
+    (y,), _ = run_op(OpType.SOFTMAX, A.SoftmaxAttrs(-1), [x])
+    np.testing.assert_allclose(
+        y, F.softmax(torch.from_numpy(x), -1).numpy(), rtol=1e-5, atol=1e-6
+    )
+
+    ids = np.array([[1, 2], [0, 3]], np.int32)
+    table = rand(10, 4)
+    (e,), _ = run_op(
+        OpType.EMBEDDING, A.EmbeddingAttrs(10, 4, AggrMode.SUM), [ids],
+        {"kernel": table},
+    )
+    np.testing.assert_allclose(e, table[ids].sum(1), rtol=1e-6)
+
+    src = rand(3, 5)
+    idx = np.array([[0, 1], [2, 0], [4, 4]], np.int64)
+    (gth,), _ = run_op(OpType.GATHER, A.GatherAttrs(1), [src, idx])
+    ref = torch.gather(torch.from_numpy(src), 1, torch.from_numpy(idx))
+    np.testing.assert_allclose(gth, ref.numpy(), rtol=1e-6)
+
+    (vals, inds), _ = run_op(OpType.TOPK, A.TopKAttrs(3), [x])
+    tv, ti = torch.topk(torch.from_numpy(x), 3)
+    np.testing.assert_allclose(vals, tv.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(inds, ti.numpy())
+
+
+def test_attention_vs_torch():
+    np.random.seed(1)
+    B, S, E, H = 2, 6, 16, 4
+    x = np.random.randn(B, S, E).astype(np.float32)
+    attrs = A.MultiHeadAttentionAttrs(E, H, use_bias=False)
+    hd = E // H
+    wq = np.random.randn(E, H, hd).astype(np.float32) * 0.1
+    wk = np.random.randn(E, H, hd).astype(np.float32) * 0.1
+    wv = np.random.randn(E, H, hd).astype(np.float32) * 0.1
+    wo = np.random.randn(H, hd, E).astype(np.float32) * 0.1
+    (y,), _ = run_op(
+        OpType.MULTIHEAD_ATTENTION, attrs, [x, x, x],
+        {"wq": wq, "wk": wk, "wv": wv, "wo": wo},
+    )
+    # torch reference with the same packed weights
+    xt = torch.from_numpy(x)
+    q = torch.einsum("bse,ehd->bshd", xt, torch.from_numpy(wq))
+    k = torch.einsum("bse,ehd->bshd", xt, torch.from_numpy(wk))
+    v = torch.einsum("bse,ehd->bshd", xt, torch.from_numpy(wv))
+    logits = torch.einsum("bshd,bthd->bhst", q, k) / hd**0.5
+    probs = torch.softmax(logits, -1)
+    o = torch.einsum("bhst,bthd->bshd", probs, v)
+    ref = torch.einsum("bshd,hde->bse", o, torch.from_numpy(wo))
+    np.testing.assert_allclose(y, ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_shape_ops():
+    x = rand(2, 3, 4)
+    (y,), _ = run_op(OpType.RESHAPE, A.ReshapeAttrs((6, 4)), [x])
+    assert y.shape == (6, 4)
+    (y,), _ = run_op(OpType.FLAT, A.FlatAttrs(), [x])
+    assert y.shape == (2, 12)
+    (y,), _ = run_op(OpType.TRANSPOSE, A.TransposeAttrs((0, 2, 1)), [x])
+    np.testing.assert_allclose(y, x.transpose(0, 2, 1))
+    (y,), _ = run_op(OpType.REVERSE, A.ReverseAttrs(1), [x])
+    np.testing.assert_allclose(y, x[:, ::-1])
+    outs, _ = run_op(OpType.SPLIT, A.SplitAttrs((1, 2), 1), [x])
+    assert outs[0].shape == (2, 1, 4) and outs[1].shape == (2, 2, 4)
+    (y,), _ = run_op(OpType.CONCAT, A.ConcatAttrs(1), [x, x])
+    assert y.shape == (2, 6, 4)
+    (y,), _ = run_op(OpType.CAST, A.CastAttrs(DataType.BFLOAT16), [x])
+    assert y.dtype == jnp.bfloat16
+
+
+def test_moe_group_by_aggregate_roundtrip():
+    """group_by + aggregate with k=1 and ample capacity reconstructs each
+    token's expert output weighted by its gate prob."""
+    np.random.seed(0)
+    b, d, n = 8, 4, 4
+    x = np.random.randn(b, d).astype(np.float32)
+    assign = np.random.randint(0, n, (b, 1)).astype(np.int32)
+    gates = np.ones((b, 1), np.float32)
+    gb_attrs = A.GroupByAttrs(n, alpha=float(n))  # capacity = b
+    outs, _ = run_op(OpType.GROUP_BY, gb_attrs, [x, assign])
+    assert len(outs) == n
+    # identity experts: aggregate should reproduce x
+    agg_inputs = [gates, assign, assign, np.zeros((b, n), np.float32)] + outs
+    (y,), _ = run_op(OpType.AGGREGATE, A.AggregateAttrs(n), agg_inputs)
+    np.testing.assert_allclose(y, x, rtol=1e-5, atol=1e-6)
+
+
+def test_experts_fused_moe_runs():
+    np.random.seed(0)
+    t, d, n, k, h = 16, 8, 4, 2, 32
+    x = np.random.randn(t, d).astype(np.float32)
+    gate = np.random.randn(t, n).astype(np.float32)
+    attrs = A.ExpertsAttrs(n, k, h, d, alpha=2.0)
+    w1 = np.random.randn(n, d, h).astype(np.float32) * 0.1
+    w2 = np.random.randn(n, h, d).astype(np.float32) * 0.1
+    (y,), ctx = run_op(OpType.EXPERTS, attrs, [x, gate], {"w1": w1, "w2": w2},
+                       training=True)
+    assert y.shape == (t, d)
+    assert np.isfinite(y).all()
+    assert "__aux_loss__" in ctx.state_updates
+
+
+def test_aggregate_spec_shapes():
+    np.random.seed(0)
+    b, d, n, k = 8, 4, 4, 2
+    x = np.random.randn(b, d).astype(np.float32)
+    assign = np.random.randint(0, n, (b, k)).astype(np.int32)
+    gates = np.full((b, k), 0.5, np.float32)
+    outs, _ = run_op(OpType.GROUP_BY, A.GroupByAttrs(n, alpha=float(n)), [x, assign])
+    agg_inputs = [gates, assign, assign, np.zeros((b, n), np.float32)] + outs
+    (y,), _ = run_op(OpType.AGGREGATE_SPEC, A.AggregateSpecAttrs(n), agg_inputs)
+    assert y.shape == (b * k, d)
+    assert np.isfinite(y).all()
+
+
+def test_predict_partial_batch():
+    from flexflow_tpu import FFModel, FFConfig, DataType, LossType
+
+    ff = FFModel(FFConfig(batch_size=8))
+    t = ff.create_tensor((8, 4), DataType.FLOAT)
+    out = ff.softmax(ff.dense(t, 3))
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    preds = ff.predict(rand(13, 4))  # 13 rows: not a multiple of 8
+    assert preds.shape == (13, 3)
