@@ -42,6 +42,7 @@ class ActiMode(enum.Enum):
     SIGMOID = "sigmoid"
     TANH = "tanh"
     GELU = "gelu"
+    SILU = "silu"
 
 
 class AggrMode(enum.Enum):

@@ -171,13 +171,14 @@ class FFModel:
                             embed_dim: int, num_heads: int, kdim: int = 0,
                             vdim: int = 0, dropout: float = 0.0, bias: bool = True,
                             causal: bool = False, kv_heads: Optional[int] = None,
+                            rope: bool = False, rope_theta: float = 10000.0,
                             kernel_initializer=None,
                             name: Optional[str] = None) -> Tensor:
         node = self._add(
             OpType.MULTIHEAD_ATTENTION,
             A.MultiHeadAttentionAttrs(
                 embed_dim, num_heads, kv_heads, kdim // num_heads if kdim else None,
-                causal, bias, dropout,
+                causal, bias, dropout, rope, rope_theta,
             ),
             [query, key, value], name or "attention",
         )
@@ -187,13 +188,18 @@ class FFModel:
 
     def ring_attention(self, query: Tensor, key: Tensor, value: Tensor,
                        embed_dim: int, num_heads: int, causal: bool = True,
-                       kv_heads: Optional[int] = None,
+                       kv_heads: Optional[int] = None, rope: bool = False,
+                       rope_theta: float = 10000.0,
                        name: Optional[str] = None) -> Tensor:
         return self._one(
             OpType.RING_ATTENTION,
-            A.RingAttentionAttrs(embed_dim, num_heads, kv_heads, None, causal, False),
+            A.RingAttentionAttrs(embed_dim, num_heads, kv_heads, None, causal,
+                                 False, 0.0, rope, rope_theta),
             [query, key, value], name or "ring_attention",
         )
+
+    def silu(self, x, name=None):
+        return self._unary("silu", x, name)
 
     def batch_matmul(self, a: Tensor, b: Tensor, a_seq_length_dim: int = -1,
                      b_seq_length_dim: int = -1, name: Optional[str] = None) -> Tensor:

@@ -1,0 +1,106 @@
+"""Llama-family decoder builder — the flagship model (BASELINE config 4:
+Llama-3-8B hybrid TP+DP).
+
+Built through the FFModel layer API: RMSNorm, GQA attention with RoPE,
+SwiGLU MLP. `llama_tp_strategy` returns the Megatron-style hybrid TP+DP
+sharding (the strategy the Unity-style search should discover); with
+`use_ring_attention=True` the attention ops become sequence-parallel ring
+attention (net-new vs the reference, SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+from flexflow_tpu.ffconst import ActiMode, DataType
+from flexflow_tpu.model import FFModel, Tensor
+from flexflow_tpu.parallel.sharding import ShardingView
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 8
+    hidden: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab: int = 512) -> "LlamaConfig":
+        """Test-sized config (multi-chip dryruns, CPU tests)."""
+        return LlamaConfig(vocab_size=vocab, dim=64, layers=2, heads=4,
+                           kv_heads=2, hidden=128, rope_theta=10000.0)
+
+    @staticmethod
+    def bench_1b() -> "LlamaConfig":
+        """~1.2B-param config that fits one v5e chip with Adam state."""
+        return LlamaConfig(vocab_size=32000, dim=2048, layers=16, heads=16,
+                           kv_heads=8, hidden=5632)
+
+
+def build_llama(ff: FFModel, cfg: LlamaConfig, batch_size: int = None,
+                seq_len: int = 2048, dtype: DataType = DataType.BFLOAT16,
+                use_ring_attention: bool = False) -> Tensor:
+    b = batch_size or ff.config.batch_size
+    ids = ff.create_tensor((b, seq_len), DataType.INT32, name="input_ids")
+    h = ff.embedding(ids, cfg.vocab_size, cfg.dim, dtype=dtype, name="tok_emb")
+    for i in range(cfg.layers):
+        a = ff.rms_norm(h, eps=cfg.norm_eps, name=f"l{i}_attn_norm")
+        attn_fn = ff.ring_attention if use_ring_attention else (
+            lambda q, k, v, e, nh, **kw: ff.multihead_attention(
+                q, k, v, e, nh, bias=False, **kw
+            )
+        )
+        a = attn_fn(a, a, a, cfg.dim, cfg.heads, causal=True,
+                    kv_heads=cfg.kv_heads, rope=True, rope_theta=cfg.rope_theta,
+                    name=f"l{i}_attn")
+        h = ff.add(h, a, name=f"l{i}_res1")
+        m = ff.rms_norm(h, eps=cfg.norm_eps, name=f"l{i}_mlp_norm")
+        g = ff.dense(m, cfg.hidden, use_bias=False, name=f"l{i}_gate")
+        u = ff.dense(m, cfg.hidden, use_bias=False, name=f"l{i}_up")
+        x = ff.multiply(ff.silu(g, name=f"l{i}_silu"), u, name=f"l{i}_gxu")
+        d = ff.dense(x, cfg.dim, use_bias=False, name=f"l{i}_down")
+        h = ff.add(h, d, name=f"l{i}_res2")
+    h = ff.rms_norm(h, eps=cfg.norm_eps, name="final_norm")
+    logits = ff.dense(h, cfg.vocab_size, use_bias=False, name="lm_head")
+    return ff.softmax(logits, name="softmax")
+
+
+def llama_tp_strategy(cfg: LlamaConfig, seq_parallel: bool = False) -> Dict[str, ShardingView]:
+    """Hybrid TP(+SP)+DP views: attention heads and MLP column/row split over
+    `model`; activations batch-sharded over `data` (and sequence over `seq`
+    when seq_parallel). The lm_head shards the vocab dim."""
+    act3 = (("data",), ("seq",) if seq_parallel else (), ())
+    views: Dict[str, ShardingView] = {}
+    for i in range(cfg.layers):
+        views[f"l{i}_attn"] = ShardingView(
+            output_specs=(act3,),
+            weight_specs={
+                "wq": ((), ("model",), ()),
+                "wk": ((), ("model",), ()),
+                "wv": ((), ("model",), ()),
+                "wo": (("model",), (), ()),
+            },
+        )
+        views[f"l{i}_gate"] = ShardingView(
+            weight_specs={"kernel": ((), ("model",))}
+        )
+        views[f"l{i}_up"] = ShardingView(
+            weight_specs={"kernel": ((), ("model",))}
+        )
+        views[f"l{i}_down"] = ShardingView(
+            output_specs=(act3,), weight_specs={"kernel": (("model",), ())}
+        )
+    views["lm_head"] = ShardingView(weight_specs={"kernel": ((), ("model",))})
+    views["tok_emb"] = ShardingView(
+        output_specs=(act3,), weight_specs={"kernel": ((), ("model",))}
+    )
+    return views

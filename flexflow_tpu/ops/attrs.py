@@ -224,6 +224,9 @@ class MultiHeadAttentionAttrs(OpAttrs):
     causal: bool = False
     use_bias: bool = False
     dropout: float = 0.0
+    # rotary position embeddings (TPU-native addition for the Llama family)
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     @property
     def kdim(self) -> int:

@@ -32,6 +32,8 @@ def apply_activation(x, act: ActiMode):
         return jnp.tanh(x)
     if act == ActiMode.GELU:
         return jax.nn.gelu(x)
+    if act == ActiMode.SILU:
+        return jax.nn.silu(x)
     raise ValueError(f"unknown activation {act}")
 
 
@@ -114,6 +116,24 @@ def _batch_matmul(attrs, inputs, params, ctx):
 # attention
 
 
+def apply_rope(x, theta: float, pos_offset=0):
+    """Rotary position embedding, half-split (rotate_half) convention.
+    x: (B, S, H, D)."""
+    B, S, H, D = x.shape
+    if D % 2 != 0:
+        raise ValueError(f"RoPE requires an even head dim, got {D}")
+    d2 = D // 2
+    freqs = theta ** (-jnp.arange(0, d2, dtype=jnp.float32) / d2)
+    pos = jnp.arange(S, dtype=jnp.float32) + pos_offset
+    ang = pos[:, None] * freqs[None, :]  # (S, d2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :d2], xf[..., d2:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
 def _dot_product_attention(q, k, v, causal: bool, scale: float,
                            dropout_rate: float = 0.0, dropout_rng=None):
     """q: (B,S,H,D), k/v: (B,T,Hkv,D) -> (B,S,H,D). fp32 softmax accumulate."""
@@ -150,6 +170,9 @@ def _mha(attrs, inputs, params, ctx):
         q = q + params["bq"].astype(dt)
         k = k + params["bk"].astype(dt)
         v = v + params["bv"].astype(dt)
+    if attrs.rope:
+        q = apply_rope(q, attrs.rope_theta)
+        k = apply_rope(k, attrs.rope_theta)
     drop_rng = ctx.rng if (ctx.training and attrs.dropout > 0.0) else None
     out = _dot_product_attention(
         q, k, v, attrs.causal, 1.0 / (hd**0.5),
@@ -204,6 +227,7 @@ def _element_unary(attrs, inputs, params, ctx):
         "tanh": jnp.tanh,
         "elu": jax.nn.elu,
         "rsqrt": lax.rsqrt,
+        "silu": jax.nn.silu,
         "identity": lambda v: v,
         "pow": lambda v: jnp.power(v, s),
         "scalar_add": lambda v: v + s,

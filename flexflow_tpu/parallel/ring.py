@@ -35,7 +35,8 @@ def _mesh_axis_size(mesh, name: str) -> int:
     return dict(zip(mesh.axis_names, mesh.devices.shape))[name]
 
 
-def ring_attention_core(q, k, v, *, axis_name: str, n_shards: int, causal: bool, scale: float):
+def ring_attention_core(q, k, v, *, axis_name: str, n_shards: int, causal: bool,
+                        scale: float, vary_axes=()):
     """Per-shard body (inside shard_map). q,k,v: (B, s_loc, H, D) local
     blocks; device i initially holds sequence block i."""
     B, s_loc, H, D = q.shape
@@ -46,6 +47,17 @@ def ring_attention_core(q, k, v, *, axis_name: str, n_shards: int, causal: bool,
     m0 = jnp.full((B, H, s_loc), NEG, jnp.float32)
     l0 = jnp.zeros((B, H, s_loc), jnp.float32)
     acc0 = jnp.zeros((B, s_loc, H, D), jnp.float32)
+    if vary_axes:
+        # fori_loop carries must have the same varying-manual-axes type as
+        # the body outputs (see jax shard_map vma docs)
+        def _vary(t):
+            if hasattr(lax, "pcast"):
+                return lax.pcast(t, tuple(vary_axes), to="varying")
+            if hasattr(lax, "pvary"):
+                return lax.pvary(t, tuple(vary_axes))
+            return t
+
+        m0, l0, acc0 = (_vary(t) for t in (m0, l0, acc0))
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 
     def body(i, carry):
@@ -93,10 +105,12 @@ def ring_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
     ba = batch_axis if _mesh_axis_size(mesh, batch_axis) > 1 else None
     ha = head_axis if _mesh_axis_size(mesh, head_axis) > 1 else None
     spec = P(ba, seq_axis, ha, None)
+    vary_axes = tuple(a for a in (ba, seq_axis, ha) if a is not None)
 
     def fn(ql, kl, vl):
         return ring_attention_core(
-            ql, kl, vl, axis_name=seq_axis, n_shards=n, causal=causal, scale=scale
+            ql, kl, vl, axis_name=seq_axis, n_shards=n, causal=causal,
+            scale=scale, vary_axes=vary_axes,
         )
 
     return _shard_map(fn, mesh, (spec, spec, spec), spec)(q, k, v)
@@ -113,6 +127,13 @@ def ring_attention_lowering(attrs, inputs, params, ctx):
     q = jnp.einsum("bse,ehd->bshd", q_in, params["wq"].astype(dt))
     k = jnp.einsum("bse,ehd->bshd", k_in, params["wk"].astype(dt))
     v = jnp.einsum("bse,ehd->bshd", v_in, params["wv"].astype(dt))
+    if attrs.rope:
+        # applied at the global (logical) level, before the seq-sharded ring
+        # core — positions are global so each shard sees correct angles
+        from flexflow_tpu.ops.jax_ops import apply_rope
+
+        q = apply_rope(q, attrs.rope_theta)
+        k = apply_rope(k, attrs.rope_theta)
     if attrs.num_kv != attrs.num_heads:
         rep = attrs.num_heads // attrs.num_kv
         k = jnp.repeat(k, rep, axis=2)

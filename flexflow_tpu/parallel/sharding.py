@@ -82,6 +82,28 @@ class ShardingView:
         return f"View[{outs}{('|' + ws) if ws else ''}]"
 
 
+def prune_spec(spec: Optional[Spec], shape: Tuple[int, ...], mesh) -> Optional[Spec]:
+    """Drop per-dim axis assignments whose degree does not divide the dim
+    size (the reference's machine-view validity rule): a kv-head dim of 2
+    cannot shard over a 4-way model axis, so it stays replicated."""
+    if spec is None or mesh is None:
+        return spec
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for i, axes in enumerate(spec):
+        if i >= len(shape) or not axes:
+            out.append(())
+            continue
+        # axes absent from this mesh are dropped (a strategy written for a
+        # larger mesh degrades gracefully on a smaller one)
+        axes = tuple(a for a in axes if a in sizes)
+        degree = 1
+        for a in axes:
+            degree *= sizes[a]
+        out.append(axes if axes and shape[i] % degree == 0 else ())
+    return tuple(out)
+
+
 def used_axes(view: ShardingView) -> Tuple[str, ...]:
     axes = []
     for spec in list(view.output_specs) + list(view.weight_specs.values()):

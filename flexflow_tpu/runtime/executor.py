@@ -33,6 +33,7 @@ from flexflow_tpu.ops.registry import LowerCtx, get_lowering
 from flexflow_tpu.parallel.sharding import (
     ShardingView,
     batch_spec,
+    prune_spec,
     spec_to_partition_spec,
 )
 from flexflow_tpu.pcg.graph import Graph, Node
@@ -111,7 +112,10 @@ class Executor:
             for name, spec_decl in ws.items():
                 pspec = PartitionSpec()
                 if view is not None and name in view.weight_specs:
-                    pspec = spec_to_partition_spec(view.weight_specs[name])
+                    spec = prune_spec(
+                        view.weight_specs[name], spec_decl.shape.dims, self.mesh
+                    )
+                    pspec = spec_to_partition_spec(spec)
                 sh = NamedSharding(self.mesh, pspec)
                 (tr if spec_decl.trainable else ntr).setdefault(key, {})[name] = sh
         return tr, ntr
@@ -166,7 +170,7 @@ class Executor:
             if spec is None:
                 out.append(v)
             else:
-                ps = spec_to_partition_spec(spec)
+                ps = spec_to_partition_spec(prune_spec(spec, v.shape, self.mesh))
                 out.append(jax.lax.with_sharding_constraint(v, NamedSharding(self.mesh, ps)))
         return out
 

@@ -23,7 +23,12 @@ def compute_loss(loss_type: LossType, logits, labels, last_op_is_softmax: bool =
     b = logits.shape[0]
     lf = logits.astype(jnp.float32)
     if loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
-        labels = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
+        if lf.ndim > 2:
+            # per-token LM loss: (b, ..., V) logits with (b, ...) labels
+            lf = lf.reshape(-1, lf.shape[-1])
+            labels = labels.reshape(-1).astype(jnp.int32)
+        else:
+            labels = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
         probs = lf if last_op_is_softmax else jax.nn.softmax(lf, axis=-1)
         ll = jnp.take_along_axis(probs, labels[:, None], axis=-1)[:, 0]
         return -jnp.mean(jnp.log(jnp.maximum(ll, 1e-30)))

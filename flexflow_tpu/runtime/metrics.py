@@ -83,13 +83,21 @@ def compute_step_metrics(
     if needs_probs and not last_op_is_softmax:
         lf = jax.nn.softmax(lf, axis=-1)
     sparse = loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY
+    batch = labels.shape[0]
     if sparse:
-        lbl = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
+        if lf.ndim > 2:  # per-token LM metrics
+            lf = lf.reshape(-1, lf.shape[-1])
+            lbl = labels.reshape(-1).astype(jnp.int32)
+        else:
+            lbl = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
     for m in measured:
         if m == MetricsType.ACCURACY:
             pred = jnp.argmax(lf, axis=-1)
             truth = lbl if sparse else jnp.argmax(labels, axis=-1)
-            out["accuracy_correct"] = jnp.sum(pred == truth)
+            # normalized to SAMPLE counts: per-token accuracy is averaged over
+            # the tokens of each sample so the host accumulator (which counts
+            # samples) stays consistent
+            out["accuracy_correct"] = jnp.mean(pred == truth) * batch
         elif m == MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
             ll = jnp.take_along_axis(lf, lbl[:, None], axis=-1)[:, 0]
             out["sparse_cce_loss"] = -jnp.mean(jnp.log(jnp.maximum(ll, 1e-30)))

@@ -1,0 +1,193 @@
+"""Model-ladder tests: every BASELINE config builds and trains on the
+8-device CPU mesh; TP/EP strategies match DP numerics."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    AdamOptimizer,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models.alexnet import build_alexnet_cifar10
+from flexflow_tpu.models.bert import BertConfig, bert_attribute_parallel_strategy, build_bert
+from flexflow_tpu.models.dlrm import build_dlrm
+from flexflow_tpu.models.llama import LlamaConfig, build_llama, llama_tp_strategy
+from flexflow_tpu.models.mixtral import (
+    MixtralConfig,
+    build_mixtral,
+    build_moe_classifier,
+    mixtral_ep_strategy,
+)
+from flexflow_tpu.models.resnet import build_resnet50
+
+
+def lm_data(vocab, b, s, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randint(0, vocab, (b, s)).astype(np.int32)
+    y = np.roll(x, -1, axis=1)
+    return x, y
+
+
+def test_llama_tiny_trains_dp():
+    cfg = FFConfig(batch_size=8)
+    ff = FFModel(cfg)
+    lcfg = LlamaConfig.tiny()
+    build_llama(ff, lcfg, seq_len=32)
+    ff.compile(
+        optimizer=AdamOptimizer(lr=1e-3),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    x, y = lm_data(lcfg.vocab_size, 64, 32)
+    m1 = ff.fit(x, y, epochs=1, verbose=False)
+    l1 = m1.sparse_cce_loss / m1.train_all
+    m2 = ff.fit(x, y, epochs=2, verbose=False)
+    l2 = m2.sparse_cce_loss / m2.train_all
+    assert np.isfinite(l1) and np.isfinite(l2)
+    assert l2 < l1  # learning
+
+
+def test_llama_tp_matches_dp_forward():
+    """The TP-sharded model must compute the same function as DP (same seed
+    -> same weights -> same logits), validating that the Megatron views are
+    resharding-only."""
+    lcfg = LlamaConfig.tiny()
+    x, _ = lm_data(lcfg.vocab_size, 8, 32)
+
+    ff_dp = FFModel(FFConfig(batch_size=8, seed=7))
+    build_llama(ff_dp, lcfg, seq_len=32, dtype=DataType.FLOAT)
+    ff_dp.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    out_dp = ff_dp.predict(x)
+
+    ff_tp = FFModel(
+        FFConfig(batch_size=8, seed=7, mesh_shape={"data": 2, "model": 4})
+    )
+    build_llama(ff_tp, lcfg, seq_len=32, dtype=DataType.FLOAT)
+    ff_tp.compile(
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=llama_tp_strategy(lcfg),
+    )
+    out_tp = ff_tp.predict(x)
+    np.testing.assert_allclose(out_dp, out_tp, rtol=2e-3, atol=2e-5)
+
+
+def test_llama_ring_attention_matches_full():
+    """Ring attention over a seq-sharded mesh == full attention numerics."""
+    lcfg = LlamaConfig.tiny()
+    x, _ = lm_data(lcfg.vocab_size, 4, 64)
+
+    ff_full = FFModel(FFConfig(batch_size=4, seed=3))
+    build_llama(ff_full, lcfg, seq_len=64, dtype=DataType.FLOAT)
+    ff_full.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    out_full = ff_full.predict(x)
+
+    ff_ring = FFModel(
+        FFConfig(batch_size=4, seed=3, mesh_shape={"data": 2, "seq": 4})
+    )
+    build_llama(ff_ring, lcfg, seq_len=64, dtype=DataType.FLOAT,
+                use_ring_attention=True)
+    ff_ring.compile(
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=llama_tp_strategy(lcfg, seq_parallel=True),
+    )
+    out_ring = ff_ring.predict(x)
+    np.testing.assert_allclose(out_full, out_ring, rtol=2e-3, atol=2e-5)
+
+
+def test_mixtral_tiny_trains_ep():
+    mcfg = MixtralConfig.tiny()
+    ff = FFModel(FFConfig(batch_size=4, mesh_shape={"data": 2, "expert": 4}))
+    build_mixtral(ff, mcfg, seq_len=16, dtype=DataType.FLOAT)
+    ff.compile(
+        optimizer=AdamOptimizer(lr=1e-3),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=mixtral_ep_strategy(mcfg),
+    )
+    x, y = lm_data(mcfg.vocab_size, 16, 16)
+    m = ff.fit(x, y, epochs=1, verbose=False)
+    assert m.train_all == 16
+
+
+def test_moe_classifier_composite_trains():
+    """The reference-graph-shaped MoE (top_k/group_by/aggregate ops)."""
+    ff = FFModel(FFConfig(batch_size=16))
+    build_moe_classifier(ff, input_dim=10, num_classes=4)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    rs = np.random.RandomState(0)
+    centers = rs.randn(4, 10) * 3
+    y = rs.randint(0, 4, 256)
+    x = (centers[y] + rs.randn(256, 10)).astype(np.float32)
+    ff.fit(x, y.astype(np.int32), epochs=5, verbose=False)
+    m = ff.eval(x, y.astype(np.int32), verbose=False)
+    assert m.train_correct / m.train_all > 0.7
+
+
+def test_alexnet_cifar_trains():
+    ff = FFModel(FFConfig(batch_size=8))
+    build_alexnet_cifar10(ff)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 3, 32, 32).astype(np.float32)
+    y = rs.randint(0, 10, 16).astype(np.int32)
+    m = ff.fit(x, y, epochs=1, verbose=False)
+    assert m.train_all == 16
+
+
+def test_bert_tiny_trains_attribute_parallel():
+    bcfg = BertConfig(vocab_size=256, hidden=32, layers=2, heads=4,
+                      intermediate=64, num_classes=2)
+    ff = FFModel(FFConfig(batch_size=8, mesh_shape={"data": 2, "model": 4}))
+    build_bert(ff, bcfg, seq_len=16)
+    ff.compile(
+        optimizer=AdamOptimizer(lr=1e-3),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        strategy=bert_attribute_parallel_strategy(bcfg),
+    )
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, 256, (32, 16)).astype(np.int32)
+    y = rs.randint(0, 2, 32).astype(np.int32)
+    m = ff.fit(x, y, epochs=1, verbose=False)
+    assert m.train_all == 32
+
+
+def test_resnet50_builds_and_forward():
+    ff = FFModel(FFConfig(batch_size=8))
+    build_resnet50(ff, image_size=32, classes=10)
+    assert len(ff.graph) > 100
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    x = np.random.RandomState(0).randn(8, 3, 32, 32).astype(np.float32)
+    preds = ff.predict(x)
+    assert preds.shape == (8, 10)
+    assert np.isfinite(preds).all()
+
+
+def test_dlrm_trains_mse():
+    ff = FFModel(FFConfig(batch_size=16))
+    build_dlrm(ff, num_sparse=3, vocab=100, embed_dim=8, dense_dim=4,
+               bot_mlp=(16, 8), top_mlp=(16, 1))
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    rs = np.random.RandomState(0)
+    dense = rs.randn(64, 4).astype(np.float32)
+    sparse = [rs.randint(0, 100, (64, 1)).astype(np.int32) for _ in range(3)]
+    y = rs.rand(64, 1).astype(np.float32)
+    m = ff.fit([dense] + sparse, y, epochs=2, verbose=False)
+    assert m.train_all == 64  # metrics reset each epoch
+    assert np.isfinite(m.mse_loss)
