@@ -52,6 +52,10 @@ class FFConfig:
 
     # ---- execution ----
     profiling: bool = False
+    # rematerialization: "attention" wraps attention ops in jax.checkpoint so
+    # S×S probs are recomputed in backward instead of saved (HBM for FLOPs —
+    # net-new vs the reference, which has no remat); "none" disables
+    remat: str = "attention"
     # op fusion: on TPU XLA fuses inside one jitted program for free; this
     # flag only controls whether the PCG keeps explicit FusedOp groups for
     # search costing (reference --fusion, model.cc:2965)

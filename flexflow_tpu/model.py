@@ -446,9 +446,14 @@ class FFModel:
         self._mesh = make_mesh(mesh_axes, devices)
 
         if strategy is None and not cfg.only_data_parallel and cfg.search_budget > 0:
-            from flexflow_tpu.search.api import search_strategy
+            if cfg.search_budget > 5:
+                from flexflow_tpu.search.api import graph_optimize
 
-            strategy = search_strategy(self.graph, self._mesh, cfg)
+                self.graph, strategy = graph_optimize(self.graph, self._mesh, cfg)
+            else:
+                from flexflow_tpu.search.api import search_strategy
+
+                strategy = search_strategy(self.graph, self._mesh, cfg)
 
         # default DP: shard every INPUT's batch dim over "data"; explicit
         # strategy views override per node name
@@ -471,6 +476,7 @@ class FFModel:
             optimizer=self._optimizer,
             seq_length=cfg.seq_length,
             donate=cfg.donate_buffers,
+            remat=cfg.remat,
         )
         rng = jax.random.key(cfg.seed)
         self._params = self._executor.init_params(rng, self._init_overrides)

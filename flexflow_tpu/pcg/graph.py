@@ -46,6 +46,9 @@ class Node:
     name: str = ""
     outputs: Tuple[ParallelTensorShape, ...] = ()
     sharding: object = None  # flexflow_tpu.parallel.sharding.ShardingView
+    # input shapes cached at infer_shapes() time so subgraphs produced by
+    # search splits (which drop producer nodes) can still be costed
+    in_shapes: Tuple[ParallelTensorShape, ...] = ()
 
     def __hash__(self):
         return hash(self.guid)
@@ -183,6 +186,7 @@ class Graph:
         node's attrs.infer(input_shapes) -> output shapes."""
         for node in self.topo_order():
             ins = self.input_shapes(node)
+            node.in_shapes = tuple(ins)
             if node.attrs is not None:
                 node.outputs = tuple(node.attrs.infer(*ins))
             # source nodes (INPUT/WEIGHT) must have outputs pre-set

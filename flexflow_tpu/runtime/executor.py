@@ -61,6 +61,7 @@ class Executor:
         label_dtype=jnp.int32,
         seq_length: Optional[int] = None,
         donate: bool = True,
+        remat: str = "attention",
     ):
         self.graph = graph
         self.mesh = mesh
@@ -69,6 +70,7 @@ class Executor:
         self.optimizer = optimizer
         self.seq_length = seq_length
         self.donate = donate
+        self.remat = remat
         self.topo = graph.topo_order()
         self.input_nodes = [n for n in self.topo if n.op_type == OpType.INPUT]
         sinks = graph.sinks()
@@ -202,7 +204,21 @@ class Executor:
                 seq_length=self.seq_length,
                 node_guid=n.guid,
             )
-            outs = get_lowering(n.op_type)(n.attrs, ins, params, ctx)
+            lowering = get_lowering(n.op_type)
+            if (
+                training
+                and self.remat == "attention"
+                and n.op_type
+                in (OpType.MULTIHEAD_ATTENTION, OpType.RING_ATTENTION)
+            ):
+                # recompute S×S attention probs in backward instead of saving
+                # them (reference has no remat; on TPU this trades cheap MXU
+                # FLOPs for the scarce HBM)
+                outs = jax.checkpoint(
+                    lambda ps, xs: lowering(n.attrs, list(xs), ps, ctx)
+                )(params, tuple(ins))
+            else:
+                outs = lowering(n.attrs, ins, params, ctx)
             outs = self._apply_view(n, outs)
             for i, o in enumerate(outs):
                 values[(n.guid, i)] = o

@@ -1,24 +1,56 @@
-"""Search entry point used by FFModel.compile (reference
-FFModel::compile -> GRAPH_OPTIMIZE_TASK, model.cc:2826)."""
+"""Search entry points used by FFModel.compile.
+
+Reference analog: FFModel::compile launching GRAPH_OPTIMIZE_TASK
+(model.cc:2826) -> PCG::Graph::graph_optimize_task (graph.cc:2046). Two
+levels are available, selected by config:
+  - search_budget <= 5:  MCMC over per-op views on the FIXED graph
+    (FFModel::mcmc_optimize analog) — cheap, no graph rewriting;
+  - search_budget > 5:   Unity-style substitution search (GraphXfer
+    best-first + view DP), which may rewrite the PCG (inserting parallel
+    ops / fusing) and returns the new graph.
+"""
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Tuple
 
 from flexflow_tpu.parallel.sharding import ShardingView
+from flexflow_tpu.pcg.graph import Graph
+from flexflow_tpu.search.cost_model import CostModel
+from flexflow_tpu.search.machine_model import TPUMachineModel
+
+
+def _cost_model(mesh, config) -> CostModel:
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    machine = (
+        TPUMachineModel.from_file(config.machine_model_file)
+        if config.machine_model_file
+        else TPUMachineModel.make("v5e", num_chips=int(mesh.devices.size))
+    )
+    return CostModel(machine, axis_sizes)
 
 
 def search_strategy(graph, mesh, config) -> Dict[str, ShardingView]:
-    """Run the strategy search over per-node shardings; returns node-name ->
-    ShardingView. Dispatches to MCMC (small graphs / validation) or the
-    Unity-style DP+substitution search depending on config."""
-    try:
-        from flexflow_tpu.search.mcmc import mcmc_search
-    except ImportError as e:
-        import warnings
+    """Views-only search on a fixed graph (MCMC)."""
+    from flexflow_tpu.search.mcmc import mcmc_search
 
-        warnings.warn(
-            f"strategy search unavailable ({e}); falling back to data parallel"
-        )
-        return {}
     return mcmc_search(graph, mesh, config)
+
+
+def graph_optimize(graph: Graph, mesh, config) -> Tuple[Graph, Dict[str, ShardingView]]:
+    """Full Unity search: substitutions + view DP. Returns (possibly
+    rewritten graph, strategy)."""
+    from flexflow_tpu.search.substitution import unity_search
+
+    cost = _cost_model(mesh, config)
+    memory_limit = cost.machine.memory_per_chip() if config.memory_search else None
+    best_graph, strategy, best_time = unity_search(
+        graph,
+        cost,
+        budget=config.search_budget,
+        alpha=config.search_alpha,
+        memory_limit=memory_limit,
+    )
+    if config.profiling:
+        print(f"[search] best estimated step time {best_time * 1e3:.3f} ms")
+    return best_graph, strategy

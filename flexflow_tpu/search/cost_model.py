@@ -1,0 +1,231 @@
+"""Per-op and per-edge cost model.
+
+Reference analog: Simulator::measure_operator_cost (simulator.cc:537) +
+estimate_xfer_cost (graph.cc:1438). The reference MEASURES each op's kernels
+with CUDA events and caches by (op params, machine view); on TPU per-op
+measurement is less faithful (XLA fuses across ops, and each sharding change
+recompiles), so the default is an analytic roofline against the
+TPUMachineModel, with an optional measured calibration path
+(`MeasuredCostModel`) that times jitted single ops on the local chip and
+caches by (attrs, shard shape) exactly like strict_hash_to_operator_cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+from flexflow_tpu.ffconst import OpType, PARALLEL_OP_TYPES
+from flexflow_tpu.parallel.sharding import ShardingView, Spec
+from flexflow_tpu.pcg.graph import Graph, Node
+from flexflow_tpu.search.machine_model import TPUMachineModel
+
+
+def _in_shapes(graph, node):
+    """Input shapes via edges, falling back to the cache stamped by
+    infer_shapes() (subgraphs from search splits drop producer nodes)."""
+    ins = graph.input_shapes(node)
+    if node.in_shapes and len(ins) < len(node.in_shapes):
+        return list(node.in_shapes)
+    return ins
+
+
+def spec_degree(spec: Optional[Spec], axis_sizes: Dict[str, int],
+                ndim: Optional[int] = None) -> int:
+    """Total sharding degree implied by a spec."""
+    if spec is None:
+        return 1
+    d = 1
+    for axes in spec:
+        for a in axes:
+            d *= axis_sizes.get(a, 1)
+    return d
+
+
+def dim_degree(spec: Optional[Spec], dim: int, axis_sizes: Dict[str, int]) -> int:
+    if spec is None or dim >= len(spec):
+        return 1
+    d = 1
+    for a in spec[dim]:
+        d *= axis_sizes.get(a, 1)
+    return d
+
+
+@dataclasses.dataclass
+class CostModel:
+    machine: TPUMachineModel
+    axis_sizes: Dict[str, int]
+    # backward ~2x forward FLOPs (two GEMMs per forward GEMM)
+    backward_factor: float = 2.0
+
+    # ------------------------------------------------------------------
+
+    def node_compute_time(self, graph: Graph, node: Node, view: Optional[ShardingView],
+                          training: bool = True) -> float:
+        """Fwd (+bwd) time of one op's shard under `view`."""
+        if node.op_type in PARALLEL_OP_TYPES or node.attrs is None:
+            return 0.0
+        ins = _in_shapes(graph, node)
+        outs = list(node.outputs)
+        flops = node.attrs.flops(ins, outs)
+        byts = node.attrs.bytes_accessed(ins, outs)
+        degree = 1
+        if view is not None:
+            degree = max(
+                spec_degree(view.output_spec(0), self.axis_sizes),
+                max(
+                    (spec_degree(s, self.axis_sizes) for s in view.weight_specs.values()),
+                    default=1,
+                ),
+            )
+        degree = max(degree, 1)
+        factor = (1.0 + self.backward_factor) if training else 1.0
+        return self.machine.compute_time(flops * factor / degree, byts * factor / degree)
+
+    def node_comm_time(self, graph: Graph, node: Node,
+                       view: Optional[ShardingView]) -> float:
+        """Collective cost attributable to the node itself:
+        - parallel ops (Reduction/Combine/Repartition/AllToAll) price the
+          collective GSPMD will emit for them;
+        - a linear/conv whose contraction dim is sharded produces a partial
+          sum -> all-reduce of the output (the row-TP allreduce)."""
+        ins = _in_shapes(graph, node)
+        if node.op_type == OpType.REDUCTION and ins:
+            deg = self.axis_sizes.get("model", 1)
+            return self.machine.all_reduce_time(ins[0].global_bytes(), deg)
+        if node.op_type == OpType.COMBINE and ins:
+            deg = max(self.axis_sizes.get("model", 1), 2)
+            return self.machine.all_gather_time(ins[0].global_bytes(), deg)
+        if node.op_type == OpType.ALL_TO_ALL and ins:
+            deg = max(self.axis_sizes.get("seq", 1), self.axis_sizes.get("model", 1), 2)
+            return self.machine.all_to_all_time(ins[0].global_bytes(), deg)
+        if node.op_type in PARALLEL_OP_TYPES:
+            return 0.0
+        # contraction-dim sharding => partial-sum all-reduce of the output
+        if view is not None and node.outputs:
+            contraction_specs = {
+                OpType.LINEAR: ("kernel", 0),
+                OpType.CONV2D: ("kernel", 1),
+            }
+            if node.op_type in contraction_specs:
+                wname, cdim = contraction_specs[node.op_type]
+                wspec = view.weight_specs.get(wname)
+                if wspec is not None and cdim < len(wspec) and wspec[cdim]:
+                    deg = 1
+                    for a in wspec[cdim]:
+                        deg *= self.axis_sizes.get(a, 1)
+                    if deg > 1:
+                        return self.machine.all_reduce_time(
+                            node.outputs[0].global_bytes(), deg
+                        )
+        return 0.0
+
+    def weight_sync_time(self, graph: Graph, node: Node,
+                         view: Optional[ShardingView]) -> float:
+        """Gradient all-reduce over the replicated (data) axes of each weight
+        (reference: NCCL allreduce in the optimizer, optimizer_kernel.cu:88)."""
+        if node.attrs is None:
+            return 0.0
+        total = 0.0
+        ws = node.attrs.weights(*_in_shapes(graph, node))
+        data_degree = self.axis_sizes.get("data", 1)
+        for name, spec_decl in ws.items():
+            if not spec_decl.trainable:
+                continue
+            nbytes = spec_decl.shape.size_bytes()
+            shard_degree = 1
+            if view is not None and name in view.weight_specs:
+                shard_degree = spec_degree(view.weight_specs[name], self.axis_sizes)
+            # grads are sharded over the weight's own axes; the psum spans the
+            # axes the weight does NOT use (≈ data axis degree)
+            total += self.machine.all_reduce_time(nbytes / shard_degree, data_degree)
+        return total
+
+    def edge_xfer_time(self, shape, src_spec: Optional[Spec],
+                       dst_spec: Optional[Spec]) -> float:
+        """Resharding cost between producer and consumer specs (reference
+        estimate_xfer_cost graph.cc:1438). Equal specs are free; otherwise
+        classify the transition into gather/partition/all-to-all."""
+        src = tuple(src_spec or ())
+        dst = tuple(dst_spec or ())
+        if src == dst:
+            return 0.0
+        nbytes = shape.global_bytes()
+        src_deg = spec_degree(src or None, self.axis_sizes)
+        dst_deg = spec_degree(dst or None, self.axis_sizes)
+        parts = max(src_deg, dst_deg, 2)
+        if src_deg > 1 and dst_deg > 1:
+            return self.machine.all_to_all_time(nbytes, parts)
+        if src_deg > 1 and dst_deg == 1:
+            return self.machine.all_gather_time(nbytes, src_deg)
+        # partitioning replicated data is a local slice
+        return 0.0
+
+    # ------------------------------------------------------------------
+
+    def node_memory(self, graph: Graph, node: Node,
+                    view: Optional[ShardingView], training: bool = True) -> float:
+        """Per-chip bytes attributable to this node: weights (+grads+opt
+        state when training) and activation output, under `view`."""
+        if node.attrs is None:
+            return 0.0
+        total = 0.0
+        ws = node.attrs.weights(*_in_shapes(graph, node))
+        for name, spec_decl in ws.items():
+            deg = 1
+            if view is not None and name in view.weight_specs:
+                deg = spec_degree(view.weight_specs[name], self.axis_sizes)
+            factor = 4.0 if (training and spec_decl.trainable) else 1.0  # p+g+m+v
+            total += spec_decl.shape.size_bytes() * factor / deg
+        for i, out in enumerate(node.outputs):
+            deg = 1
+            if view is not None:
+                deg = spec_degree(view.output_spec(i), self.axis_sizes)
+            total += out.global_bytes() / deg
+        return total
+
+
+@dataclasses.dataclass
+class GraphCost:
+    """Composite result (reference GraphCostResultWithMemory)."""
+
+    time: float
+    memory_per_chip: float
+
+    def multi_obj(self, run_time_cost_factor: float) -> float:
+        """λ-blend used by the memory-aware search (graph.cc:1155)."""
+        return self.time * run_time_cost_factor + self.memory_per_chip * (
+            1.0 - run_time_cost_factor
+        )
+
+
+def graph_cost(graph: Graph, strategy: Dict[str, ShardingView],
+               cost: CostModel, training: bool = True,
+               overlap: float = 0.0) -> GraphCost:
+    """Whole-graph step-time estimate for a strategy: compute + resharding +
+    gradient sync, with `overlap` ∈ [0,1] crediting comm/compute overlap
+    (XLA async collectives). This is the SPMD analog of the reference's
+    SimTask list-scheduling (simulator.cc:822): with one fused XLA program
+    per step there is a single device timeline, so the schedule reduces to a
+    sum with an overlap credit."""
+    compute = 0.0
+    comm = 0.0
+    mem = 0.0
+    for node in graph.topo_order():
+        view = strategy.get(node.name, node.sharding)
+        compute += cost.node_compute_time(graph, node, view, training)
+        comm += cost.node_comm_time(graph, node, view)
+        if training:
+            comm += cost.weight_sync_time(graph, node, view)
+        mem += cost.node_memory(graph, node, view, training)
+        for e in graph.out_edges(node):
+            dst = graph.node(e.dst)
+            dst_view = strategy.get(dst.name, dst.sharding)
+            src_spec = view.output_spec(e.src_idx) if view else None
+            dst_in_spec = dst_view.output_spec(0) if dst_view else None
+            comm += cost.edge_xfer_time(
+                node.outputs[e.src_idx], src_spec, dst_in_spec
+            )
+    time = compute + comm * (1.0 - overlap)
+    return GraphCost(time, mem)

@@ -1,0 +1,123 @@
+"""Machine-view DP over graph structure (the Unity inner search).
+
+Reference analog: SearchHelper::graph_cost (graph.cc:1586): recursively
+decompose the PCG — bottleneck (dominator) node -> sequence split trying
+every view at the boundary; otherwise a horizontal split of independent
+branches; memoize by (graph hash, boundary views). The base case here is an
+exhaustive product for tiny subgraphs and coordinate-descent otherwise
+(replacing the reference's per-node exhaustive machine-view scan, which is
+cheap for device lists but exponential for named-axis specs).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+from flexflow_tpu.parallel.sharding import ShardingView
+from flexflow_tpu.pcg.graph import Graph
+from flexflow_tpu.search import space
+from flexflow_tpu.search.cost_model import CostModel, graph_cost
+
+
+class ViewDP:
+    def __init__(self, cost: CostModel, *, training: bool = True,
+                 max_exhaustive: int = 4):
+        self.cost = cost
+        self.training = training
+        self.max_exhaustive = max_exhaustive
+        self._memo: Dict = {}
+
+    def optimize(self, graph: Graph) -> Dict[str, ShardingView]:
+        strategy = self._solve(graph, {})
+        # fill uncovered nodes with DP defaults
+        base = space.default_dp_strategy(graph, self.cost.axis_sizes)
+        base.update(strategy)
+        return base
+
+    # ------------------------------------------------------------------
+
+    def _solve(self, graph: Graph, fixed: Dict[str, ShardingView]) -> Dict[str, ShardingView]:
+        key = (graph.structure_hash(), tuple(sorted((k, hash(v)) for k, v in fixed.items())))
+        if key in self._memo:
+            return self._memo[key]
+        result = self._solve_uncached(graph, fixed)
+        self._memo[key] = result
+        return result
+
+    def _candidates(self, graph: Graph) -> Dict[str, List[ShardingView]]:
+        out = {}
+        for n in graph.nodes:
+            views = space.enumerate_views(n, self.cost.axis_sizes)
+            if len(views) > 1:
+                out[n.name] = views
+        return out
+
+    def _eval(self, graph: Graph, strategy: Dict[str, ShardingView]) -> float:
+        return graph_cost(graph, strategy, self.cost, self.training).time
+
+    def _solve_uncached(self, graph: Graph, fixed) -> Dict[str, ShardingView]:
+        cands = {k: v for k, v in self._candidates(graph).items() if k not in fixed}
+        if not cands:
+            return dict(fixed)
+
+        # sequence split at a bottleneck (graph.cc:115)
+        if len(graph) > self.max_exhaustive:
+            b = graph.find_bottleneck_node()
+            if b is not None and b.name in cands:
+                best, best_cost = None, float("inf")
+                first, second = graph.split_at_node(b)
+                for view in cands[b.name]:
+                    f = dict(fixed)
+                    f[b.name] = view
+                    s1 = self._solve(first, {k: v for k, v in f.items()
+                                             if any(n.name == k for n in first.nodes)})
+                    s2 = self._solve(second, {k: v for k, v in f.items()
+                                              if any(n.name == k for n in second.nodes)})
+                    merged = dict(f)
+                    merged.update(s1)
+                    merged.update(s2)
+                    c = self._eval(graph, merged)
+                    if c < best_cost:
+                        best, best_cost = merged, c
+                if best is not None:
+                    return best
+            elif b is not None:
+                # bottleneck exists but has no choices: solve halves
+                first, second = graph.split_at_node(b)
+                s1 = self._solve(first, {k: v for k, v in fixed.items()
+                                         if any(n.name == k for n in first.nodes)})
+                s2 = self._solve(second, {k: v for k, v in fixed.items()
+                                          if any(n.name == k for n in second.nodes)})
+                merged = dict(fixed)
+                merged.update(s1)
+                merged.update(s2)
+                return merged
+
+        # exhaustive product for small graphs (graph.cc base case)
+        names = list(cands)
+        if len(names) <= self.max_exhaustive:
+            best, best_cost = dict(fixed), float("inf")
+            for combo in itertools.product(*(cands[n] for n in names)):
+                s = dict(fixed)
+                s.update(dict(zip(names, combo)))
+                c = self._eval(graph, s)
+                if c < best_cost:
+                    best, best_cost = s, c
+            return best
+
+        # fallback: coordinate descent (2 sweeps)
+        strategy = dict(fixed)
+        for n in names:
+            strategy[n] = cands[n][0]
+        for _ in range(2):
+            for n in names:
+                best_v, best_c = strategy[n], float("inf")
+                for v in cands[n]:
+                    s = dict(strategy)
+                    s[n] = v
+                    c = self._eval(graph, s)
+                    if c < best_c:
+                        best_v, best_c = v, c
+                strategy[n] = best_v
+        return strategy

@@ -1,0 +1,127 @@
+"""TPU machine models for the strategy search.
+
+Reference analog: SimpleMachineModel / EnhancedMachineModel /
+NetworkedMachineModel (simulator.h:212-605, machine_model.cc) — but the
+network is an ICI torus (+ DCN between slices) instead of
+NVLink/PCIe/NIC graphs. Like the reference's `--machine-model-file`
+(machine_config_example), a JSON file can describe a machine you don't have,
+so strategies can be searched for a v5p-64 pod from a laptop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class TPUChipSpec:
+    name: str
+    bf16_flops: float  # peak FLOP/s
+    hbm_bytes: float
+    hbm_bw: float  # bytes/s
+    ici_link_bw: float  # bytes/s per link per direction
+    ici_links: int  # links per chip (torus degree * 2 dirs collapsed)
+    torus_dims: int  # 2 (v5e/v6e) or 3 (v4/v5p)
+
+
+# Published specs (approximate, public numbers)
+CHIPS: Dict[str, TPUChipSpec] = {
+    "v4": TPUChipSpec("v4", 275e12, 32e9, 1228e9, 50e9, 6, 3),
+    "v5e": TPUChipSpec("v5e", 197e12, 16e9, 819e9, 50e9, 4, 2),
+    "v5p": TPUChipSpec("v5p", 459e12, 95e9, 2765e9, 100e9, 6, 3),
+    "v6e": TPUChipSpec("v6e", 918e12, 32e9, 1640e9, 100e9, 4, 2),
+}
+
+
+@dataclasses.dataclass
+class TPUMachineModel:
+    """Cost oracle for compute and collectives on a TPU slice.
+
+    Collective estimates use standard ring/torus formulas: an all-reduce of
+    B bytes over n chips moves 2B(n-1)/n per chip; bandwidth scales with the
+    number of torus links usable by the mesh axis. `mxu_efficiency` and
+    `ici_efficiency` are calibration knobs (cf. the reference's measured
+    microbenchmarks feeding its simulator, simulator.cc:537).
+    """
+
+    chip: TPUChipSpec
+    num_chips: int
+    mxu_efficiency: float = 0.5
+    hbm_efficiency: float = 0.8
+    ici_efficiency: float = 0.8
+    ici_latency: float = 1e-6  # per-hop software+link latency (s)
+    # multi-slice: chips per slice; collectives crossing slices use DCN
+    chips_per_slice: Optional[int] = None
+    dcn_bw: float = 25e9  # bytes/s per host
+
+    @staticmethod
+    def make(chip: str = "v5e", num_chips: int = 8, **kw) -> "TPUMachineModel":
+        return TPUMachineModel(CHIPS[chip], num_chips, **kw)
+
+    @staticmethod
+    def from_file(path: str) -> "TPUMachineModel":
+        """JSON machine description (reference --machine-model-file analog):
+        {"chip": "v5p", "num_chips": 64, "mxu_efficiency": 0.55, ...} or a
+        fully custom chip: {"chip": {"name": ..., "bf16_flops": ...}, ...}"""
+        with open(path) as f:
+            d = json.load(f)
+        chip = d.pop("chip", "v5e")
+        if isinstance(chip, dict):
+            spec = TPUChipSpec(**chip)
+        else:
+            spec = CHIPS[chip]
+        return TPUMachineModel(spec, d.pop("num_chips", 8), **d)
+
+    # ------------------------------------------------------------------
+
+    def compute_time(self, flops: float, bytes_accessed: float) -> float:
+        """Roofline: max of MXU time and HBM time for one chip's shard."""
+        t_flops = flops / (self.chip.bf16_flops * self.mxu_efficiency)
+        t_mem = bytes_accessed / (self.chip.hbm_bw * self.hbm_efficiency)
+        return max(t_flops, t_mem)
+
+    def _axis_bw(self, participants: int) -> float:
+        """Aggregate ICI bandwidth available to a collective over one mesh
+        axis. A contiguous axis rides one torus dimension: 2 links (both
+        ring directions)."""
+        return 2 * self.chip.ici_link_bw * self.ici_efficiency
+
+    def _crosses_dcn(self, participants: int) -> bool:
+        return (
+            self.chips_per_slice is not None and participants > self.chips_per_slice
+        )
+
+    def all_reduce_time(self, bytes_global: float, participants: int) -> float:
+        if participants <= 1:
+            return 0.0
+        if self._crosses_dcn(participants):
+            return bytes_global * 2 / self.dcn_bw + self.ici_latency * participants
+        moved = 2 * bytes_global * (participants - 1) / participants
+        return moved / self._axis_bw(participants) + self.ici_latency * participants
+
+    def all_gather_time(self, bytes_global: float, participants: int) -> float:
+        if participants <= 1:
+            return 0.0
+        moved = bytes_global * (participants - 1) / participants
+        bw = self.dcn_bw if self._crosses_dcn(participants) else self._axis_bw(participants)
+        return moved / bw + self.ici_latency * participants
+
+    def reduce_scatter_time(self, bytes_global: float, participants: int) -> float:
+        return self.all_gather_time(bytes_global, participants)
+
+    def all_to_all_time(self, bytes_global: float, participants: int) -> float:
+        if participants <= 1:
+            return 0.0
+        # each chip keeps 1/n, sends (n-1)/n of its shard
+        moved = bytes_global * (participants - 1) / (participants * participants)
+        bw = self.dcn_bw if self._crosses_dcn(participants) else self._axis_bw(participants)
+        return moved / bw + self.ici_latency * participants
+
+    def p2p_time(self, bytes_per_chip: float, hops: int = 1) -> float:
+        return bytes_per_chip / self._axis_bw(2) + self.ici_latency * hops
+
+    def memory_per_chip(self) -> float:
+        return self.chip.hbm_bytes

@@ -1,0 +1,85 @@
+"""MCMC strategy search (simulated annealing over per-op ShardingViews).
+
+Reference analog: FFModel::mcmc_optimize (model.cc:3285-3356): start from
+data parallel, propose "random op -> random legal config", accept improving
+moves always and worsening moves with prob exp(-alpha * diff), track the
+best strategy seen within the budget.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, Optional
+
+from flexflow_tpu.parallel.sharding import ShardingView
+from flexflow_tpu.pcg.graph import Graph
+from flexflow_tpu.search import space
+from flexflow_tpu.search.cost_model import CostModel, graph_cost
+from flexflow_tpu.search.machine_model import TPUMachineModel
+
+
+def mcmc_optimize(
+    graph: Graph,
+    cost: CostModel,
+    *,
+    budget: int = 200,
+    alpha: float = 0.05,
+    seed: int = 0,
+    training: bool = True,
+    memory_limit: Optional[float] = None,
+    verbose: bool = False,
+) -> Dict[str, ShardingView]:
+    rng = random.Random(seed)
+    axis_sizes = cost.axis_sizes
+
+    candidates = {}
+    for node in graph.nodes:
+        views = space.enumerate_views(node, axis_sizes)
+        if len(views) > 1:
+            candidates[node.name] = views
+    if not candidates:
+        return space.default_dp_strategy(graph, axis_sizes)
+
+    current = space.default_dp_strategy(graph, axis_sizes)
+    names = list(candidates)
+
+    def evaluate(strategy):
+        gc = graph_cost(graph, strategy, cost, training)
+        t = gc.time
+        if memory_limit is not None and gc.memory_per_chip > memory_limit:
+            t += 1e3 * (gc.memory_per_chip / memory_limit)  # strong penalty
+        return t
+
+    cur_cost = evaluate(current)
+    best, best_cost = dict(current), cur_cost
+    for it in range(budget):
+        name = rng.choice(names)
+        view = rng.choice(candidates[name])
+        nxt = dict(current)
+        nxt[name] = view
+        nxt_cost = evaluate(nxt)
+        diff = nxt_cost - cur_cost
+        if diff < 0 or rng.random() < math.exp(-alpha * diff / max(cur_cost, 1e-12) * 100):
+            current, cur_cost = nxt, nxt_cost
+            if cur_cost < best_cost:
+                best, best_cost = dict(current), cur_cost
+                if verbose:
+                    print(f"mcmc iter {it}: best {best_cost * 1e3:.3f} ms")
+    return best
+
+
+def mcmc_search(graph: Graph, mesh, config) -> Dict[str, ShardingView]:
+    """Entry used by FFModel.compile (search/api.py)."""
+    from flexflow_tpu.search.api import _cost_model
+
+    cost = _cost_model(mesh, config)
+    machine = cost.machine
+    return mcmc_optimize(
+        graph,
+        cost,
+        budget=max(config.search_budget, 1) * 50,
+        alpha=config.search_alpha - 1.0 if config.search_alpha > 1 else 0.05,
+        memory_limit=machine.memory_per_chip() if config.memory_search else None,
+        verbose=config.profiling,
+    )

@@ -1,0 +1,95 @@
+"""Strategy space: legal ShardingViews per operator.
+
+Reference analog: the SOAP dimensions (sample/operator/attribute/parameter)
+from MLSys'19 and the per-op ParallelConfig enumeration used by the MCMC
+search (FFModel::rewrite, model.cc:3260) plus register_all_machine_views
+(graph.cc:2329). Here a "view" names mesh axes instead of device lists; the
+enumeration yields, per op, the TPU-meaningful points: pure DP, column/row
+TP for linears (parameter parallelism), head parallelism for attention
+(attribute), expert parallelism for MoE, vocab/ffn splits, and combinations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.parallel.sharding import ShardingView, batch_spec, replicated_spec
+from flexflow_tpu.pcg.graph import Graph, Node
+
+
+def enumerate_views(node: Node, axis_sizes: Dict[str, int]) -> List[ShardingView]:
+    """Candidate ShardingViews for one node. Always includes the
+    data-parallel default (weights replicated)."""
+    has_model = axis_sizes.get("model", 1) > 1
+    has_expert = axis_sizes.get("expert", 1) > 1
+    out_ndim = node.outputs[0].ndim if node.outputs else 2
+    dp = ShardingView((batch_spec(out_ndim),))
+    views = [dp]
+    t = node.op_type
+
+    if t == OpType.LINEAR and has_model:
+        # column parallel (parameter parallelism on out_dim)
+        views.append(
+            ShardingView(
+                (batch_spec(out_ndim)[:-1] + (("model",),),),
+                {"kernel": ((), ("model",)), "bias": (("model",),)},
+            )
+        )
+        # row parallel (contraction dim sharded -> all-reduce after)
+        views.append(
+            ShardingView(
+                (batch_spec(out_ndim),),
+                {"kernel": (("model",), ()), "bias": ((),)},
+            )
+        )
+    elif t in (OpType.MULTIHEAD_ATTENTION, OpType.RING_ATTENTION) and has_model:
+        # head (attribute) parallelism
+        views.append(
+            ShardingView(
+                (batch_spec(out_ndim),),
+                {
+                    "wq": ((), ("model",), ()),
+                    "wk": ((), ("model",), ()),
+                    "wv": ((), ("model",), ()),
+                    "wo": (("model",), (), ()),
+                },
+            )
+        )
+    elif t == OpType.EMBEDDING and has_model:
+        views.append(
+            ShardingView(
+                (batch_spec(out_ndim),),
+                {"kernel": ((), ("model",))},
+            )
+        )
+        views.append(
+            ShardingView(
+                (batch_spec(out_ndim),),
+                {"kernel": (("model",), ())},  # vocab-sharded
+            )
+        )
+    elif t == OpType.EXPERTS and has_expert:
+        views.append(
+            ShardingView(
+                (batch_spec(out_ndim),),
+                {"w1": (("expert",), (), ()), "w2": (("expert",), (), ())},
+            )
+        )
+    elif t == OpType.CONV2D and has_model:
+        # output-channel (parameter) parallelism
+        views.append(
+            ShardingView(
+                ((("data",),) + (("model",),) + ((),) * (out_ndim - 2),),
+                {"kernel": (("model",), (), (), ()), "bias": (("model",),)},
+            )
+        )
+    return views
+
+
+def default_dp_strategy(graph: Graph, axis_sizes: Dict[str, int]) -> Dict[str, ShardingView]:
+    out = {}
+    for n in graph.nodes:
+        if n.op_type == OpType.INPUT and n.outputs:
+            out[n.name] = ShardingView((batch_spec(n.outputs[0].ndim),))
+    return out

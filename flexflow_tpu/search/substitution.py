@@ -1,0 +1,368 @@
+"""Graph substitutions (GraphXfer) + Unity-style outer search.
+
+Reference analog: src/runtime/substitution.cc — pattern graphs (OpX/TensorX,
+substitution.h:40-110) matched against the PCG, rewritten candidates ranked
+by optimal_cost in a budgeted best-first search (base_optimize,
+substitution.cc:2229), seeded from hand-coded xfer builders
+(substitution.cc:1726-1868).
+
+TPU-native differences: rewrites operate on attrs/views rather than device
+lists; the canonical TP substitutions insert explicit parallel-op nodes
+(Repartition/Combine/Replicate/Reduction) exactly like the reference so the
+cost model can price the resharding, and the executor lowers them to
+sharding constraints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+from typing import Callable, Dict, List, Optional, Tuple
+
+from flexflow_tpu.ffconst import ActiMode, OpType
+from flexflow_tpu.ops import attrs as A
+from flexflow_tpu.parallel.parallel_ops import (
+    CombineAttrs,
+    ReductionAttrs,
+    RepartitionAttrs,
+    ReplicateAttrs,
+)
+from flexflow_tpu.parallel.sharding import ShardingView, batch_spec
+from flexflow_tpu.pcg.graph import Graph, Node
+from flexflow_tpu.search.cost_model import CostModel, graph_cost
+
+
+@dataclasses.dataclass
+class OpX:
+    """One pattern node: match by op type + optional predicate on attrs."""
+
+    op_type: OpType
+    predicate: Optional[Callable[[Node], bool]] = None
+
+    def matches(self, node: Node) -> bool:
+        if node.op_type != self.op_type:
+            return False
+        return self.predicate(node) if self.predicate else True
+
+
+@dataclasses.dataclass
+class GraphXfer:
+    """A rewrite rule: match a linear chain of pattern ops, then rebuild.
+
+    `pattern` is a chain (each node feeding the next, single-output), which
+    covers the reference's hand-coded TP/fusion xfers; `rewrite(graph,
+    matched_nodes)` returns a new Graph or None if not applicable.
+    """
+
+    name: str
+    pattern: List[OpX]
+    rewrite: Callable[[Graph, List[Node]], Optional[Graph]]
+
+    def find_matches(self, graph: Graph) -> List[List[Node]]:
+        out = []
+        for start in graph.nodes:
+            if not self.pattern[0].matches(start):
+                continue
+            chain = [start]
+            ok = True
+            for px in self.pattern[1:]:
+                succs = graph.succs(chain[-1])
+                nxt = [s for s in succs if px.matches(s)]
+                # chain steps must be the sole consumer to rewrite safely
+                if len(nxt) != 1 or len(graph.out_edges(chain[-1])) != 1:
+                    ok = False
+                    break
+                chain.append(nxt[0])
+            if ok:
+                out.append(chain)
+        return out
+
+    def apply_all(self, graph: Graph) -> List[Graph]:
+        res = []
+        for match in self.find_matches(graph):
+            g = self.rewrite(graph, match)
+            if g is not None:
+                res.append(g)
+        return res
+
+
+# ---------------------------------------------------------------------------
+# rewrite helpers
+
+
+def _replace_node(graph: Graph, old: Node, make_nodes) -> Graph:
+    """Copy `graph`, replacing `old` with a chain built by
+    `make_nodes(new_graph, reuse) -> (entry_node, exit_node)`; all of old's
+    in-edges go to entry, out-edges leave from exit. `reuse(op_type, attrs,
+    name)` creates the primary replacement node WITH old's guid, so
+    identity-keyed metadata (initializer overrides, which key on
+    name_guid) survives the rewrite."""
+    g = graph.copy()
+    node = g.node(old.guid)
+    in_edges = list(g.in_edges(node))
+    out_edges = list(g.out_edges(node))
+    for e in in_edges + out_edges:
+        g.remove_edge(e)
+    g.remove_node(node)
+
+    def reuse(op_type, attrs, name):
+        return g.add_node(Node(old.guid, op_type, attrs, name))
+
+    entry, exit_ = make_nodes(g, reuse)
+    for e in in_edges:
+        g.add_edge(g.node(e.src), entry, e.src_idx, e.dst_idx)
+    for e in out_edges:
+        g.add_edge(exit_, g.node(e.dst), e.src_idx, e.dst_idx)
+    g.infer_shapes()
+    return g
+
+
+# ---------------------------------------------------------------------------
+# concrete xfers (reference substitution.cc:1726-1868)
+
+
+def make_partition_linear_combine(axis: str = "model") -> GraphXfer:
+    """Linear -> Repartition(batch)-free column-TP:
+    Linear(col-sharded kernel) + Combine(out dim) — the reference's
+    create_partition_linear_combine (substitution.cc:1809)."""
+
+    def rewrite(graph: Graph, match: List[Node]) -> Optional[Graph]:
+        (lin,) = match
+        attrs: A.LinearAttrs = lin.attrs
+        ndim = lin.outputs[0].ndim
+
+        def build(g: Graph, reuse):
+            n1 = reuse(OpType.LINEAR, attrs, f"{lin.name}")
+            n1.sharding = ShardingView(
+                (batch_spec(ndim)[:-1] + ((axis,),),),
+                {"kernel": ((), (axis,)), "bias": ((axis,),)}
+                if attrs.use_bias
+                else {"kernel": ((), (axis,))},
+            )
+            comb = g.create_node(
+                OpType.COMBINE, CombineAttrs(ndim - 1), f"{lin.name}_combine"
+            )
+            comb.sharding = ShardingView((batch_spec(ndim),))
+            g.add_edge(n1, comb)
+            return n1, comb
+
+        return _replace_node(graph, lin, build)
+
+    return GraphXfer(
+        "partition_linear_combine",
+        [OpX(OpType.LINEAR, lambda n: n.sharding is None or not n.sharding.weight_specs)],
+        rewrite,
+    )
+
+
+def make_replicate_linear_reduce(axis: str = "model") -> GraphXfer:
+    """Linear -> row-TP: kernel sharded on in_dim + Reduction (the
+    reference's create_replicate_linear_combine, substitution.cc:1756)."""
+
+    def rewrite(graph: Graph, match: List[Node]) -> Optional[Graph]:
+        (lin,) = match
+        attrs: A.LinearAttrs = lin.attrs
+        if attrs.activation != ActiMode.NONE:
+            return None  # activation must come after the reduction
+        ndim = lin.outputs[0].ndim
+
+        def build(g: Graph, reuse):
+            n1 = reuse(OpType.LINEAR, attrs, f"{lin.name}")
+            n1.sharding = ShardingView(
+                (), {"kernel": ((axis,), ()), "bias": ((),)}
+                if attrs.use_bias
+                else {"kernel": ((axis,), ())},
+            )
+            red = g.create_node(
+                OpType.REDUCTION, ReductionAttrs(), f"{lin.name}_reduce"
+            )
+            red.sharding = ShardingView((batch_spec(ndim),))
+            g.add_edge(n1, red)
+            return n1, red
+
+        return _replace_node(graph, lin, build)
+
+    return GraphXfer(
+        "replicate_linear_reduce",
+        [OpX(OpType.LINEAR, lambda n: n.sharding is None or not n.sharding.weight_specs)],
+        rewrite,
+    )
+
+
+def make_partition_attention_combine(axis: str = "model") -> GraphXfer:
+    """Head-parallel attention (create_partition_attention_combine,
+    substitution.cc:1764)."""
+
+    def rewrite(graph: Graph, match: List[Node]) -> Optional[Graph]:
+        (attn,) = match
+
+        def build(g: Graph, reuse):
+            n1 = reuse(OpType.MULTIHEAD_ATTENTION, attn.attrs, attn.name)
+            n1.sharding = ShardingView(
+                (),
+                {
+                    "wq": ((), (axis,), ()),
+                    "wk": ((), (axis,), ()),
+                    "wv": ((), (axis,), ()),
+                    "wo": (((axis,), (), ())),
+                },
+            )
+            return n1, n1
+
+        return _replace_node(graph, attn, build)
+
+    return GraphXfer(
+        "partition_attention_combine",
+        [
+            OpX(
+                OpType.MULTIHEAD_ATTENTION,
+                lambda n: n.sharding is None or not n.sharding.weight_specs,
+            )
+        ],
+        rewrite,
+    )
+
+
+def make_fuse_linear_activation() -> GraphXfer:
+    """Linear + ElementUnary(relu|gelu|sigmoid|tanh) -> Linear(activation)
+    (the reference's linear+relu fusion xfer)."""
+    fusable = {"relu": ActiMode.RELU, "gelu": ActiMode.GELU,
+               "sigmoid": ActiMode.SIGMOID, "tanh": ActiMode.TANH}
+
+    def rewrite(graph: Graph, match: List[Node]) -> Optional[Graph]:
+        lin, act = match
+        attrs: A.LinearAttrs = lin.attrs
+        new_attrs = dataclasses.replace(attrs, activation=fusable[act.attrs.kind])
+        g = graph.copy()
+        lin_n, act_n = g.node(lin.guid), g.node(act.guid)
+        lin_n.attrs = new_attrs
+        out_edges = list(g.out_edges(act_n))
+        in_edge = g.in_edges(act_n)[0]
+        for e in out_edges + [in_edge]:
+            g.remove_edge(e)
+        for e in out_edges:
+            g.add_edge(lin_n, g.node(e.dst), 0, e.dst_idx)
+        g.remove_node(act_n)
+        g.infer_shapes()
+        return g
+
+    return GraphXfer(
+        "fuse_linear_activation",
+        [
+            OpX(OpType.LINEAR, lambda n: n.attrs.activation == ActiMode.NONE),
+            OpX(OpType.ELEMENT_UNARY, lambda n: n.attrs.kind in fusable),
+        ],
+        rewrite,
+    )
+
+
+def make_cancel_parallel_ops() -> GraphXfer:
+    """Repartition followed by Combine on the same dim cancels (the
+    SimplificationSettings.fuse_parallel_ops pass, substitution.cc:1924)."""
+
+    def rewrite(graph: Graph, match: List[Node]) -> Optional[Graph]:
+        rep, comb = match
+        if rep.attrs.dim != comb.attrs.dim:
+            return None
+        g = graph.copy()
+        rep_n, comb_n = g.node(rep.guid), g.node(comb.guid)
+        in_e = g.in_edges(rep_n)[0]
+        out_edges = list(g.out_edges(comb_n))
+        mid = g.in_edges(comb_n)[0]
+        for e in [in_e, mid] + out_edges:
+            g.remove_edge(e)
+        for e in out_edges:
+            g.add_edge(g.node(in_e.src), g.node(e.dst), in_e.src_idx, e.dst_idx)
+        g.remove_node(rep_n)
+        g.remove_node(comb_n)
+        g.infer_shapes()
+        return g
+
+    return GraphXfer(
+        "cancel_partition_combine",
+        [OpX(OpType.REPARTITION), OpX(OpType.COMBINE)],
+        rewrite,
+    )
+
+
+def default_xfers(axis_sizes: Dict[str, int]) -> List[GraphXfer]:
+    xf = [make_fuse_linear_activation(), make_cancel_parallel_ops()]
+    if axis_sizes.get("model", 1) > 1:
+        xf += [
+            make_partition_linear_combine("model"),
+            make_replicate_linear_reduce("model"),
+            make_partition_attention_combine("model"),
+        ]
+    return xf
+
+
+# ---------------------------------------------------------------------------
+# budgeted best-first search (base_optimize, substitution.cc:2229)
+
+
+def unity_search(
+    graph: Graph,
+    cost: CostModel,
+    *,
+    budget: int = 20,
+    alpha: float = 1.05,
+    training: bool = True,
+    xfers: Optional[List[GraphXfer]] = None,
+    use_dp: bool = True,
+    memory_limit: Optional[float] = None,
+) -> Tuple[Graph, Dict[str, ShardingView], float]:
+    """Best-first search over substitution rewrites; each candidate graph is
+    costed at its optimal views (ViewDP when `use_dp`, else current views +
+    DP default). Candidates worse than alpha × best are pruned; strategies
+    over `memory_limit` bytes/chip are heavily penalized (the reference's
+    is_valid_strategy memory check, graph.cc:1983). Returns (best graph,
+    best strategy, best cost)."""
+    from flexflow_tpu.search.dp import ViewDP
+
+    xfers = xfers if xfers is not None else default_xfers(cost.axis_sizes)
+    # one ViewDP across all candidates: its memo keys on (structure hash,
+    # boundary views), so shared subgraphs are solved once
+    view_dp = ViewDP(cost, training=training) if use_dp else None
+
+    def views_of(g: Graph) -> Dict[str, ShardingView]:
+        if view_dp is not None:
+            return view_dp.optimize(g)
+        out = {n.name: n.sharding for n in g.nodes if n.sharding is not None}
+        from flexflow_tpu.search.space import default_dp_strategy
+
+        base = default_dp_strategy(g, cost.axis_sizes)
+        base.update(out)
+        return base
+
+    def evaluate(g: Graph) -> Tuple[float, Dict[str, ShardingView]]:
+        s = views_of(g)
+        gc = graph_cost(g, s, cost, training)
+        t = gc.time
+        if memory_limit is not None and gc.memory_per_chip > memory_limit:
+            t += 1e3 * (gc.memory_per_chip / memory_limit)
+        return t, s
+
+    best_graph = graph
+    best_cost, best_strategy = evaluate(graph)
+    seen = {graph.structure_hash()}
+    counter = itertools.count()
+    heap = [(best_cost, next(counter), graph)]
+    expansions = 0
+    while heap and expansions < budget:
+        c, _, g = heapq.heappop(heap)
+        if c > alpha * best_cost:
+            continue
+        expansions += 1
+        for xfer in xfers:
+            for cand in xfer.apply_all(g):
+                h = cand.structure_hash()
+                if h in seen:
+                    continue
+                seen.add(h)
+                cc, ss = evaluate(cand)
+                if cc < best_cost:
+                    best_graph, best_cost, best_strategy = cand, cc, ss
+                if cc <= alpha * best_cost:
+                    heapq.heappush(heap, (cc, next(counter), cand))
+    return best_graph, best_strategy, best_cost
