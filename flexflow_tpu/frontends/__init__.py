@@ -1,0 +1,3 @@
+"""Frontends: import models from torch.fx, Keras-style APIs, and ONNX into
+the FFModel layer graph (reference python/flexflow/{torch,keras,onnx},
+SURVEY.md §2.7)."""

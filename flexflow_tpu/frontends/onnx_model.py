@@ -1,0 +1,143 @@
+"""ONNX frontend (reference python/flexflow/onnx/model.py:56:
+`ONNXModel(onnx.load(path)).apply(ffmodel, inputs)`).
+
+The onnx package is optional — the class raises a clear ImportError when
+it's missing. Supported ops mirror the reference's set: Gemm/MatMul, Conv,
+Relu/Sigmoid/Tanh/Softmax, MaxPool/AveragePool, Add/Sub/Mul, Concat,
+Flatten, Reshape, Dropout, BatchNormalization.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from flexflow_tpu.ffconst import PoolType
+from flexflow_tpu.model import FFModel, Tensor
+
+
+class ONNXModel:
+    def __init__(self, model_or_path):
+        try:
+            import onnx
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "the onnx package is required for the ONNX frontend"
+            ) from e
+        if isinstance(model_or_path, str):
+            model_or_path = onnx.load(model_or_path)
+        self.model = model_or_path
+
+    def apply(self, ff: FFModel, input_tensors: Dict[str, Tensor]) -> List[Tensor]:
+        graph = self.model.graph
+        env: Dict[str, Tensor] = dict(input_tensors)
+        inits = {i.name: i for i in graph.initializer}
+
+        def attr(node, name, default=None):
+            for a in node.attribute:
+                if a.name == name:
+                    if a.type == 7:  # INTS
+                        return list(a.ints)
+                    if a.type == 2:  # INT
+                        return a.i
+                    if a.type == 1:  # FLOAT
+                        return a.f
+            return default
+
+        for node in graph.node:
+            op = node.op_type
+            name = node.name or node.output[0]
+            if op == "Gemm":
+                x = env[node.input[0]]
+                w = inits[node.input[1]]
+                out_dim = list(w.dims)[0 if attr(node, "transB", 0) else 1]
+                env[node.output[0]] = ff.dense(
+                    x, out_dim, use_bias=len(node.input) > 2, name=name
+                )
+            elif op == "MatMul":
+                if node.input[1] in inits:
+                    w = inits[node.input[1]]
+                    env[node.output[0]] = ff.dense(
+                        env[node.input[0]], list(w.dims)[-1], use_bias=False,
+                        name=name,
+                    )
+                else:
+                    env[node.output[0]] = ff.batch_matmul(
+                        env[node.input[0]], env[node.input[1]], name=name
+                    )
+            elif op == "Conv":
+                k = attr(node, "kernel_shape")
+                s = attr(node, "strides", [1, 1])
+                p = attr(node, "pads", [0, 0, 0, 0])
+                g = attr(node, "group", 1)
+                w = inits[node.input[1]]
+                env[node.output[0]] = ff.conv2d(
+                    env[node.input[0]], list(w.dims)[0], k[0], k[1], s[0], s[1],
+                    p[0], p[1], groups=g, use_bias=len(node.input) > 2, name=name,
+                )
+            elif op in ("MaxPool", "AveragePool"):
+                k = attr(node, "kernel_shape")
+                s = attr(node, "strides", k)
+                p = attr(node, "pads", [0, 0, 0, 0])
+                env[node.output[0]] = ff.pool2d(
+                    env[node.input[0]], k[0], k[1], s[0], s[1], p[0], p[1],
+                    PoolType.MAX if op == "MaxPool" else PoolType.AVG, name=name,
+                )
+            elif op == "GlobalAveragePool":
+                env[node.output[0]] = ff.mean(env[node.input[0]], (2, 3),
+                                              keepdims=True, name=name)
+            elif op == "Relu":
+                env[node.output[0]] = ff.relu(env[node.input[0]], name=name)
+            elif op == "Sigmoid":
+                env[node.output[0]] = ff.sigmoid(env[node.input[0]], name=name)
+            elif op == "Tanh":
+                env[node.output[0]] = ff.tanh(env[node.input[0]], name=name)
+            elif op == "Softmax":
+                env[node.output[0]] = ff.softmax(env[node.input[0]],
+                                                 attr(node, "axis", -1), name=name)
+            elif op in ("Add", "Sub", "Mul"):
+                a = env[node.input[0]]
+                if node.input[1] in env:
+                    b = env[node.input[1]]
+                else:
+                    # constant operand: materialize the initializer as a
+                    # weight node holding its values
+                    from onnx import numpy_helper
+
+                    from flexflow_tpu.runtime.initializer import ArrayInitializer
+
+                    arr = numpy_helper.to_array(inits[node.input[1]])
+                    b = ff.create_weight(
+                        arr.shape, initializer=ArrayInitializer(arr),
+                        name=f"{name}_const",
+                    )
+                    env[node.input[1]] = b
+                fn = {"Add": ff.add, "Sub": ff.subtract, "Mul": ff.multiply}[op]
+                env[node.output[0]] = fn(a, b, name=name)
+            elif op == "Concat":
+                env[node.output[0]] = ff.concat(
+                    [env[i] for i in node.input], attr(node, "axis", 0), name=name
+                )
+            elif op == "Flatten":
+                env[node.output[0]] = ff.flat(env[node.input[0]], name=name)
+            elif op == "Reshape":
+                shape_init = inits[node.input[1]]
+                shape = list(np.frombuffer(shape_init.raw_data, dtype=np.int64))
+                x = env[node.input[0]]
+                total = int(np.prod(x.shape))
+                known = int(np.prod([s for s in shape if s > 0]))
+                shape = [total // known if s == -1 else int(s) for s in shape]
+                env[node.output[0]] = ff.reshape(x, shape, name=name)
+            elif op == "Dropout":
+                env[node.output[0]] = ff.dropout(
+                    env[node.input[0]], attr(node, "ratio", 0.5), name=name
+                )
+            elif op == "BatchNormalization":
+                env[node.output[0]] = ff.batch_norm(env[node.input[0]],
+                                                    relu=False, name=name)
+            elif op == "Identity":
+                env[node.output[0]] = env[node.input[0]]
+            else:
+                raise NotImplementedError(f"ONNX op {op} not supported")
+        return [env[o.name] for o in graph.output]

@@ -1,0 +1,419 @@
+"""PyTorch frontend: torch.fx symbolic trace -> FFModel graph.
+
+Reference analog: python/flexflow/torch/model.py — `PyTorchModel` wraps
+`torch.fx.symbolic_trace` (:2408-2495), ~55 Node classes map fx ops to
+FFModel layer calls (:43-2345), and a text IR supports decoupled
+export/import (`torch_to_file`/`file_to_ff`, :2597/:2540: trace on a CPU
+box with torch installed, train on the TPU pod without it).
+
+Weight transfer: `copy_weights` pushes traced module parameters into the
+compiled FFModel (torch Linear stores (out,in) — transposed into our
+(in,out) layout; Conv2d OIHW matches).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from flexflow_tpu.ffconst import ActiMode, DataType, PoolType
+from flexflow_tpu.model import FFModel, Tensor
+
+
+def _act(ff: FFModel, t: Tensor, mod) -> Tensor:
+    import torch.nn as nn
+
+    table = {
+        nn.ReLU: ff.relu,
+        nn.GELU: ff.gelu,
+        nn.Sigmoid: ff.sigmoid,
+        nn.Tanh: ff.tanh,
+        nn.SiLU: ff.silu,
+        nn.ELU: ff.elu,
+    }
+    return table[type(mod)](t)
+
+
+class PyTorchModel:
+    """Wraps a torch.nn.Module; `torch_to_ff` replays its fx graph as
+    FFModel layer calls and returns the output tensors."""
+
+    def __init__(self, model, seq_length: Optional[int] = None):
+        import torch.fx
+
+        self.model = model
+        self.traced = torch.fx.symbolic_trace(model)
+        # fx node name -> ff node name (for weight copy)
+        self._name_map: Dict[str, str] = {}
+
+    # ------------------------------------------------------------------
+
+    def torch_to_ff(self, ff: FFModel, input_tensors: Sequence[Tensor]) -> List[Tensor]:
+        import operator
+
+        import torch
+        import torch.nn as nn
+        import torch.nn.functional as F
+
+        env: Dict[str, Union[Tensor, float, int, tuple]] = {}
+        inputs = list(input_tensors)
+        outputs: List[Tensor] = []
+
+        def val(a):
+            if isinstance(a, torch.fx.Node):
+                return env[a.name]
+            if isinstance(a, (list, tuple)):
+                return type(a)(val(x) for x in a)
+            return a
+
+        for node in self.traced.graph.nodes:
+            if node.op == "placeholder":
+                env[node.name] = inputs.pop(0)
+            elif node.op == "get_attr":
+                # constants/buffers/parameters all become weights holding
+                # their traced values
+                import operator as _op
+
+                from flexflow_tpu.runtime.initializer import ArrayInitializer
+
+                try:
+                    t = self.traced.get_parameter(node.target)
+                except AttributeError:
+                    try:
+                        t = self.traced.get_buffer(node.target)
+                    except AttributeError:
+                        t = _op.attrgetter(node.target)(self.traced)
+                arr = t.detach().numpy()
+                env[node.name] = ff.create_weight(
+                    arr.shape, initializer=ArrayInitializer(arr), name=node.name
+                )
+            elif node.op == "call_module":
+                mod = self.traced.get_submodule(node.target)
+                x = val(node.args[0])
+                env[node.name] = self._lower_module(ff, node, mod, x)
+            elif node.op == "call_function":
+                env[node.name] = self._lower_function(ff, node, val)
+            elif node.op == "call_method":
+                env[node.name] = self._lower_method(ff, node, val)
+            elif node.op == "output":
+                out = val(node.args[0])
+                outputs = list(out) if isinstance(out, (list, tuple)) else [out]
+        return outputs
+
+    # ------------------------------------------------------------------
+
+    def _lower_module(self, ff: FFModel, node, mod, x: Tensor) -> Tensor:
+        import torch.nn as nn
+
+        name = node.target.replace(".", "_")
+        if isinstance(mod, nn.Linear):
+            self._name_map[node.target] = name
+            return ff.dense(x, mod.out_features, use_bias=mod.bias is not None,
+                            name=name)
+        if isinstance(mod, nn.Conv2d):
+            self._name_map[node.target] = name
+            return ff.conv2d(
+                x, mod.out_channels, *mod.kernel_size,
+                stride_h=mod.stride[0], stride_w=mod.stride[1],
+                padding_h=mod.padding[0], padding_w=mod.padding[1],
+                groups=mod.groups, use_bias=mod.bias is not None, name=name,
+            )
+        if isinstance(mod, nn.Embedding):
+            self._name_map[node.target] = name
+            return ff.embedding(x, mod.num_embeddings, mod.embedding_dim, name=name)
+        if isinstance(mod, nn.BatchNorm2d):
+            self._name_map[node.target] = name
+            return ff.batch_norm(x, relu=False, name=name)
+        if isinstance(mod, nn.LayerNorm):
+            self._name_map[node.target] = name
+            return ff.layer_norm(x, axes=tuple(range(-len(mod.normalized_shape), 0)),
+                                 elementwise_affine=mod.elementwise_affine,
+                                 eps=mod.eps, name=name)
+        if isinstance(mod, nn.MaxPool2d):
+            k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
+            s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride,) * 2
+            p = mod.padding if isinstance(mod.padding, tuple) else (mod.padding,) * 2
+            return ff.pool2d(x, k[0], k[1], s[0], s[1], p[0], p[1], PoolType.MAX,
+                             name=name)
+        if isinstance(mod, nn.AvgPool2d):
+            k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
+            s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride,) * 2
+            return ff.pool2d(x, k[0], k[1], s[0], s[1], 0, 0, PoolType.AVG, name=name)
+        if isinstance(mod, nn.AdaptiveAvgPool2d):
+            out = mod.output_size if isinstance(mod.output_size, tuple) else (mod.output_size,) * 2
+            h, w = x.shape[2], x.shape[3]
+            if out == (1, 1):
+                return ff.mean(x, axes=(2, 3), keepdims=True, name=name)
+            kh, kw = h // out[0], w // out[1]
+            return ff.pool2d(x, kh, kw, kh, kw, 0, 0, PoolType.AVG, name=name)
+        if isinstance(mod, nn.Dropout):
+            return ff.dropout(x, mod.p, name=name)
+        if isinstance(mod, nn.Flatten):
+            return ff.flat(x, name=name)
+        if isinstance(mod, nn.Softmax):
+            return ff.softmax(x, axis=mod.dim if mod.dim is not None else -1, name=name)
+        if isinstance(mod, nn.Identity):
+            return ff.identity(x, name=name)
+        if isinstance(mod, (nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh, nn.SiLU, nn.ELU)):
+            return _act(ff, x, mod)
+        if isinstance(mod, nn.Sequential):
+            t = x
+            for child_name, sub in mod.named_children():
+                # qualify by the child's own module path so names stay unique
+                # and copy_weights resolves the actual leaf module
+                fake = type(
+                    "N", (),
+                    {"target": f"{node.target}.{child_name}",
+                     "name": f"{node.name}_{child_name}"},
+                )
+                t = self._lower_module(ff, fake, sub, t)
+            return t
+        raise NotImplementedError(f"torch module {type(mod).__name__} not supported")
+
+    def _lower_function(self, ff: FFModel, node, val):
+        import operator
+
+        import torch
+        import torch.nn.functional as F
+
+        fn = node.target
+        a = [val(x) for x in node.args]
+        if fn in (operator.add, torch.add):
+            if isinstance(a[1], Tensor):
+                return ff.add(a[0], a[1])
+            return ff.scalar_add(a[0], float(a[1]))
+        if fn in (operator.sub, torch.sub):
+            if isinstance(a[1], Tensor):
+                return ff.subtract(a[0], a[1])
+            return ff.scalar_sub(a[0], float(a[1]))
+        if fn in (operator.mul, torch.mul):
+            if isinstance(a[1], Tensor):
+                return ff.multiply(a[0], a[1])
+            return ff.scalar_multiply(a[0], float(a[1]))
+        if fn in (operator.truediv, torch.div):
+            if isinstance(a[1], Tensor):
+                return ff.divide(a[0], a[1])
+            return ff.scalar_true_divide(a[0], float(a[1]))
+        if fn in (torch.relu, F.relu):
+            return ff.relu(a[0])
+        if fn is F.gelu:
+            return ff.gelu(a[0])
+        if fn in (torch.sigmoid, F.sigmoid):
+            return ff.sigmoid(a[0])
+        if fn in (torch.tanh, F.tanh):
+            return ff.tanh(a[0])
+        if fn in (torch.flatten,):
+            return ff.flat(a[0])
+        if fn in (torch.cat,):
+            axis = node.kwargs.get("dim", 0)
+            if len(node.args) > 1:
+                axis = node.args[1]
+            return ff.concat(a[0], axis=axis)
+        if fn in (torch.matmul, torch.bmm):
+            return ff.batch_matmul(a[0], a[1])
+        if fn is F.softmax:
+            return ff.softmax(a[0], axis=node.kwargs.get("dim", -1))
+        if fn is torch.exp:
+            return ff.exp(a[0])
+        if fn is torch.pow:
+            return ff.pow(a[0], float(a[1]))
+        if fn is torch.rsqrt:
+            return ff.rsqrt(a[0])
+        if fn is torch.mean:
+            dims = a[1] if len(a) > 1 else node.kwargs.get("dim")
+            keep = node.kwargs.get("keepdim", False)
+            return ff.mean(a[0], axes=tuple(dims) if isinstance(dims, (list, tuple)) else (dims,), keepdims=keep)
+        raise NotImplementedError(f"torch function {fn} not supported")
+
+    def _lower_method(self, ff: FFModel, node, val):
+        a = [val(x) for x in node.args]
+        m = node.target
+        x = a[0]
+        if m in ("view", "reshape"):
+            shape = a[1:] if not isinstance(a[1], (list, tuple)) else list(a[1])
+            shape = [int(s) for s in shape]
+            total = int(np.prod(x.shape))
+            known = int(np.prod([s for s in shape if s != -1]))
+            shape = [total // known if s == -1 else s for s in shape]
+            return ff.reshape(x, shape)
+        if m == "flatten":
+            return ff.flat(x)
+        if m == "transpose":
+            d0, d1 = a[1], a[2]
+            perm = list(range(len(x.shape)))
+            perm[d0], perm[d1] = perm[d1], perm[d0]
+            return ff.transpose(x, perm)
+        if m == "permute":
+            perm = a[1:] if not isinstance(a[1], (list, tuple)) else list(a[1])
+            return ff.transpose(x, [int(p) for p in perm])
+        if m == "contiguous":
+            return x
+        if m == "size":
+            return x.shape[a[1]] if len(a) > 1 else x.shape
+        raise NotImplementedError(f"torch method {m} not supported")
+
+    # ------------------------------------------------------------------
+
+    def copy_weights(self, ff: FFModel):
+        """Push the torch module's trained weights into the compiled model."""
+        import torch.nn as nn
+
+        for target, ff_name in self._name_map.items():
+            mod = self.traced.get_submodule(target)
+            if isinstance(mod, nn.Linear):
+                ff.set_weight(ff_name, mod.weight.detach().numpy().T, "kernel")
+                if mod.bias is not None:
+                    ff.set_weight(ff_name, mod.bias.detach().numpy(), "bias")
+            elif isinstance(mod, nn.Conv2d):
+                ff.set_weight(ff_name, mod.weight.detach().numpy(), "kernel")
+                if mod.bias is not None:
+                    ff.set_weight(ff_name, mod.bias.detach().numpy(), "bias")
+            elif isinstance(mod, nn.Embedding):
+                ff.set_weight(ff_name, mod.weight.detach().numpy(), "kernel")
+            elif isinstance(mod, nn.LayerNorm):
+                ff.set_weight(ff_name, mod.weight.detach().numpy(), "scale")
+                ff.set_weight(ff_name, mod.bias.detach().numpy(), "bias")
+            elif isinstance(mod, nn.BatchNorm2d):
+                ff.set_weight(ff_name, mod.weight.detach().numpy(), "scale")
+                ff.set_weight(ff_name, mod.bias.detach().numpy(), "bias")
+                ff.set_weight(ff_name, mod.running_mean.detach().numpy(), "running_mean")
+                ff.set_weight(ff_name, mod.running_var.detach().numpy(), "running_var")
+
+    # ------------------------------------------------------------------
+    # text IR (reference torch_to_file/file_to_ff, torch/model.py:2597,2540)
+
+    def torch_to_file(self, path: str):
+        """Serialize the fx graph to a text IR so the TPU side can rebuild
+        the model without torch installed."""
+        import torch
+
+        lines = []
+        for node in self.traced.graph.nodes:
+            if node.op == "placeholder":
+                lines.append(f"input\t{node.name}")
+            elif node.op == "output":
+                srcs = node.args[0]
+                if not isinstance(srcs, (list, tuple)):
+                    srcs = [srcs]
+                lines.append("output\t" + ",".join(s.name for s in srcs))
+            elif node.op == "call_module":
+                mod = self.traced.get_submodule(node.target)
+                spec = _module_spec(mod)
+                args = ",".join(a.name for a in node.args
+                                if isinstance(a, torch.fx.Node))
+                lines.append(f"module\t{node.name}\t{args}\t{spec}")
+            elif node.op in ("call_function", "call_method"):
+                import operator
+
+                fname = getattr(node.target, "__name__", str(node.target))
+                args = []
+                for a in node.args:
+                    args.append(a.name if isinstance(a, torch.fx.Node) else repr(a))
+                lines.append(f"{node.op}\t{node.name}\t{fname}\t{';'.join(args)}")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+
+
+def _module_spec(mod) -> str:
+    import torch.nn as nn
+
+    if isinstance(mod, nn.Linear):
+        return f"Linear:{mod.in_features}:{mod.out_features}:{int(mod.bias is not None)}"
+    if isinstance(mod, nn.Conv2d):
+        return (f"Conv2d:{mod.out_channels}:{mod.kernel_size[0]}:{mod.kernel_size[1]}"
+                f":{mod.stride[0]}:{mod.stride[1]}:{mod.padding[0]}:{mod.padding[1]}"
+                f":{mod.groups}:{int(mod.bias is not None)}")
+    if isinstance(mod, nn.ReLU):
+        return "ReLU"
+    if isinstance(mod, nn.GELU):
+        return "GELU"
+    if isinstance(mod, nn.MaxPool2d):
+        k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
+        s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride,) * 2
+        p = mod.padding if isinstance(mod.padding, tuple) else (mod.padding,) * 2
+        return f"MaxPool2d:{k[0]}:{k[1]}:{s[0]}:{s[1]}:{p[0]}:{p[1]}"
+    if isinstance(mod, nn.Flatten):
+        return "Flatten"
+    if isinstance(mod, nn.Dropout):
+        return f"Dropout:{mod.p}"
+    if isinstance(mod, nn.Softmax):
+        return f"Softmax:{mod.dim if mod.dim is not None else -1}"
+    if isinstance(mod, nn.LayerNorm):
+        return f"LayerNorm:{len(mod.normalized_shape)}:{mod.eps}"
+    if isinstance(mod, nn.Embedding):
+        return f"Embedding:{mod.num_embeddings}:{mod.embedding_dim}"
+    if isinstance(mod, nn.BatchNorm2d):
+        return "BatchNorm2d"
+    raise NotImplementedError(f"no text-IR spec for {type(mod).__name__}")
+
+
+def file_to_ff(path: str, ff: FFModel, input_tensors: Sequence[Tensor]) -> List[Tensor]:
+    """Rebuild an FFModel graph from the text IR (no torch needed)."""
+    env: Dict[str, Tensor] = {}
+    inputs = list(input_tensors)
+    outputs: List[Tensor] = []
+    with open(path) as f:
+        for line in f.read().splitlines():
+            if not line.strip():
+                continue
+            parts = line.split("\t")
+            kind = parts[0]
+            if kind == "input":
+                env[parts[1]] = inputs.pop(0)
+            elif kind == "output":
+                outputs = [env[n] for n in parts[1].split(",")]
+            elif kind == "module":
+                name, args, spec = parts[1], parts[2], parts[3]
+                x = env[args.split(",")[0]]
+                env[name] = _apply_spec(ff, spec, x, name)
+            elif kind in ("call_function", "call_method"):
+                name, fname, rawargs = parts[1], parts[2], parts[3]
+                args = rawargs.split(";")
+                ts = [env[a] for a in args if a in env]
+                if fname == "add":
+                    env[name] = ff.add(ts[0], ts[1]) if len(ts) > 1 else ff.scalar_add(ts[0], float(eval(args[1])))
+                elif fname == "mul":
+                    env[name] = ff.multiply(ts[0], ts[1]) if len(ts) > 1 else ff.scalar_multiply(ts[0], float(eval(args[1])))
+                elif fname == "flatten":
+                    env[name] = ff.flat(ts[0])
+                elif fname == "relu":
+                    env[name] = ff.relu(ts[0])
+                else:
+                    raise NotImplementedError(f"text-IR function {fname}")
+    return outputs
+
+
+def _apply_spec(ff: FFModel, spec: str, x: Tensor, name: str) -> Tensor:
+    parts = spec.split(":")
+    kind = parts[0]
+    if kind == "Linear":
+        return ff.dense(x, int(parts[2]), use_bias=bool(int(parts[3])), name=name)
+    if kind == "Conv2d":
+        o, kh, kw, sh, sw, ph, pw, g, b = (int(p) for p in parts[1:])
+        return ff.conv2d(x, o, kh, kw, sh, sw, ph, pw, groups=g,
+                         use_bias=bool(b), name=name)
+    if kind == "ReLU":
+        return ff.relu(x, name=name)
+    if kind == "GELU":
+        return ff.gelu(x, name=name)
+    if kind == "MaxPool2d":
+        vals = [int(p) for p in parts[1:]]
+        kh, kw, sh, sw = vals[:4]
+        ph, pw = vals[4:6] if len(vals) >= 6 else (0, 0)
+        return ff.pool2d(x, kh, kw, sh, sw, ph, pw, name=name)
+    if kind == "Flatten":
+        return ff.flat(x, name=name)
+    if kind == "Dropout":
+        return ff.dropout(x, float(parts[1]), name=name)
+    if kind == "Softmax":
+        return ff.softmax(x, axis=int(parts[1]), name=name)
+    if kind == "LayerNorm":
+        return ff.layer_norm(x, axes=tuple(range(-int(parts[1]), 0)),
+                             eps=float(parts[2]), name=name)
+    if kind == "Embedding":
+        return ff.embedding(x, int(parts[1]), int(parts[2]), name=name)
+    if kind == "BatchNorm2d":
+        return ff.batch_norm(x, relu=False, name=name)
+    raise NotImplementedError(f"text-IR spec {kind}")

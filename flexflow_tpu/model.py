@@ -73,6 +73,7 @@ class FFModel:
         self._loss_type: Optional[LossType] = None
         self._metrics: List[MetricsType] = []
         self._init_overrides: Dict[str, Dict] = {}
+        self._used_names: set = set()
         self._rng_seed = self.config.seed
         self._step_count = 0
         self.current_metrics: Optional[PerfMetrics] = None
@@ -81,7 +82,15 @@ class FFModel:
     # graph building helpers
 
     def _add(self, op_type: OpType, op_attrs, inputs: Sequence[Tensor], name: Optional[str]) -> Node:
-        node = self.graph.create_node(op_type, op_attrs, name or op_type.value)
+        name = name or op_type.value
+        # node names must be unique: strategies, weight access, and strategy
+        # export/import files are keyed by name
+        if name in self._used_names:
+            base = name
+            while name in self._used_names:
+                name = f"{base}_{self.graph.new_guid()}"
+        self._used_names.add(name)
+        node = self.graph.create_node(op_type, op_attrs, name)
         for i, t in enumerate(inputs):
             self.graph.add_edge(t.node, node, t.idx, i)
         node.outputs = tuple(
@@ -445,6 +454,16 @@ class FFModel:
             mesh_axes = {"data": len(devices)}
         self._mesh = make_mesh(mesh_axes, devices)
 
+        if strategy is None and cfg.import_strategy_file:
+            # reference --import-strategy (model.cc:3599)
+            import json as _json
+
+            from flexflow_tpu.parallel.sharding import view_from_json
+
+            with open(cfg.import_strategy_file) as f:
+                strategy = {
+                    k: view_from_json(v) for k, v in _json.load(f).items()
+                }
         if strategy is None and not cfg.only_data_parallel and cfg.search_budget > 0:
             if cfg.search_budget > 5:
                 from flexflow_tpu.search.api import graph_optimize
@@ -481,6 +500,27 @@ class FFModel:
         rng = jax.random.key(cfg.seed)
         self._params = self._executor.init_params(rng, self._init_overrides)
         self._opt_state = self._optimizer.init_state(self._params[0])
+
+        if cfg.export_strategy_file:
+            # reference --export-strategy (model.cc:3604)
+            import json as _json
+
+            from flexflow_tpu.parallel.sharding import view_to_json
+
+            with open(cfg.export_strategy_file, "w") as f:
+                _json.dump(
+                    {
+                        n.name: view_to_json(n.sharding)
+                        for n in self.graph.nodes
+                        if n.sharding is not None
+                    },
+                    f,
+                    indent=1,
+                )
+        if cfg.export_strategy_computation_graph_file:
+            # reference --compgraph dot export (model.cc:3664)
+            with open(cfg.export_strategy_computation_graph_file, "w") as f:
+                f.write(self.graph.to_dot())
         return self
 
     @property
@@ -510,16 +550,29 @@ class FFModel:
             out.append(jax.device_put(a, sh) if sh is not None else jax.device_put(a))
         return out
 
-    def fit(self, x: Union[np.ndarray, Sequence[np.ndarray]], y: np.ndarray,
-            epochs: Optional[int] = None, batch_size: Optional[int] = None,
-            verbose: bool = True):
+    def create_data_loader(self, tensor: Tensor, full_array: np.ndarray,
+                           batch_size: Optional[int] = None,
+                           shuffle: bool = False, seed: int = 0):
+        """Reference SingleDataLoader analog (flexflow_cffi.py:2433)."""
+        from flexflow_tpu.runtime.dataloader import SingleDataLoader
+
+        return SingleDataLoader(self, tensor, full_array, batch_size=batch_size,
+                                shuffle=shuffle, seed=seed)
+
+    def fit(self, x=None, y=None, epochs: Optional[int] = None,
+            batch_size: Optional[int] = None, verbose: bool = True,
+            dataloaders=None, recompile_state=None):
         """Training loop (reference flexflow_cffi.py:2044: per iteration
         next_batch -> forward -> zero_grads -> backward -> update, wrapped in
-        a Legion trace — here one jitted step call)."""
+        a Legion trace — here one jitted step call). Either pass numpy
+        arrays (x, y) or `dataloaders` = [input loaders..., label loader]
+        built via create_data_loader (prefetched host->device)."""
         import jax
 
-        xs = [x] if isinstance(x, np.ndarray) else list(x)
+        from flexflow_tpu.runtime.dataloader import PrefetchLoader
+
         epochs = epochs or self.config.epochs
+        explicit_bs = batch_size
         batch_size = batch_size or self.config.batch_size
         step = self.executor.train_step()
         tr, ntr = self._params
@@ -527,14 +580,39 @@ class FFModel:
         rng = jax.random.key(self._rng_seed + 1)
         for epoch in range(epochs):
             self.current_metrics = PerfMetrics()
-            for batch in self._batches(xs + [y], batch_size):
-                *bx, by = self._device_put_batch(batch)
+            if dataloaders is not None:
+                if explicit_bs is not None:
+                    for dl in dataloaders:
+                        dl.batch_size = explicit_bs
+                batches = iter(PrefetchLoader(self, dataloaders))
+            else:
+                xs = [x] if isinstance(x, np.ndarray) else list(x)
+                batches = (
+                    self._device_put_batch(b)
+                    for b in self._batches(xs + [y], batch_size)
+                )
+            for batch in batches:
+                *bx, by = batch
                 rng, sub = jax.random.split(rng)
                 tr, ntr, opt_state, m = step(tr, ntr, opt_state, sub, by, *bx)
                 self._step_count += 1
                 self.current_metrics.update(
-                    {k: float(v) for k, v in m.items() if k != "loss"}, batch_size
+                    {k: float(v) for k, v in m.items() if k != "loss"},
+                    by.shape[0],
                 )
+                if recompile_state is not None:
+                    # reference recompile_on_condition (model.cc:2422)
+                    from flexflow_tpu.runtime.recompile import (
+                        recompile_on_condition,
+                    )
+
+                    recompile_state.last_metrics = m
+                    self._params = (tr, ntr)
+                    self._opt_state = opt_state
+                    if recompile_on_condition(self, recompile_state):
+                        step = self.executor.train_step()
+                        tr, ntr = self._params
+                        opt_state = self._opt_state
             if verbose:
                 print(f"epoch {epoch}: {self.current_metrics.report(self._metrics)}")
         self._params = (tr, ntr)

@@ -104,6 +104,30 @@ def prune_spec(spec: Optional[Spec], shape: Tuple[int, ...], mesh) -> Optional[S
     return tuple(out)
 
 
+def view_to_json(view: Optional[ShardingView]):
+    if view is None:
+        return None
+    return {
+        "outputs": [list(map(list, s)) if s is not None else None
+                    for s in view.output_specs],
+        "weights": {k: (list(map(list, v)) if v is not None else None)
+                    for k, v in view.weight_specs.items()},
+    }
+
+
+def view_from_json(d) -> Optional[ShardingView]:
+    if d is None:
+        return None
+    outs = tuple(
+        tuple(tuple(a) for a in s) if s is not None else None for s in d["outputs"]
+    )
+    ws = {
+        k: (tuple(tuple(a) for a in v) if v is not None else None)
+        for k, v in d["weights"].items()
+    }
+    return ShardingView(outs, ws)
+
+
 def used_axes(view: ShardingView) -> Tuple[str, ...]:
     axes = []
     for spec in list(view.output_specs) + list(view.weight_specs.values()):

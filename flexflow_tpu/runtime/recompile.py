@@ -1,0 +1,46 @@
+"""Dynamic recompilation hook.
+
+Reference analog: `RecompileState` (include/flexflow/recompile.h:26-42,
+src/recompile/recompile_state.cc) + `FFModel::recompile_on_condition`
+(model.cc:2422): a user trigger function checked every iteration; when it
+fires, an alter function mutates the model (e.g. the MoE cache swap) and
+the program is rebuilt. On TPU "rebuild" means re-jitting: the executor's
+cached step functions are dropped so the next call re-traces against the
+altered graph/params.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class RecompileState:
+    def __init__(self, trigger_func: Callable[["RecompileState"], bool],
+                 alter_func: Callable[["RecompileState"], None], ffmodel):
+        self.trigger_func = trigger_func
+        self.alter_func = alter_func
+        self.ffmodel = ffmodel
+        self.recompilations = 0
+        self.last_metrics = None
+
+    def trigger(self) -> bool:
+        return bool(self.trigger_func(self))
+
+    def alter(self):
+        self.alter_func(self)
+        self.recompilations += 1
+        ex = self.ffmodel._executor
+        if ex is not None:
+            # drop jitted caches -> next call re-traces (the "recompile")
+            ex._train_step = None
+            ex._eval_step = None
+            ex._forward = None
+
+
+def recompile_on_condition(ffmodel, state: RecompileState) -> bool:
+    """Check + apply (reference model.cc:2422-2426). Returns True when a
+    recompilation happened."""
+    if state.trigger():
+        state.alter()
+        return True
+    return False

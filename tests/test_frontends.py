@@ -1,0 +1,128 @@
+"""Frontend tests: torch.fx import + weight copy numerics vs torch
+(reference tests/align analog), text IR roundtrip, keras API."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import DataType, FFConfig, FFModel, LossType, MetricsType
+from flexflow_tpu.frontends.torch_fx import PyTorchModel, file_to_ff
+
+
+class SmallCNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 8, 3, padding=1)
+        self.relu = nn.ReLU()
+        self.pool = nn.MaxPool2d(2)
+        self.flatten = nn.Flatten()
+        self.fc1 = nn.Linear(8 * 16 * 16, 32)
+        self.fc2 = nn.Linear(32, 10)
+
+    def forward(self, x):
+        x = self.pool(self.relu(self.conv1(x)))
+        x = self.flatten(x)
+        x = torch.relu(self.fc1(x))
+        return self.fc2(x)
+
+
+def test_torch_fx_import_matches_torch():
+    torch.manual_seed(0)
+    model = SmallCNN().eval()
+    ptm = PyTorchModel(model)
+    ff = FFModel(FFConfig(batch_size=4))
+    x_t = ff.create_tensor((4, 3, 32, 32), DataType.FLOAT)
+    (out,) = ptm.torch_to_ff(ff, [x_t])
+    sm = ff.softmax(out)  # single sink for compile
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    ptm.copy_weights(ff)
+    x = np.random.RandomState(0).randn(4, 3, 32, 32).astype(np.float32)
+    ours = ff.predict(x)
+    with torch.no_grad():
+        theirs = torch.softmax(model(torch.from_numpy(x)), -1).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-5)
+
+
+class ResidualMLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 16)
+        self.ln = nn.LayerNorm(16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        h = self.ln(x + torch.relu(self.fc1(x)))
+        return self.fc2(h)
+
+
+def test_torch_fx_residual_and_layernorm():
+    torch.manual_seed(1)
+    model = ResidualMLP().eval()
+    ptm = PyTorchModel(model)
+    ff = FFModel(FFConfig(batch_size=8))
+    x_t = ff.create_tensor((8, 16), DataType.FLOAT)
+    (out,) = ptm.torch_to_ff(ff, [x_t])
+    ff.softmax(out)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    ptm.copy_weights(ff)
+    x = np.random.RandomState(1).randn(8, 16).astype(np.float32)
+    ours = ff.predict(x)
+    with torch.no_grad():
+        theirs = torch.softmax(model(torch.from_numpy(x)), -1).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-5)
+
+
+def test_text_ir_roundtrip(tmp_path):
+    """torch_to_file -> file_to_ff rebuilds the same graph shape (the
+    reference's decoupled .ff workflow, README.md:8-20)."""
+    model = SmallCNN()
+    ptm = PyTorchModel(model)
+    path = str(tmp_path / "model.ff")
+    ptm.torch_to_file(path)
+
+    ff = FFModel(FFConfig(batch_size=4))
+    x_t = ff.create_tensor((4, 3, 32, 32), DataType.FLOAT)
+    (out,) = file_to_ff(path, ff, [x_t])
+    assert out.shape == (4, 10)
+
+
+def test_keras_sequential_trains():
+    from flexflow_tpu.frontends import keras
+
+    m = keras.Sequential(config=FFConfig(batch_size=32))
+    m.add_input((20,))
+    m.add(keras.Dense(64, activation="relu"))
+    m.add(keras.Dense(4))
+    m.add(keras.Activation("softmax"))
+    m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    rs = np.random.RandomState(0)
+    centers = rs.randn(4, 20) * 3
+    y = rs.randint(0, 4, 256)
+    x = (centers[y] + rs.randn(256, 20)).astype(np.float32)
+    m.fit(x, y.astype(np.int32), epochs=5, verbose=False)
+    pm = m.evaluate(x, y.astype(np.int32), verbose=False)
+    assert pm.train_correct / pm.train_all > 0.9
+    assert "dense" in m.summary().lower() or "softmax" in m.summary().lower()
+
+
+def test_keras_functional_multi_branch():
+    from flexflow_tpu.frontends import keras
+
+    a = keras.Input((8,), name="a")
+    b = keras.Input((8,), name="b")
+    da = keras.Dense(16, activation="relu")(a)
+    db = keras.Dense(16, activation="relu")(b)
+    merged = keras.Concatenate(axis=1)(da, db)
+    out = keras.Activation("softmax")(keras.Dense(3)(merged))
+    m = keras.Model(inputs=[a, b], outputs=out, config=FFConfig(batch_size=16))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    rs = np.random.RandomState(0)
+    xa = rs.randn(64, 8).astype(np.float32)
+    xb = rs.randn(64, 8).astype(np.float32)
+    y = rs.randint(0, 3, 64).astype(np.int32)
+    m.fit([xa, xb], y, epochs=2, verbose=False)
+    preds = m.predict([xa, xb])
+    assert preds.shape == (64, 3)
