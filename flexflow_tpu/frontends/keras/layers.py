@@ -19,6 +19,16 @@ _ACT = {
 }
 
 
+def _resolve_act(activation):
+    """-> (fused ActiMode, needs_softmax). Softmax is not a fused activation
+    in the op library; it becomes a trailing softmax op."""
+    if activation == "softmax":
+        return ActiMode.NONE, True
+    if activation not in _ACT:
+        raise ValueError(f"unsupported Keras activation {activation!r}")
+    return _ACT[activation], False
+
+
 class Layer:
     name: Optional[str] = None
 
@@ -75,8 +85,10 @@ class Dense(_CallableLayer):
     name: Optional[str] = None
 
     def apply(self, ff, x):
-        return ff.dense(x, self.units, _ACT[self.activation], self.use_bias,
-                        kernel_initializer=self.kernel_initializer, name=self.name)
+        act, softmax = _resolve_act(self.activation)
+        y = ff.dense(x, self.units, act, self.use_bias,
+                     kernel_initializer=self.kernel_initializer, name=self.name)
+        return ff.softmax(y) if softmax else y
 
 
 @dataclasses.dataclass
@@ -98,9 +110,10 @@ class Conv2D(_CallableLayer):
             p = (0, 0)
         else:
             p = (self.padding, self.padding)
-        return ff.conv2d(x, self.filters, k[0], k[1], s[0], s[1], p[0], p[1],
-                         _ACT[self.activation], use_bias=self.use_bias,
-                         name=self.name)
+        act, softmax = _resolve_act(self.activation)
+        y = ff.conv2d(x, self.filters, k[0], k[1], s[0], s[1], p[0], p[1],
+                      act, use_bias=self.use_bias, name=self.name)
+        return ff.softmax(y) if softmax else y
 
 
 @dataclasses.dataclass

@@ -78,7 +78,7 @@ class ONNXModel:
                 )
             elif op in ("MaxPool", "AveragePool"):
                 k = attr(node, "kernel_shape")
-                s = attr(node, "strides", k)
+                s = attr(node, "strides", [1, 1])  # ONNX default is 1 per axis
                 p = attr(node, "pads", [0, 0, 0, 0])
                 env[node.output[0]] = ff.pool2d(
                     env[node.input[0]], k[0], k[1], s[0], s[1], p[0], p[1],
@@ -123,11 +123,15 @@ class ONNXModel:
                 env[node.output[0]] = ff.flat(env[node.input[0]], name=name)
             elif op == "Reshape":
                 shape_init = inits[node.input[1]]
-                shape = list(np.frombuffer(shape_init.raw_data, dtype=np.int64))
+                shape = [int(s) for s in
+                         np.frombuffer(shape_init.raw_data, dtype=np.int64)]
                 x = env[node.input[0]]
+                # ONNX: 0 copies the corresponding input dim, -1 is inferred
+                shape = [x.shape[i] if s == 0 else s
+                         for i, s in enumerate(shape)]
                 total = int(np.prod(x.shape))
-                known = int(np.prod([s for s in shape if s > 0]))
-                shape = [total // known if s == -1 else int(s) for s in shape]
+                known = int(np.prod([s for s in shape if s != -1]))
+                shape = [total // known if s == -1 else s for s in shape]
                 env[node.output[0]] = ff.reshape(x, shape, name=name)
             elif op == "Dropout":
                 env[node.output[0]] = ff.dropout(

@@ -22,6 +22,19 @@ from flexflow_tpu.ffconst import ActiMode, DataType, PoolType
 from flexflow_tpu.model import FFModel, Tensor
 
 
+def _flatten_dims(ff: FFModel, x: Tensor, start: int, end: int,
+                  name: Optional[str] = None) -> Tensor:
+    """torch.flatten(x, start_dim, end_dim) semantics via reshape."""
+    nd = len(x.shape)
+    start, end = start % nd, end % nd
+    if start == 1 and end == nd - 1:
+        return ff.flat(x, name=name)
+    shape = (list(x.shape[:start])
+             + [int(np.prod(x.shape[start:end + 1]))]
+             + list(x.shape[end + 1:]))
+    return ff.reshape(x, shape, name=name)
+
+
 def _act(ff: FFModel, t: Tensor, mod) -> Tensor:
     import torch.nn as nn
 
@@ -45,8 +58,10 @@ class PyTorchModel:
 
         self.model = model
         self.traced = torch.fx.symbolic_trace(model)
-        # fx node name -> ff node name (for weight copy)
-        self._name_map: Dict[str, str] = {}
+        # module path -> ALL ff node names it lowered to (a module called at
+        # several sites becomes several FF layers; copy_weights fills each).
+        # Note: the copies are not tied for training — updates diverge.
+        self._name_map: Dict[str, List[str]] = {}
 
     # ------------------------------------------------------------------
 
@@ -104,33 +119,36 @@ class PyTorchModel:
 
     # ------------------------------------------------------------------
 
+    def _record(self, target: str, t: Tensor) -> Tensor:
+        # the layer call may have deduped the requested name; record the
+        # final node name so copy_weights hits the right layer(s)
+        self._name_map.setdefault(target, []).append(t.node.name)
+        return t
+
     def _lower_module(self, ff: FFModel, node, mod, x: Tensor) -> Tensor:
         import torch.nn as nn
 
         name = node.target.replace(".", "_")
         if isinstance(mod, nn.Linear):
-            self._name_map[node.target] = name
-            return ff.dense(x, mod.out_features, use_bias=mod.bias is not None,
-                            name=name)
+            return self._record(node.target, ff.dense(
+                x, mod.out_features, use_bias=mod.bias is not None, name=name))
         if isinstance(mod, nn.Conv2d):
-            self._name_map[node.target] = name
-            return ff.conv2d(
+            return self._record(node.target, ff.conv2d(
                 x, mod.out_channels, *mod.kernel_size,
                 stride_h=mod.stride[0], stride_w=mod.stride[1],
                 padding_h=mod.padding[0], padding_w=mod.padding[1],
                 groups=mod.groups, use_bias=mod.bias is not None, name=name,
-            )
+            ))
         if isinstance(mod, nn.Embedding):
-            self._name_map[node.target] = name
-            return ff.embedding(x, mod.num_embeddings, mod.embedding_dim, name=name)
+            return self._record(node.target, ff.embedding(
+                x, mod.num_embeddings, mod.embedding_dim, name=name))
         if isinstance(mod, nn.BatchNorm2d):
-            self._name_map[node.target] = name
-            return ff.batch_norm(x, relu=False, name=name)
+            return self._record(node.target, ff.batch_norm(x, relu=False, name=name))
         if isinstance(mod, nn.LayerNorm):
-            self._name_map[node.target] = name
-            return ff.layer_norm(x, axes=tuple(range(-len(mod.normalized_shape), 0)),
-                                 elementwise_affine=mod.elementwise_affine,
-                                 eps=mod.eps, name=name)
+            return self._record(node.target, ff.layer_norm(
+                x, axes=tuple(range(-len(mod.normalized_shape), 0)),
+                elementwise_affine=mod.elementwise_affine,
+                eps=mod.eps, name=name))
         if isinstance(mod, nn.MaxPool2d):
             k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
             s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride,) * 2
@@ -140,7 +158,9 @@ class PyTorchModel:
         if isinstance(mod, nn.AvgPool2d):
             k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
             s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride,) * 2
-            return ff.pool2d(x, k[0], k[1], s[0], s[1], 0, 0, PoolType.AVG, name=name)
+            p = mod.padding if isinstance(mod.padding, tuple) else (mod.padding,) * 2
+            return ff.pool2d(x, k[0], k[1], s[0], s[1], p[0], p[1], PoolType.AVG,
+                             name=name)
         if isinstance(mod, nn.AdaptiveAvgPool2d):
             out = mod.output_size if isinstance(mod.output_size, tuple) else (mod.output_size,) * 2
             h, w = x.shape[2], x.shape[3]
@@ -151,7 +171,7 @@ class PyTorchModel:
         if isinstance(mod, nn.Dropout):
             return ff.dropout(x, mod.p, name=name)
         if isinstance(mod, nn.Flatten):
-            return ff.flat(x, name=name)
+            return _flatten_dims(ff, x, mod.start_dim, mod.end_dim, name=name)
         if isinstance(mod, nn.Softmax):
             return ff.softmax(x, axis=mod.dim if mod.dim is not None else -1, name=name)
         if isinstance(mod, nn.Identity):
@@ -205,7 +225,9 @@ class PyTorchModel:
         if fn in (torch.tanh, F.tanh):
             return ff.tanh(a[0])
         if fn in (torch.flatten,):
-            return ff.flat(a[0])
+            start = a[1] if len(a) > 1 else node.kwargs.get("start_dim", 0)
+            end = a[2] if len(a) > 2 else node.kwargs.get("end_dim", -1)
+            return _flatten_dims(ff, a[0], int(start), int(end))
         if fn in (torch.cat,):
             axis = node.kwargs.get("dim", 0)
             if len(node.args) > 1:
@@ -239,7 +261,9 @@ class PyTorchModel:
             shape = [total // known if s == -1 else s for s in shape]
             return ff.reshape(x, shape)
         if m == "flatten":
-            return ff.flat(x)
+            start = a[1] if len(a) > 1 else node.kwargs.get("start_dim", 0)
+            end = a[2] if len(a) > 2 else node.kwargs.get("end_dim", -1)
+            return _flatten_dims(ff, x, int(start), int(end))
         if m == "transpose":
             d0, d1 = a[1], a[2]
             perm = list(range(len(x.shape)))
@@ -260,26 +284,29 @@ class PyTorchModel:
         """Push the torch module's trained weights into the compiled model."""
         import torch.nn as nn
 
-        for target, ff_name in self._name_map.items():
+        for target, ff_names in self._name_map.items():
             mod = self.traced.get_submodule(target)
-            if isinstance(mod, nn.Linear):
-                ff.set_weight(ff_name, mod.weight.detach().numpy().T, "kernel")
-                if mod.bias is not None:
+            for ff_name in ff_names:
+                if isinstance(mod, nn.Linear):
+                    ff.set_weight(ff_name, mod.weight.detach().numpy().T, "kernel")
+                    if mod.bias is not None:
+                        ff.set_weight(ff_name, mod.bias.detach().numpy(), "bias")
+                elif isinstance(mod, nn.Conv2d):
+                    ff.set_weight(ff_name, mod.weight.detach().numpy(), "kernel")
+                    if mod.bias is not None:
+                        ff.set_weight(ff_name, mod.bias.detach().numpy(), "bias")
+                elif isinstance(mod, nn.Embedding):
+                    ff.set_weight(ff_name, mod.weight.detach().numpy(), "kernel")
+                elif isinstance(mod, nn.LayerNorm):
+                    ff.set_weight(ff_name, mod.weight.detach().numpy(), "scale")
                     ff.set_weight(ff_name, mod.bias.detach().numpy(), "bias")
-            elif isinstance(mod, nn.Conv2d):
-                ff.set_weight(ff_name, mod.weight.detach().numpy(), "kernel")
-                if mod.bias is not None:
+                elif isinstance(mod, nn.BatchNorm2d):
+                    ff.set_weight(ff_name, mod.weight.detach().numpy(), "scale")
                     ff.set_weight(ff_name, mod.bias.detach().numpy(), "bias")
-            elif isinstance(mod, nn.Embedding):
-                ff.set_weight(ff_name, mod.weight.detach().numpy(), "kernel")
-            elif isinstance(mod, nn.LayerNorm):
-                ff.set_weight(ff_name, mod.weight.detach().numpy(), "scale")
-                ff.set_weight(ff_name, mod.bias.detach().numpy(), "bias")
-            elif isinstance(mod, nn.BatchNorm2d):
-                ff.set_weight(ff_name, mod.weight.detach().numpy(), "scale")
-                ff.set_weight(ff_name, mod.bias.detach().numpy(), "bias")
-                ff.set_weight(ff_name, mod.running_mean.detach().numpy(), "running_mean")
-                ff.set_weight(ff_name, mod.running_var.detach().numpy(), "running_var")
+                    ff.set_weight(ff_name, mod.running_mean.detach().numpy(),
+                                  "running_mean")
+                    ff.set_weight(ff_name, mod.running_var.detach().numpy(),
+                                  "running_var")
 
     # ------------------------------------------------------------------
     # text IR (reference torch_to_file/file_to_ff, torch/model.py:2597,2540)
@@ -369,13 +396,20 @@ def file_to_ff(path: str, ff: FFModel, input_tensors: Sequence[Tensor]) -> List[
                 x = env[args.split(",")[0]]
                 env[name] = _apply_spec(ff, spec, x, name)
             elif kind in ("call_function", "call_method"):
+                import ast
+
                 name, fname, rawargs = parts[1], parts[2], parts[3]
                 args = rawargs.split(";")
                 ts = [env[a] for a in args if a in env]
+                # scalar operand may come before or after the tensor; parse
+                # with literal_eval (never eval untrusted IR files)
+                scalars = [ast.literal_eval(a) for a in args if a not in env]
                 if fname == "add":
-                    env[name] = ff.add(ts[0], ts[1]) if len(ts) > 1 else ff.scalar_add(ts[0], float(eval(args[1])))
+                    env[name] = (ff.add(ts[0], ts[1]) if len(ts) > 1
+                                 else ff.scalar_add(ts[0], float(scalars[0])))
                 elif fname == "mul":
-                    env[name] = ff.multiply(ts[0], ts[1]) if len(ts) > 1 else ff.scalar_multiply(ts[0], float(eval(args[1])))
+                    env[name] = (ff.multiply(ts[0], ts[1]) if len(ts) > 1
+                                 else ff.scalar_multiply(ts[0], float(scalars[0])))
                 elif fname == "flatten":
                     env[name] = ff.flat(ts[0])
                 elif fname == "relu":

@@ -96,7 +96,9 @@ def _embedding(attrs, inputs, params, ctx):
         out = out.sum(axis=-2)
     elif attrs.aggr == AggrMode.AVG:
         out = out.mean(axis=-2)
-    return [out]
+    # masters are fp32; the op's declared dtype sets the activation dtype for
+    # everything downstream (bf16 compute on the MXU)
+    return [out.astype(attrs.dtype.jnp_dtype)]
 
 
 @register_lowering(OpType.BATCH_MATMUL)
@@ -156,6 +158,24 @@ def _dot_product_attention(q, k, v, causal: bool, scale: float,
     return out.astype(q.dtype)
 
 
+def fused_attention(q, k, v, *, causal, scale, dropout=0.0, dropout_rng=None,
+                    mesh=None):
+    """Dispatch: Pallas flash kernel on TPU when shapes/config allow (and the
+    program is single-device — a pallas_call does not partition under GSPMD),
+    XLA dot-product attention otherwise."""
+    from flexflow_tpu.ops.pallas import (
+        flash_attention,
+        flash_attention_available,
+    )
+
+    single = mesh is None or getattr(mesh, "size", 1) == 1
+    if single and flash_attention_available(q.shape[1], k.shape[1],
+                                            dropout=dropout):
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return _dot_product_attention(q, k, v, causal, scale,
+                                  dropout_rate=dropout, dropout_rng=dropout_rng)
+
+
 @register_lowering(OpType.MULTIHEAD_ATTENTION)
 def _mha(attrs, inputs, params, ctx):
     q_in = inputs[0]
@@ -174,9 +194,10 @@ def _mha(attrs, inputs, params, ctx):
         q = apply_rope(q, attrs.rope_theta)
         k = apply_rope(k, attrs.rope_theta)
     drop_rng = ctx.rng if (ctx.training and attrs.dropout > 0.0) else None
-    out = _dot_product_attention(
-        q, k, v, attrs.causal, 1.0 / (hd**0.5),
-        dropout_rate=attrs.dropout if ctx.training else 0.0, dropout_rng=drop_rng,
+    out = fused_attention(
+        q, k, v, causal=attrs.causal, scale=1.0 / (hd**0.5),
+        dropout=attrs.dropout if ctx.training else 0.0, dropout_rng=drop_rng,
+        mesh=ctx.mesh,
     )
     y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
     if attrs.use_bias:

@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference implements its hot ops as hand-written CUDA kernels
+(src/ops/kernels/*.cu, SURVEY.md §2.2); on TPU most ops are best left to
+XLA fusion, but attention benefits from a blockwise flash kernel that never
+materializes the S×S score matrix in HBM. These kernels are selected by the
+attention lowerings when running on a TPU backend and shapes allow;
+otherwise the XLA fallback in flexflow_tpu.ops.jax_ops is used.
+"""
+
+from flexflow_tpu.ops.pallas.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_available,
+)

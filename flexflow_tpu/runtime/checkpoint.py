@@ -18,10 +18,15 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 
+# tree-path separator: node names may contain '/' (e.g. ONNX node names), so
+# join with a control char that cannot appear in names
+_SEP = "\x1f"
+
+
 def _flatten(tree: Dict, prefix: str = "") -> Dict[str, Any]:
     out = {}
     for k, v in tree.items():
-        key = f"{prefix}/{k}" if prefix else str(k)
+        key = f"{prefix}{_SEP}{k}" if prefix else str(k)
         if isinstance(v, dict):
             out.update(_flatten(v, key))
         else:
@@ -32,7 +37,7 @@ def _flatten(tree: Dict, prefix: str = "") -> Dict[str, Any]:
 def _unflatten(flat: Dict[str, Any]) -> Dict:
     out: Dict = {}
     for k, v in flat.items():
-        parts = k.split("/")
+        parts = k.split(_SEP)
         d = out
         for p in parts[:-1]:
             d = d.setdefault(p, {})

@@ -126,3 +126,86 @@ def test_keras_functional_multi_branch():
     m.fit([xa, xb], y, epochs=2, verbose=False)
     preds = m.predict([xa, xb])
     assert preds.shape == (64, 3)
+
+
+class SharedBlock(nn.Module):
+    """One Linear reused at two call sites (weight sharing)."""
+
+    def __init__(self):
+        super().__init__()
+        self.shared = nn.Linear(12, 12)
+
+    def forward(self, x):
+        return self.shared(torch.relu(self.shared(x)))
+
+
+def test_torch_fx_shared_module_weight_copy():
+    torch.manual_seed(2)
+    model = SharedBlock().eval()
+    ptm = PyTorchModel(model)
+    ff = FFModel(FFConfig(batch_size=4))
+    x_t = ff.create_tensor((4, 12), DataType.FLOAT)
+    (out,) = ptm.torch_to_ff(ff, [x_t])
+    ff.softmax(out)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    ptm.copy_weights(ff)  # must fill BOTH lowered copies
+    x = np.random.RandomState(2).randn(4, 12).astype(np.float32)
+    ours = ff.predict(x)
+    with torch.no_grad():
+        theirs = torch.softmax(model(torch.from_numpy(x)), -1).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-5)
+
+
+class FlattenDims(nn.Module):
+    def forward(self, x):
+        return x.flatten(2)  # (B, C, H, W) -> (B, C, H*W)
+
+
+def test_torch_fx_partial_flatten():
+    model = FlattenDims().eval()
+    ptm = PyTorchModel(model)
+    ff = FFModel(FFConfig(batch_size=2))
+    x_t = ff.create_tensor((2, 3, 4, 5), DataType.FLOAT)
+    (out,) = ptm.torch_to_ff(ff, [x_t])
+    assert out.shape == (2, 3, 20)
+
+
+class PaddedAvgPool(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.pool = nn.AvgPool2d(3, stride=1, padding=1)
+
+    def forward(self, x):
+        return self.pool(x)
+
+
+def test_torch_fx_avgpool_padding_kept():
+    model = PaddedAvgPool().eval()
+    ptm = PyTorchModel(model)
+    ff = FFModel(FFConfig(batch_size=2))
+    x_t = ff.create_tensor((2, 3, 8, 8), DataType.FLOAT)
+    (out,) = ptm.torch_to_ff(ff, [x_t])
+    assert out.shape == (2, 3, 8, 8)  # padding=1 keeps spatial size
+
+
+def test_keras_dense_softmax_activation():
+    from flexflow_tpu.frontends import keras
+
+    m = keras.Sequential(config=FFConfig(batch_size=8))
+    m.add_input((6,))
+    m.add(keras.Dense(3, activation="softmax"))
+    m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    x = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+    p = m.predict(x)
+    np.testing.assert_allclose(p.sum(-1), np.ones(8), rtol=1e-5)
+
+
+def test_keras_unknown_activation_raises():
+    from flexflow_tpu.frontends import keras
+
+    m = keras.Sequential(config=FFConfig(batch_size=8))
+    m.add_input((6,))
+    m.add(keras.Dense(3, activation="sofmax"))  # typo'd name
+    with pytest.raises((ValueError, KeyError)):
+        # layers apply lazily at compile time
+        m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")

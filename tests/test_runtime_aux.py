@@ -146,3 +146,26 @@ def test_recompile_on_condition():
     d0 = [n for n in ff.graph.nodes if n.name == "d0"][0]
     from flexflow_tpu.ffconst import ActiMode
     assert d0.attrs.activation == ActiMode.GELU
+
+
+def test_checkpoint_name_with_slash(tmp_path):
+    """ONNX-style node names contain '/'; the tree separator must not split
+    on them (regression: restore used to fail with KeyError)."""
+    import flexflow_tpu as fx
+    from flexflow_tpu.runtime.checkpoint import restore_checkpoint, save_checkpoint
+
+    def build():
+        ff = fx.FFModel(fx.FFConfig(batch_size=4))
+        x = ff.create_tensor((4, 8), fx.DataType.FLOAT)
+        h = ff.dense(x, 8, name="/enc/fc1")
+        ff.softmax(ff.dense(h, 3, name="/enc/fc2"))
+        ff.compile(optimizer=fx.SGDOptimizer(lr=0.1),
+                   loss_type=fx.LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+        return ff
+
+    ff = build()
+    w = ff.get_weight("/enc/fc1")
+    save_checkpoint(str(tmp_path / "ck"), ff)
+    ff2 = build()
+    restore_checkpoint(str(tmp_path / "ck"), ff2)
+    np.testing.assert_allclose(ff2.get_weight("/enc/fc1"), w)
