@@ -39,7 +39,9 @@ class FFConfig:
     # ---- strategy search (reference model.cc:3599-3719 flags) ----
     search_budget: int = 0
     search_alpha: float = 1.05
-    only_data_parallel: bool = True
+    # search already requires search_budget > 0; this flag force-disables it
+    # (reference --only-data-parallel, model.cc:3609 — off by default there too)
+    only_data_parallel: bool = False
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
     memory_search: bool = False

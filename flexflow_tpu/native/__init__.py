@@ -1,0 +1,147 @@
+"""ctypes loader for the native search engine (native/ffsim.cc).
+
+The reference's search/simulator layer is C++ (src/runtime/simulator.cc,
+model.cc mcmc); ours is too — Python prices (node, view) pairs with the
+analytic TPU cost model, and libffsim owns the hot loops. The library is
+built on demand with g++ (no pybind11 in this image; plain C ABI +
+ctypes). Everything degrades gracefully to the pure-Python path when no
+compiler is available: callers must check `available()`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_PKG_DIR, "..", "..", "native", "ffsim.cc")
+_LIB_PATH = os.path.join(_PKG_DIR, "libffsim.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return False
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src):
+        return True
+    # compile to a temp path and atomically swap in, so a concurrent
+    # process never dlopens a partially written .so
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-o", tmp, src],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, _LIB_PATH)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    dp = ctypes.POINTER(ctypes.c_double)
+    ip = ctypes.POINTER(ctypes.c_int)
+    lib.ffsim_create.restype = ctypes.c_void_p
+    lib.ffsim_create.argtypes = [ctypes.c_int]
+    lib.ffsim_destroy.argtypes = [ctypes.c_void_p]
+    lib.ffsim_set_node.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                                   dp, dp, dp, dp]
+    lib.ffsim_add_edge.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, dp]
+    lib.ffsim_eval.restype = ctypes.c_double
+    lib.ffsim_eval.argtypes = [ctypes.c_void_p, ip, ctypes.c_double, dp]
+    lib.ffsim_simulate.restype = ctypes.c_double
+    lib.ffsim_simulate.argtypes = [ctypes.c_void_p, ip]
+    lib.ffsim_mcmc.restype = ctypes.c_int
+    lib.ffsim_mcmc.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_double,
+                               ctypes.c_uint64, ctypes.c_double, ctypes.c_double,
+                               ctypes.c_int, ip, dp]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("FLEXFLOW_NATIVE", "1") == "0":
+        return None
+    if _build():
+        try:
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+class NativeSimGraph:
+    """Owns one ffsim graph handle; rows are (node, view) cost tables."""
+
+    def __init__(self, n_nodes: int):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError("native ffsim library unavailable")
+        self._h = self._lib.ffsim_create(n_nodes)
+        self.n_nodes = n_nodes
+        self._n_views = [0] * n_nodes
+
+    def __del__(self):
+        if getattr(self, "_h", None) and self._lib is not None:
+            self._lib.ffsim_destroy(self._h)
+            self._h = None
+
+    @staticmethod
+    def _darr(vals):
+        return (ctypes.c_double * len(vals))(*vals)
+
+    def set_node(self, node, compute, comm, sync, memory):
+        n = len(compute)
+        assert len(comm) == len(sync) == len(memory) == n
+        self._n_views[node] = n
+        self._lib.ffsim_set_node(
+            self._h, node, n, self._darr(compute), self._darr(comm),
+            self._darr(sync), self._darr(memory),
+        )
+
+    def add_edge(self, src, dst, xfer_matrix):
+        flat = [x for row in xfer_matrix for x in row]
+        assert len(flat) == self._n_views[src] * self._n_views[dst]
+        self._lib.ffsim_add_edge(self._h, src, dst, self._darr(flat))
+
+    def _iarr(self, assignment):
+        assert len(assignment) == self.n_nodes
+        return (ctypes.c_int * self.n_nodes)(*assignment)
+
+    def eval(self, assignment, overlap: float = 0.0):
+        mem = ctypes.c_double()
+        t = self._lib.ffsim_eval(self._h, self._iarr(assignment), overlap,
+                                 ctypes.byref(mem))
+        return t, mem.value
+
+    def simulate(self, assignment) -> float:
+        return self._lib.ffsim_simulate(self._h, self._iarr(assignment))
+
+    def mcmc(self, assignment, *, budget: int, alpha: float, seed: int = 0,
+             overlap: float = 0.0, memory_limit: float = 0.0,
+             use_simulate: bool = False):
+        arr = self._iarr(assignment)
+        best_cost = ctypes.c_double()
+        accepted = self._lib.ffsim_mcmc(
+            self._h, budget, alpha, seed, overlap, memory_limit,
+            1 if use_simulate else 0, arr, ctypes.byref(best_cost),
+        )
+        return list(arr), best_cost.value, accepted

@@ -4,6 +4,10 @@ Reference analog: FFModel::mcmc_optimize (model.cc:3285-3356): start from
 data parallel, propose "random op -> random legal config", accept improving
 moves always and worsening moves with prob exp(-alpha * diff), track the
 best strategy seen within the budget.
+
+The hot loop runs in the native C++ engine (native/ffsim.cc) when the
+library is available — the reference's search is C++ for the same reason —
+with a pure-Python fallback evaluating the identical cost tables.
 """
 
 from __future__ import annotations
@@ -15,8 +19,8 @@ from typing import Dict, Optional
 from flexflow_tpu.parallel.sharding import ShardingView
 from flexflow_tpu.pcg.graph import Graph
 from flexflow_tpu.search import space
-from flexflow_tpu.search.cost_model import CostModel, graph_cost
-from flexflow_tpu.search.machine_model import TPUMachineModel
+from flexflow_tpu.search.cost_model import CostModel
+from flexflow_tpu.search.table import build_table
 
 
 def mcmc_optimize(
@@ -29,8 +33,8 @@ def mcmc_optimize(
     training: bool = True,
     memory_limit: Optional[float] = None,
     verbose: bool = False,
+    use_simulate: bool = False,
 ) -> Dict[str, ShardingView]:
-    rng = random.Random(seed)
     axis_sizes = cost.axis_sizes
 
     candidates = {}
@@ -38,35 +42,63 @@ def mcmc_optimize(
         views = space.enumerate_views(node, axis_sizes)
         if len(views) > 1:
             candidates[node.name] = views
+    base = space.default_dp_strategy(graph, axis_sizes)
     if not candidates:
-        return space.default_dp_strategy(graph, axis_sizes)
+        return base
 
-    current = space.default_dp_strategy(graph, axis_sizes)
-    names = list(candidates)
+    table = build_table(graph, cost, candidates, base, training)
+    start = [0] * len(table.nodes)
 
-    def evaluate(strategy):
-        gc = graph_cost(graph, strategy, cost, training)
-        t = gc.time
-        if memory_limit is not None and gc.memory_per_chip > memory_limit:
-            t += 1e3 * (gc.memory_per_chip / memory_limit)  # strong penalty
+    from flexflow_tpu import native
+
+    if native.available():
+        g = table.to_native()
+        best_assign, best_cost, _ = g.mcmc(
+            start, budget=budget, alpha=alpha, seed=seed,
+            memory_limit=memory_limit or 0.0, use_simulate=use_simulate,
+        )
+        if verbose:
+            print(f"mcmc (native): best {best_cost * 1e3:.3f} ms")
+        return table.to_strategy(best_assign)
+
+    # ---- pure-Python fallback over the same tables --------------------
+    rng = random.Random(seed)
+    searchable = table.searchable()
+
+    if use_simulate:
+        raise NotImplementedError(
+            "use_simulate requires the native engine (libffsim failed to "
+            "build); the Python fallback only evaluates the summed cost"
+        )
+
+    def evaluate(a):
+        t, mem = table.eval(a)
+        # match the native sentinel: a limit of 0 (or None) disables the check
+        if memory_limit and mem > memory_limit:
+            t += 1e3 * (mem / memory_limit)
         return t
 
-    cur_cost = evaluate(current)
-    best, best_cost = dict(current), cur_cost
+    cur = list(start)
+    cur_cost = evaluate(cur)
+    best, best_cost = list(cur), cur_cost
     for it in range(budget):
-        name = rng.choice(names)
-        view = rng.choice(candidates[name])
-        nxt = dict(current)
-        nxt[name] = view
-        nxt_cost = evaluate(nxt)
+        i = rng.choice(searchable)
+        k = rng.randrange(len(table.views[i]))
+        if k == cur[i]:
+            continue
+        prev = cur[i]
+        cur[i] = k
+        nxt_cost = evaluate(cur)
         diff = nxt_cost - cur_cost
         if diff < 0 or rng.random() < math.exp(-alpha * diff / max(cur_cost, 1e-12) * 100):
-            current, cur_cost = nxt, nxt_cost
+            cur_cost = nxt_cost
             if cur_cost < best_cost:
-                best, best_cost = dict(current), cur_cost
+                best, best_cost = list(cur), cur_cost
                 if verbose:
                     print(f"mcmc iter {it}: best {best_cost * 1e3:.3f} ms")
-    return best
+        else:
+            cur[i] = prev
+    return table.to_strategy(best)
 
 
 def mcmc_search(graph: Graph, mesh, config) -> Dict[str, ShardingView]:
