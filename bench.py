@@ -9,9 +9,17 @@ JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
+
+_T0 = time.time()
+
+
+def _log(msg: str) -> None:
+    """Phase progress on stderr (stdout carries only the JSON line)."""
+    print(f"[bench +{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
 def _llama_cfg():
@@ -34,14 +42,58 @@ def _sync(out):
 
 
 def _time_steps(step_fn, *, iters=ITERS, warmup=WARMUP):
+    _log("warmup/compile start")
     for _ in range(warmup):
         out = step_fn()
     _sync(out)
+    _log("warmup done; timing")
     t0 = time.perf_counter()
     for _ in range(iters):
         out = step_fn()
     _sync(out)
-    return (time.perf_counter() - t0) / iters
+    dt = (time.perf_counter() - t0) / iters
+    _log(f"timed {iters} steps @ {dt * 1e3:.1f} ms/step")
+    return dt
+
+
+def _flops_per_token(cfg, seq: int) -> float:
+    """Analytic matmul FLOPs per trained token (fwd+bwd = 3× fwd matmul
+    FLOPs; causal attention counted at half density). Mirrors the
+    reference's measure-everything discipline (simulator.cc:537) as a model."""
+    hd = cfg.dim // cfg.heads
+    per_layer = (
+        cfg.dim * cfg.heads * hd          # wq
+        + 2 * cfg.dim * cfg.kv_heads * hd  # wk, wv
+        + cfg.heads * hd * cfg.dim         # wo
+        + 3 * cfg.dim * cfg.hidden         # gate, up, down
+    )
+    n_matmul = cfg.layers * per_layer + cfg.dim * cfg.vocab_size  # + lm_head
+    # per token: 2 flops/MAC × 3 (fwd+bwd) = 6 × params touched by matmuls
+    dense = 6.0 * n_matmul
+    # attention: QK^T + PV are each seq×dim MACs/token; ×2 flops ×3 fwd+bwd
+    # ×0.5 causal
+    attn = 6.0 * cfg.layers * seq * cfg.dim
+    return dense + attn
+
+
+def _peak_flops() -> float:
+    """Best-effort bf16 peak of the whole local machine (all chips — the
+    bench throughput spans every device the framework uses)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    table = {
+        "v5 lite": 197e12, "v5e": 197e12,
+        "v5p": 459e12, "v5": 459e12,
+        "v4": 275e12,
+        "v6 lite": 918e12, "v6e": 918e12,
+    }
+    per_chip = 197e12
+    for k, v in table.items():
+        if k in kind:
+            per_chip = v
+            break
+    return per_chip * len(jax.devices())
 
 
 def bench_framework(x, y) -> float:
@@ -50,10 +102,14 @@ def bench_framework(x, y) -> float:
 
     import jax
 
-    ff = FFModel(FFConfig(batch_size=BATCH))
+    # no remat: at ~200M params / batch 8 everything fits in HBM, and the
+    # baseline gets the identical setting (none) — no handicap either way
+    _log("framework: building model")
+    ff = FFModel(FFConfig(batch_size=BATCH, remat="none"))
     build_llama(ff, _llama_cfg(), seq_len=SEQ)
     ff.compile(optimizer=AdamOptimizer(lr=1e-4),
                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    _log("framework: compiled model/params")
     step = ff.executor.train_step()
     tr, ntr = ff._params
     opt = ff._opt_state
@@ -117,8 +173,6 @@ def bench_naive(x, y) -> float:
         return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
                                -1).astype(x.dtype)
 
-    # per-layer remat, matching the framework's attention-remat setting
-    @jax.checkpoint
     def layer(L, h):
         a = rms(h, L["ln1"])
         q = rope(jnp.einsum("bse,ehd->bshd", a, L["wq"].astype(jnp.bfloat16)))
@@ -169,6 +223,7 @@ def bench_naive(x, y) -> float:
         )
         return p, m, v, t
 
+    _log("naive: init params")
     rng = jax.random.key(0)
     p = jax.jit(init)(rng)
     m = jax.tree.map(jnp.zeros_like, p)
@@ -194,11 +249,14 @@ def main():
     y = np.roll(x, -1, axis=1).astype(np.int32)
     fw = bench_framework(x, y)
     nv = bench_naive(x, y)
+    mfu = fw * _flops_per_token(_llama_cfg(), SEQ) / _peak_flops()
     print(json.dumps({
         "metric": "llama_200m_train_tokens_per_sec",
         "value": round(fw, 1),
         "unit": "tokens/s",
         "vs_baseline": round(fw / nv, 4),
+        "mfu": round(mfu, 4),
+        "baseline_tokens_per_sec": round(nv, 1),
     }))
 
 

@@ -42,10 +42,17 @@ class FFConfig:
     # search already requires search_budget > 0; this flag force-disables it
     # (reference --only-data-parallel, model.cc:3609 — off by default there too)
     only_data_parallel: bool = False
-    enable_parameter_parallel: bool = False
-    enable_attribute_parallel: bool = False
+    # SOAP dimension gates for the search space (reference
+    # --enable-parameter-parallel / --enable-attribute-parallel,
+    # model.cc:3613-3617). The reference defaults these off; TPU-native
+    # default is on — weight/head sharding is the normal operating mode,
+    # set False to restrict the search to sample parallelism.
+    enable_parameter_parallel: bool = True
+    enable_attribute_parallel: bool = True
     memory_search: bool = False
-    search_num_devices: Optional[int] = None  # search for a bigger machine
+    # search for a machine bigger than the one running (reference
+    # --search-num-workers, model.cc:3692); extra chips extend `data`
+    search_num_devices: Optional[int] = None
     machine_model_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
     export_strategy_file: Optional[str] = None

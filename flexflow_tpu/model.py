@@ -23,6 +23,7 @@ from flexflow_tpu.ffconst import (
     LossType,
     MetricsType,
     OpType,
+    ParamSyncType,
     PoolType,
 )
 from flexflow_tpu.ops import attrs as A
@@ -447,6 +448,21 @@ class FFModel:
 
         self.graph.infer_shapes()
 
+        if cfg.perform_fusion:
+            # reference --fusion / apply_fusion (model.cc:2965): fold
+            # fusable op pairs into one PCG node before search/lowering.
+            # XLA fuses kernels regardless; this shrinks the searched graph.
+            from flexflow_tpu.search.substitution import (
+                make_fuse_linear_activation,
+            )
+
+            xf = make_fuse_linear_activation()
+            while True:
+                cands = xf.apply_all(self.graph)
+                if not cands:
+                    break
+                self.graph = cands[0]
+
         devices = cfg.devices
         if cfg.mesh_shape:
             mesh_axes = dict(cfg.mesh_shape)
@@ -473,6 +489,13 @@ class FFModel:
                 from flexflow_tpu.search.api import search_strategy
 
                 strategy = search_strategy(self.graph, self._mesh, cfg)
+            # multi-host: every process must lower the identical strategy;
+            # ship process 0's search result to all (the reference
+            # serializes the optimized PCG to every rank, graph.cc:2162)
+            from flexflow_tpu.runtime import distributed as dist
+
+            if dist.is_multi_host():
+                strategy = dist.broadcast_strategy(strategy, self._mesh)
 
         # default DP: shard every INPUT's batch dim over "data"; explicit
         # strategy views override per node name
@@ -496,10 +519,13 @@ class FFModel:
             seq_length=cfg.seq_length,
             donate=cfg.donate_buffers,
             remat=cfg.remat,
+            zero_sharded_opt=cfg.param_sync == ParamSyncType.SHARDED,
         )
         rng = jax.random.key(cfg.seed)
         self._params = self._executor.init_params(rng, self._init_overrides)
-        self._opt_state = self._optimizer.init_state(self._params[0])
+        self._opt_state = self._executor.init_opt_state(
+            self._optimizer, self._params[0]
+        )
 
         if cfg.export_strategy_file:
             # reference --export-strategy (model.cc:3604)
@@ -518,9 +544,24 @@ class FFModel:
                     indent=1,
                 )
         if cfg.export_strategy_computation_graph_file:
-            # reference --compgraph dot export (model.cc:3664)
+            # reference --compgraph dot export (model.cc:3664); with
+            # --include-costs-dot-graph each node is annotated with its
+            # modeled per-shard time (model.cc:3660)
+            costs = None
+            if cfg.include_costs_dot_graph:
+                from flexflow_tpu.search.api import _cost_model
+
+                cm = _cost_model(self._mesh, cfg)
+                costs = {
+                    n.guid: (
+                        cm.node_compute_time(self.graph, n, n.sharding)
+                        + cm.node_comm_time(self.graph, n, n.sharding)
+                    )
+                    * 1e3
+                    for n in self.graph.nodes
+                }
             with open(cfg.export_strategy_computation_graph_file, "w") as f:
-                f.write(self.graph.to_dot())
+                f.write(self.graph.to_dot(costs=costs))
         return self
 
     @property
@@ -544,9 +585,32 @@ class FFModel:
     def _device_put_batch(self, arrs):
         import jax
 
+        from flexflow_tpu.runtime import distributed as dist
+
         out = []
+        multi = dist.is_multi_host()
         for a in arrs:
             sh = self._executor.batch_sharding(a.ndim, a.shape[0])
+            if multi:
+                # every process passes the same GLOBAL batch; each host
+                # device_puts only its slice and the logical global array is
+                # assembled across hosts (SingleDataLoader-for-pods analog).
+                # device_put with a global sharding would raise on the
+                # non-addressable devices, so every multi-host path goes
+                # through make_array_from_process_local_data — replicated
+                # when the batch doesn't split evenly across processes.
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                pc, pi = dist.process_count(), dist.process_index()
+                if sh is not None and a.shape[0] % pc == 0:
+                    n = a.shape[0] // pc
+                    out.append(jax.make_array_from_process_local_data(
+                        sh, np.ascontiguousarray(a[pi * n:(pi + 1) * n])
+                    ))
+                else:
+                    repl = NamedSharding(self._mesh, PartitionSpec())
+                    out.append(jax.make_array_from_process_local_data(repl, a))
+                continue
             out.append(jax.device_put(a, sh) if sh is not None else jax.device_put(a))
         return out
 
@@ -591,14 +655,28 @@ class FFModel:
                     self._device_put_batch(b)
                     for b in self._batches(xs + [y], batch_size)
                 )
+            # metrics accumulate ON DEVICE across the epoch (reference
+            # PerfMetrics future-reduction discipline); one host sync at
+            # epoch end — per-step float() would block async dispatch and
+            # serialize the step stream
+            dev_sums = None
+            n_samples = 0
             for batch in batches:
                 *bx, by = batch
                 rng, sub = jax.random.split(rng)
                 tr, ntr, opt_state, m = step(tr, ntr, opt_state, sub, by, *bx)
                 self._step_count += 1
-                self.current_metrics.update(
-                    {k: float(v) for k, v in m.items() if k != "loss"},
-                    by.shape[0],
+                bsz = by.shape[0]
+                n_samples += bsz
+                scaled = {
+                    k: (v if k == "accuracy_correct" else v * bsz)
+                    for k, v in m.items()
+                    if k != "loss"
+                }
+                dev_sums = (
+                    scaled
+                    if dev_sums is None
+                    else jax.tree.map(lambda a, b: a + b, dev_sums, scaled)
                 )
                 if recompile_state is not None:
                     # reference recompile_on_condition (model.cc:2422)
@@ -613,6 +691,18 @@ class FFModel:
                         step = self.executor.train_step()
                         tr, ntr = self._params
                         opt_state = self._opt_state
+            self.current_metrics.train_all = n_samples
+            if dev_sums is not None:
+                host = {k: float(v) for k, v in dev_sums.items()}  # one sync
+                self.current_metrics.train_correct = int(
+                    round(host.get("accuracy_correct", 0.0))
+                )
+                for k in (
+                    "cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss",
+                    "mae_loss",
+                ):
+                    if k in host:
+                        setattr(self.current_metrics, k, host[k])
             if verbose:
                 print(f"epoch {epoch}: {self.current_metrics.report(self._metrics)}")
         self._params = (tr, ntr)

@@ -62,6 +62,7 @@ class Executor:
         seq_length: Optional[int] = None,
         donate: bool = True,
         remat: str = "attention",
+        zero_sharded_opt: bool = False,
     ):
         self.graph = graph
         self.mesh = mesh
@@ -71,6 +72,11 @@ class Executor:
         self.seq_length = seq_length
         self.donate = donate
         self.remat = remat
+        # ZeRO-1: shard optimizer state over the data axis
+        # (ParamSyncType.SHARDED — the reference's third sync mode beyond
+        # PS/NCCL, config.h:55; here it cuts Adam state HBM by the data
+        # degree and turns the grad psum into reduce-scatter + all-gather)
+        self.zero_sharded_opt = zero_sharded_opt
         self.topo = graph.topo_order()
         self.input_nodes = [n for n in self.topo if n.op_type == OpType.INPUT]
         sinks = graph.sinks()
@@ -78,6 +84,14 @@ class Executor:
             raise ValueError(f"PCG must have exactly one sink, got {sinks}")
         self.sink = sinks[0]
         self.last_op_is_softmax = self.sink.op_type == OpType.SOFTMAX
+        # When the graph ends in Softmax and the loss is a cross-entropy,
+        # train/eval skip the final softmax and fuse it into the loss as a
+        # log-softmax (the reference's fused softmax-grad discipline,
+        # loss_functions.cu:23). predict() still runs the real softmax.
+        self.fuse_loss_softmax = self.last_op_is_softmax and loss_type in (
+            LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            LossType.CATEGORICAL_CROSSENTROPY,
+        )
         self._train_step = None
         self._eval_step = None
         self._forward = None
@@ -158,6 +172,76 @@ class Executor:
         return jax.jit(build, out_shardings=(tr_sh, ntr_sh))(rng)
 
     # ------------------------------------------------------------------
+    # optimizer state (ZeRO-1 sharding)
+
+    def _data_degree(self) -> int:
+        if self.mesh is None or "data" not in self.mesh.axis_names:
+            return 1
+        return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))["data"]
+
+    def opt_state_shardings(self, params):
+        """Per-leaf NamedShardings for optimizer state trees that mirror
+        `params` (Adam m/v, SGD momentum): each leaf additionally shards its
+        largest data-divisible free dim over `data`. Scalars (step counters)
+        and non-mirroring leaves stay replicated. Returns a function usable
+        with jax.tree.map over a state tree."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = self.mesh
+        ddeg = self._data_degree()
+        tr_sh, _ = self.param_shardings()
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        # param leaf path (nk, wn) -> the param's PartitionSpec
+        def param_spec(nk, wn):
+            sh = tr_sh.get(nk, {}).get(wn)
+            return sh.spec if sh is not None else PartitionSpec()
+
+        def leaf_sharding(nk, wn, shape):
+            if not self.zero_sharded_opt or ddeg <= 1 or not shape:
+                return NamedSharding(mesh, param_spec(nk, wn))
+            spec = list(param_spec(nk, wn))
+            spec += [None] * (len(shape) - len(spec))
+            # pick the largest dim not already sharded and divisible by data
+            best, best_size = -1, 0
+            for i, (entry, size) in enumerate(zip(spec, shape)):
+                if entry is None and size % ddeg == 0 and size > best_size:
+                    best, best_size = i, size
+            if best >= 0:
+                spec[best] = "data"
+            return NamedSharding(mesh, PartitionSpec(*spec))
+
+        def shardings_like(params_tree):
+            return {
+                nk: {
+                    wn: leaf_sharding(nk, wn, jnp.shape(arr))
+                    for wn, arr in ws.items()
+                }
+                for nk, ws in params_tree.items()
+            }
+
+        return shardings_like, repl
+
+    def init_opt_state(self, optimizer, params):
+        """Build optimizer state with ZeRO shardings applied (replicated
+        when zero_sharded_opt is off)."""
+        if self.mesh is None:
+            return optimizer.init_state(params)
+        shardings_like, repl = self.opt_state_shardings(params)
+        state_shape = jax.eval_shape(optimizer.init_state, params)
+        ptree = jax.tree.structure(params)
+
+        def tree_shardings(sub):
+            # state entries mirroring the params tree get ZeRO shardings
+            if jax.tree.structure(sub) == ptree:
+                return shardings_like(sub)
+            return jax.tree.map(lambda _: repl, sub)
+
+        out_sh = {k: tree_shardings(v) for k, v in state_shape.items()}
+        self._opt_shardings = out_sh
+        return jax.jit(optimizer.init_state, out_shardings=out_sh)(params)
+
+    # ------------------------------------------------------------------
     # forward
 
     def _apply_view(self, node: Node, vals: List):
@@ -176,8 +260,11 @@ class Executor:
                 out.append(jax.lax.with_sharding_constraint(v, NamedSharding(self.mesh, ps)))
         return out
 
-    def run_forward(self, trainable, nontrainable, inputs: Sequence, *, training: bool, rng):
-        """Topo-order lowering. Returns (sink output, state_updates, aux_loss)."""
+    def run_forward(self, trainable, nontrainable, inputs: Sequence, *,
+                    training: bool, rng, skip_sink_softmax: bool = False):
+        """Topo-order lowering. Returns (sink output, state_updates, aux_loss).
+        With `skip_sink_softmax` the final Softmax node passes its input
+        (raw logits) through — used when the loss fuses the softmax."""
         values: Dict[Tuple[int, int], Any] = {}
         if len(inputs) != len(self.input_nodes):
             raise ValueError(
@@ -204,6 +291,14 @@ class Executor:
                 seq_length=self.seq_length,
                 node_guid=n.guid,
             )
+            if (
+                skip_sink_softmax
+                and n is self.sink
+                and n.op_type == OpType.SOFTMAX
+            ):
+                outs = self._apply_view(n, [ins[0]])
+                values[(n.guid, 0)] = outs[0]
+                continue
             lowering = get_lowering(n.op_type)
             if (
                 training
@@ -247,21 +342,31 @@ class Executor:
             return self._train_step
         opt = self.optimizer
 
+        fused = self.fuse_loss_softmax
+        sink_is_sm = self.last_op_is_softmax and not fused
+
         def step(trainable, nontrainable, opt_state, rng, labels, *inputs):
             def loss_fn(tr):
                 logits, updates, aux = self.run_forward(
-                    tr, nontrainable, inputs, training=True, rng=rng
+                    tr, nontrainable, inputs, training=True, rng=rng,
+                    skip_sink_softmax=fused,
                 )
-                loss = compute_loss(
-                    self.loss_type, logits, labels, self.last_op_is_softmax
-                )
+                loss = compute_loss(self.loss_type, logits, labels, sink_is_sm)
                 return loss + aux, (logits, updates, loss)
 
             grads, (logits, updates, loss) = jax.grad(loss_fn, has_aux=True)(trainable)
             new_tr, new_opt = opt.update(grads, trainable, opt_state)
+            opt_sh = getattr(self, "_opt_shardings", None)
+            if opt_sh is not None and self.zero_sharded_opt:
+                # keep ZeRO layout stable across steps; with the state
+                # sharded over data, XLA lowers the grad psum feeding the
+                # update into reduce-scatter + all-gather of new params
+                new_opt = jax.tree.map(
+                    jax.lax.with_sharding_constraint, new_opt, opt_sh
+                )
             new_ntr = self._merge_state(nontrainable, updates)
             step_metrics = compute_step_metrics(
-                self.metrics, self.loss_type, logits, labels, self.last_op_is_softmax
+                self.metrics, self.loss_type, logits, labels, sink_is_sm
             )
             step_metrics["loss"] = loss
             return new_tr, new_ntr, new_opt, step_metrics
@@ -274,13 +379,17 @@ class Executor:
         if self._eval_step is not None:
             return self._eval_step
 
+        fused = self.fuse_loss_softmax
+        sink_is_sm = self.last_op_is_softmax and not fused
+
         def step(trainable, nontrainable, labels, *inputs):
             logits, _, _ = self.run_forward(
-                trainable, nontrainable, inputs, training=False, rng=jax.random.key(0)
+                trainable, nontrainable, inputs, training=False,
+                rng=jax.random.key(0), skip_sink_softmax=fused,
             )
-            loss = compute_loss(self.loss_type, logits, labels, self.last_op_is_softmax)
+            loss = compute_loss(self.loss_type, logits, labels, sink_is_sm)
             m = compute_step_metrics(
-                self.metrics, self.loss_type, logits, labels, self.last_op_is_softmax
+                self.metrics, self.loss_type, logits, labels, sink_is_sm
             )
             m["loss"] = loss
             return m

@@ -17,9 +17,13 @@ from flexflow_tpu.ffconst import LossType
 
 
 def compute_loss(loss_type: LossType, logits, labels, last_op_is_softmax: bool = True):
-    """Scalar mean loss. `logits` is the final op output; for the CCE
-    variants it is expected to already be probabilities (the reference
-    requires the last op to be Softmax, model.cc:2875)."""
+    """Scalar mean loss. `logits` is the final op output. For the CCE
+    variants: when `last_op_is_softmax` it is probabilities (the reference
+    requires the last op to be Softmax, model.cc:2875); otherwise it is raw
+    logits and the softmax is fused into the loss as a log-softmax — the
+    TPU analog of the reference's fused softmax-grad (loss_functions.cu:23),
+    avoiding a materialized (b, V) probs tensor and the log-of-small-probs
+    precision loss in bf16."""
     b = logits.shape[0]
     lf = logits.astype(jnp.float32)
     if loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
@@ -29,14 +33,20 @@ def compute_loss(loss_type: LossType, logits, labels, last_op_is_softmax: bool =
             labels = labels.reshape(-1).astype(jnp.int32)
         else:
             labels = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
-        probs = lf if last_op_is_softmax else jax.nn.softmax(lf, axis=-1)
-        ll = jnp.take_along_axis(probs, labels[:, None], axis=-1)[:, 0]
-        return -jnp.mean(jnp.log(jnp.maximum(ll, 1e-30)))
+        if last_op_is_softmax:
+            ll = jnp.take_along_axis(lf, labels[:, None], axis=-1)[:, 0]
+            return -jnp.mean(jnp.log(jnp.maximum(ll, 1e-30)))
+        # fused log-softmax: mean(logsumexp(logits) - logits[target])
+        lse = jax.nn.logsumexp(lf, axis=-1)
+        tgt = jnp.take_along_axis(lf, labels[:, None], axis=-1)[:, 0]
+        return jnp.mean(lse - tgt)
     if loss_type == LossType.CATEGORICAL_CROSSENTROPY:
-        probs = lf if last_op_is_softmax else jax.nn.softmax(lf, axis=-1)
-        return -jnp.mean(
-            jnp.sum(labels.astype(jnp.float32) * jnp.log(jnp.maximum(probs, 1e-30)), axis=-1)
+        logp = (
+            jnp.log(jnp.maximum(lf, 1e-30))
+            if last_op_is_softmax
+            else jax.nn.log_softmax(lf, axis=-1)
         )
+        return -jnp.mean(jnp.sum(labels.astype(jnp.float32) * logp, axis=-1))
     if loss_type == LossType.MEAN_SQUARED_ERROR_AVG_REDUCE:
         return jnp.mean(jnp.square(lf - labels.astype(jnp.float32)))
     if loss_type == LossType.MEAN_SQUARED_ERROR_SUM_REDUCE:

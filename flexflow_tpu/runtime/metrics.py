@@ -72,16 +72,6 @@ def compute_step_metrics(
 
     out: Dict[str, jnp.ndarray] = {}
     lf = logits.astype(jnp.float32)
-    needs_probs = any(
-        m
-        in (
-            MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
-            MetricsType.CATEGORICAL_CROSSENTROPY,
-        )
-        for m in measured
-    )
-    if needs_probs and not last_op_is_softmax:
-        lf = jax.nn.softmax(lf, axis=-1)
     sparse = loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY
     batch = labels.shape[0]
     if sparse:
@@ -99,14 +89,21 @@ def compute_step_metrics(
             # samples) stays consistent
             out["accuracy_correct"] = jnp.mean(pred == truth) * batch
         elif m == MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
-            ll = jnp.take_along_axis(lf, lbl[:, None], axis=-1)[:, 0]
-            out["sparse_cce_loss"] = -jnp.mean(jnp.log(jnp.maximum(ll, 1e-30)))
+            if last_op_is_softmax:
+                ll = jnp.take_along_axis(lf, lbl[:, None], axis=-1)[:, 0]
+                out["sparse_cce_loss"] = -jnp.mean(jnp.log(jnp.maximum(ll, 1e-30)))
+            else:  # fused log-softmax on raw logits (matches loss.py)
+                lse = jax.nn.logsumexp(lf, axis=-1)
+                tgt = jnp.take_along_axis(lf, lbl[:, None], axis=-1)[:, 0]
+                out["sparse_cce_loss"] = jnp.mean(lse - tgt)
         elif m == MetricsType.CATEGORICAL_CROSSENTROPY:
+            logp = (
+                jnp.log(jnp.maximum(lf, 1e-30))
+                if last_op_is_softmax
+                else jax.nn.log_softmax(lf, axis=-1)
+            )
             out["cce_loss"] = -jnp.mean(
-                jnp.sum(
-                    labels.astype(jnp.float32) * jnp.log(jnp.maximum(lf, 1e-30)),
-                    axis=-1,
-                )
+                jnp.sum(labels.astype(jnp.float32) * logp, axis=-1)
             )
         elif m == MetricsType.MEAN_SQUARED_ERROR:
             out["mse_loss"] = jnp.mean(jnp.square(lf - labels.astype(jnp.float32)))
