@@ -75,10 +75,16 @@ def build_llama(ff: FFModel, cfg: LlamaConfig, batch_size: int = None,
 
 
 def llama_tp_strategy(cfg: LlamaConfig, seq_parallel: bool = False) -> Dict[str, ShardingView]:
-    """Hybrid TP(+SP)+DP views: attention heads and MLP column/row split over
-    `model`; activations batch-sharded over `data` (and sequence over `seq`
-    when seq_parallel). The lm_head shards the vocab dim."""
-    act3 = (("data",), ("seq",) if seq_parallel else (), ())
+    """Hybrid TP(+SP)+DP views — the Megatron layout: attention heads and
+    MLP column/row split over `model`, the gate→silu→×→down chain keeping
+    its hidden dim model-sharded between the column and row matmuls;
+    activations batch-sharded over `data` (and sequence over `seq` when
+    seq_parallel); lm_head + softmax vocab-sharded. Every view declares its
+    output/input specs explicitly so the cost model prices the strategy the
+    same way it prices search-enumerated views (no optimistic gaps)."""
+    sq = ("seq",) if seq_parallel else ()
+    act3 = (("data",), sq, ())           # (batch, seq, features) replicated
+    hid3 = (("data",), sq, ("model",))   # feature dim model-sharded
     views: Dict[str, ShardingView] = {}
     for i in range(cfg.layers):
         views[f"l{i}_attn"] = ShardingView(
@@ -89,17 +95,23 @@ def llama_tp_strategy(cfg: LlamaConfig, seq_parallel: bool = False) -> Dict[str,
                 "wv": ((), ("model",), ()),
                 "wo": (("model",), (), ()),
             },
+            input_specs=(act3,) * 3,
         )
         views[f"l{i}_gate"] = ShardingView(
-            weight_specs={"kernel": ((), ("model",))}
+            (hid3,), {"kernel": ((), ("model",))}, input_specs=(act3,)
         )
         views[f"l{i}_up"] = ShardingView(
-            weight_specs={"kernel": ((), ("model",))}
+            (hid3,), {"kernel": ((), ("model",))}, input_specs=(act3,)
         )
+        views[f"l{i}_silu"] = ShardingView((hid3,))
+        views[f"l{i}_gxu"] = ShardingView((hid3,))
         views[f"l{i}_down"] = ShardingView(
-            output_specs=(act3,), weight_specs={"kernel": (("model",), ())}
+            (act3,), {"kernel": (("model",), ())}, input_specs=(hid3,)
         )
-    views["lm_head"] = ShardingView(weight_specs={"kernel": ((), ("model",))})
+    views["lm_head"] = ShardingView(
+        (hid3,), {"kernel": ((), ("model",))}, input_specs=(act3,)
+    )
+    views["softmax"] = ShardingView((hid3,))
     views["tok_emb"] = ShardingView(
         output_specs=(act3,), weight_specs={"kernel": ((), ("model",))}
     )

@@ -75,9 +75,11 @@ class RepartitionAttrs(OpAttrs):
 
 @dataclasses.dataclass(frozen=True)
 class CombineAttrs(OpAttrs):
-    """Unpartition `dim` (reference combine.cc: fwd gather, bwd scatter)."""
+    """Unpartition `dim` (reference combine.cc: fwd gather, bwd scatter).
+    `axes` names the mesh axes being gathered (for the cost model)."""
 
     dim: int
+    axes: Tuple[str, ...] = ()
 
     def infer(self, x: ParallelTensorShape):
         dims = list(x.dims)
@@ -100,9 +102,11 @@ class ReplicateAttrs(OpAttrs):
 class ReductionAttrs(OpAttrs):
     """Sum partial results (reference reduction.cc) — lowers to an
     all-reduce placed where this node sits; output fully replicated unless
-    `out_spec` re-shards it (reduce-scatter)."""
+    `out_spec` re-shards it (reduce-scatter). `axes` names the mesh axes
+    being reduced over (for the cost model)."""
 
     out_spec: Optional[Spec] = None
+    axes: Tuple[str, ...] = ()
 
     def infer(self, x: ParallelTensorShape):
         return (elementwise_like(x),)

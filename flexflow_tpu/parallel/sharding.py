@@ -50,12 +50,18 @@ class ShardingView:
     """Per-node strategy record assigned by the search (or default-DP).
 
     output_specs[i] shards the node's i-th output; weight_specs[name] shards
-    that weight (None entries = replicated). Degrees are implied by the mesh
-    the strategy was built for.
+    that weight (None entries = replicated). `input_specs[i]`, when given,
+    states the sharding this op consumes its i-th input in — used by the
+    cost model to price the resharding on each edge exactly (the reference's
+    estimate_xfer_cost compares producer and consumer *input* layouts,
+    graph.cc:1438); when absent the consumer is assumed to accept the
+    producer's layout on matching dims. Degrees are implied by the mesh the
+    strategy was built for.
     """
 
     output_specs: Tuple[Optional[Spec], ...] = ()
     weight_specs: Dict[str, Optional[Spec]] = dataclasses.field(default_factory=dict)
+    input_specs: Tuple[Optional[Spec], ...] = ()
 
     def __post_init__(self):
         # freeze dict for hashing
@@ -63,12 +69,18 @@ class ShardingView:
 
     def __hash__(self):
         return hash(
-            (self.output_specs, tuple(sorted(self.weight_specs.items())))
+            (self.output_specs, tuple(sorted(self.weight_specs.items())),
+             self.input_specs)
         )
 
     def output_spec(self, idx: int = 0) -> Optional[Spec]:
         if idx < len(self.output_specs):
             return self.output_specs[idx]
+        return None
+
+    def input_spec(self, idx: int = 0) -> Optional[Spec]:
+        if idx < len(self.input_specs):
+            return self.input_specs[idx]
         return None
 
     def __repr__(self):
@@ -107,25 +119,28 @@ def prune_spec(spec: Optional[Spec], shape: Tuple[int, ...], mesh) -> Optional[S
 def view_to_json(view: Optional[ShardingView]):
     if view is None:
         return None
-    return {
-        "outputs": [list(map(list, s)) if s is not None else None
-                    for s in view.output_specs],
-        "weights": {k: (list(map(list, v)) if v is not None else None)
-                    for k, v in view.weight_specs.items()},
+    def enc(s):
+        return list(map(list, s)) if s is not None else None
+
+    out = {
+        "outputs": [enc(s) for s in view.output_specs],
+        "weights": {k: enc(v) for k, v in view.weight_specs.items()},
     }
+    if view.input_specs:
+        out["inputs"] = [enc(s) for s in view.input_specs]
+    return out
 
 
 def view_from_json(d) -> Optional[ShardingView]:
     if d is None:
         return None
-    outs = tuple(
-        tuple(tuple(a) for a in s) if s is not None else None for s in d["outputs"]
-    )
-    ws = {
-        k: (tuple(tuple(a) for a in v) if v is not None else None)
-        for k, v in d["weights"].items()
-    }
-    return ShardingView(outs, ws)
+    def dec(s):
+        return tuple(tuple(a) for a in s) if s is not None else None
+
+    outs = tuple(dec(s) for s in d["outputs"])
+    ws = {k: dec(v) for k, v in d["weights"].items()}
+    ins = tuple(dec(s) for s in d.get("inputs", ()))
+    return ShardingView(outs, ws, ins)
 
 
 def used_axes(view: ShardingView) -> Tuple[str, ...]:

@@ -22,12 +22,35 @@ from flexflow_tpu.search.machine_model import TPUMachineModel
 
 def _cost_model(mesh, config) -> CostModel:
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    num_chips = int(mesh.devices.size)
+    if config.search_num_devices and config.search_num_devices > num_chips:
+        # reference --search-num-workers (model.cc:3692): search for a
+        # machine bigger than the one running. Extra chips extend the data
+        # axis (the axis every model scales along); non-multiple requests
+        # round down to the largest consistent multiple so the machine
+        # model and the axis sizes describe the same chip count.
+        scale = config.search_num_devices // num_chips
+        if scale > 1:
+            axis_sizes["data"] = axis_sizes.get("data", 1) * scale
+            num_chips = num_chips * scale
+        if num_chips != config.search_num_devices:
+            import warnings
+
+            warnings.warn(
+                f"search_num_devices={config.search_num_devices} is not a "
+                f"multiple of the mesh size; searching for {num_chips} chips"
+            )
     machine = (
         TPUMachineModel.from_file(config.machine_model_file)
         if config.machine_model_file
-        else TPUMachineModel.make("v5e", num_chips=int(mesh.devices.size))
+        else TPUMachineModel.make("v5e", num_chips=num_chips)
     )
-    return CostModel(machine, axis_sizes)
+    return CostModel(
+        machine,
+        axis_sizes,
+        param_parallel=config.enable_parameter_parallel,
+        attr_parallel=config.enable_attribute_parallel,
+    )
 
 
 def search_strategy(graph, mesh, config) -> Dict[str, ShardingView]:

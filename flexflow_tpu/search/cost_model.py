@@ -58,6 +58,11 @@ class CostModel:
     axis_sizes: Dict[str, int]
     # backward ~2x forward FLOPs (two GEMMs per forward GEMM)
     backward_factor: float = 2.0
+    # SOAP dimension gates (reference --enable-parameter-parallel /
+    # --enable-attribute-parallel, model.cc:3613-3617): restrict the view
+    # space the search may enumerate. TPU-native default is all-on.
+    param_parallel: bool = True
+    attr_parallel: bool = True
 
     # ------------------------------------------------------------------
 
@@ -91,17 +96,36 @@ class CostModel:
         - a linear/conv whose contraction dim is sharded produces a partial
           sum -> all-reduce of the output (the row-TP allreduce)."""
         ins = _in_shapes(graph, node)
+
+        def axes_degree(axes) -> int:
+            d = 1
+            for a in axes:
+                d *= self.axis_sizes.get(a, 1)
+            return d
+
         if node.op_type == OpType.REDUCTION and ins:
-            deg = self.axis_sizes.get("model", 1)
+            deg = axes_degree(getattr(node.attrs, "axes", ()) or ("model",))
             return self.machine.all_reduce_time(ins[0].global_bytes(), deg)
         if node.op_type == OpType.COMBINE and ins:
-            deg = max(self.axis_sizes.get("model", 1), 2)
+            deg = max(axes_degree(getattr(node.attrs, "axes", ()) or ("model",)), 2)
             return self.machine.all_gather_time(ins[0].global_bytes(), deg)
         if node.op_type == OpType.ALL_TO_ALL and ins:
-            deg = max(self.axis_sizes.get("seq", 1), self.axis_sizes.get("model", 1), 2)
+            deg = max(axes_degree(getattr(node.attrs, "axes", ())), 2)
             return self.machine.all_to_all_time(ins[0].global_bytes(), deg)
         if node.op_type in PARALLEL_OP_TYPES:
             return 0.0
+        # expert parallelism: an EXPERTS op whose weight stack is sharded
+        # over the expert axis pays a token all-to-all each way (dispatch +
+        # combine) — the reference prices Group_by/Aggregate data movement
+        # through Legion partitions; on TPU it is an explicit ICI all-to-all
+        if node.op_type == OpType.EXPERTS and view is not None and ins:
+            w1 = view.weight_specs.get("w1")
+            if w1 and w1[0]:
+                deg = axes_degree(w1[0])
+                if deg > 1:
+                    return 2.0 * self.machine.all_to_all_time(
+                        ins[0].global_bytes(), deg
+                    )
         # contraction-dim sharding => partial-sum all-reduce of the output
         if view is not None and node.outputs:
             contraction_specs = {
@@ -129,31 +153,55 @@ class CostModel:
             return 0.0
         total = 0.0
         ws = node.attrs.weights(*_in_shapes(graph, node))
-        data_degree = self.axis_sizes.get("data", 1)
         for name, spec_decl in ws.items():
             if not spec_decl.trainable:
                 continue
             nbytes = spec_decl.shape.size_bytes()
             shard_degree = 1
-            if view is not None and name in view.weight_specs:
-                shard_degree = spec_degree(view.weight_specs[name], self.axis_sizes)
-            # grads are sharded over the weight's own axes; the psum spans the
-            # axes the weight does NOT use (≈ data axis degree)
-            total += self.machine.all_reduce_time(nbytes / shard_degree, data_degree)
+            used = set()
+            wspec = view.weight_specs.get(name) if view is not None else None
+            if wspec:
+                shard_degree = spec_degree(wspec, self.axis_sizes)
+                for axes in wspec:
+                    used.update(axes)
+            # the grad psum spans every mesh axis the weight is NOT sharded
+            # over (it is replicated there): a fully replicated weight on a
+            # data×model mesh syncs over data*model chips, a col-TP weight
+            # only over data
+            sync_degree = 1
+            for a, s in self.axis_sizes.items():
+                if a not in used:
+                    sync_degree *= s
+            total += self.machine.all_reduce_time(nbytes / shard_degree, sync_degree)
         return total
 
     def edge_xfer_time(self, shape, src_spec: Optional[Spec],
                        dst_spec: Optional[Spec]) -> float:
-        """Resharding cost between producer and consumer specs (reference
-        estimate_xfer_cost graph.cc:1438). Equal specs are free; otherwise
-        classify the transition into gather/partition/all-to-all."""
-        src = tuple(src_spec or ())
-        dst = tuple(dst_spec or ())
+        """Resharding cost between the producer's output spec and the
+        consumer's *input* spec (reference estimate_xfer_cost graph.cc:1438).
+        Specs are compared dim-by-dim on the dims of the edge tensor itself
+        (trailing replicated entries trimmed), so a rank-changing consumer's
+        own output spec is never misread as its input layout."""
+        ndim = len(shape.dims)
+
+        def norm(spec):
+            out = []
+            for i in range(ndim):
+                axes = spec[i] if spec is not None and i < len(spec) else ()
+                out.append(tuple(axes))
+            while out and not out[-1]:
+                out.pop()
+            return tuple(out)
+
+        src = norm(src_spec)
+        dst = norm(dst_spec)
         if src == dst:
             return 0.0
         nbytes = shape.global_bytes()
         src_deg = spec_degree(src or None, self.axis_sizes)
         dst_deg = spec_degree(dst or None, self.axis_sizes)
+        if src_deg == dst_deg == 1:
+            return 0.0
         parts = max(src_deg, dst_deg, 2)
         if src_deg > 1 and dst_deg > 1:
             return self.machine.all_to_all_time(nbytes, parts)
@@ -223,7 +271,11 @@ def graph_cost(graph: Graph, strategy: Dict[str, ShardingView],
             dst = graph.node(e.dst)
             dst_view = strategy.get(dst.name, dst.sharding)
             src_spec = view.output_spec(e.src_idx) if view else None
-            dst_in_spec = dst_view.output_spec(0) if dst_view else None
+            dst_in_spec = None
+            if dst_view is not None:
+                dst_in_spec = dst_view.input_spec(e.dst_idx)
+                if dst_in_spec is None:
+                    dst_in_spec = dst_view.output_spec(0)
             comm += cost.edge_xfer_time(
                 node.outputs[e.src_idx], src_spec, dst_in_spec
             )

@@ -22,10 +22,15 @@ from flexflow_tpu.search.cost_model import CostModel, graph_cost
 
 class ViewDP:
     def __init__(self, cost: CostModel, *, training: bool = True,
-                 max_exhaustive: int = 4):
+                 max_exhaustive: int = 4, product_cap: int = 4096):
         self.cost = cost
         self.training = training
         self.max_exhaustive = max_exhaustive
+        # exhaustive base case bound: total view-combination count, not node
+        # count — a 6-node module with 3 views each (432 combos) is cheap to
+        # solve exactly, and exactness is what crosses TP chain barriers
+        # (col-linear → sharded elementwise → row-linear must flip together)
+        self.product_cap = product_cap
         self._memo: Dict = {}
 
     def optimize(self, graph: Graph) -> Dict[str, ShardingView]:
@@ -48,7 +53,11 @@ class ViewDP:
     def _candidates(self, graph: Graph) -> Dict[str, List[ShardingView]]:
         out = {}
         for n in graph.nodes:
-            views = space.enumerate_views(n, self.cost.axis_sizes)
+            views = space.enumerate_views(
+                n, self.cost.axis_sizes,
+                param_parallel=self.cost.param_parallel,
+                attr_parallel=self.cost.attr_parallel,
+            )
             if len(views) > 1:
                 out[n.name] = views
         return out
@@ -60,6 +69,23 @@ class ViewDP:
         cands = {k: v for k, v in self._candidates(graph).items() if k not in fixed}
         if not cands:
             return dict(fixed)
+
+        product = 1
+        for v in cands.values():
+            product *= len(v)
+            if product > self.product_cap:
+                break
+        if product <= self.product_cap:
+            # exhaustive product (optimal for this module)
+            names = list(cands)
+            best, best_cost = dict(fixed), float("inf")
+            for combo in itertools.product(*(cands[n] for n in names)):
+                s = dict(fixed)
+                s.update(dict(zip(names, combo)))
+                c = self._eval(graph, s)
+                if c < best_cost:
+                    best, best_cost = s, c
+            return best
 
         # sequence split at a bottleneck (graph.cc:115)
         if len(graph) > self.max_exhaustive:
@@ -94,19 +120,8 @@ class ViewDP:
                 merged.update(s2)
                 return merged
 
-        # exhaustive product for small graphs (graph.cc base case)
-        names = list(cands)
-        if len(names) <= self.max_exhaustive:
-            best, best_cost = dict(fixed), float("inf")
-            for combo in itertools.product(*(cands[n] for n in names)):
-                s = dict(fixed)
-                s.update(dict(zip(names, combo)))
-                c = self._eval(graph, s)
-                if c < best_cost:
-                    best, best_cost = s, c
-            return best
-
         # fallback: coordinate descent (2 sweeps)
+        names = list(cands)
         strategy = dict(fixed)
         for n in names:
             strategy[n] = cands[n][0]
@@ -121,3 +136,40 @@ class ViewDP:
                         best_v, best_c = v, c
                 strategy[n] = best_v
         return strategy
+
+
+def greedy_polish(graph: Graph, strategy: Dict[str, ShardingView],
+                  cost: CostModel, *, training: bool = True,
+                  sweeps: int = 3) -> Tuple[Dict[str, ShardingView], float]:
+    """Hill-climb single-node view flips until a sweep finds no improvement.
+    Cheap local cleanup applied after the stochastic MCMC search (the
+    reference's annealing keeps a best-seen strategy; this removes its
+    residual noise)."""
+    s = dict(strategy)
+    cur = graph_cost(graph, s, cost, training).time
+    axis_sizes = cost.axis_sizes
+    for _ in range(sweeps):
+        improved = False
+        for n in graph.nodes:
+            if not n.outputs:
+                continue
+            for v in space.enumerate_views(
+                n, axis_sizes, param_parallel=cost.param_parallel,
+                attr_parallel=cost.attr_parallel,
+            ):
+                old = s.get(n.name)
+                if v == old:
+                    continue
+                s[n.name] = v
+                c = graph_cost(graph, s, cost, training).time
+                if c < cur - 1e-15:
+                    cur = c
+                    improved = True
+                else:
+                    if old is None:
+                        s.pop(n.name, None)
+                    else:
+                        s[n.name] = old
+        if not improved:
+            break
+    return s, cur

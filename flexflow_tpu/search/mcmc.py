@@ -34,12 +34,16 @@ def mcmc_optimize(
     memory_limit: Optional[float] = None,
     verbose: bool = False,
     use_simulate: bool = False,
+    polish: bool = True,
 ) -> Dict[str, ShardingView]:
     axis_sizes = cost.axis_sizes
 
     candidates = {}
     for node in graph.nodes:
-        views = space.enumerate_views(node, axis_sizes)
+        views = space.enumerate_views(
+            node, axis_sizes, param_parallel=cost.param_parallel,
+            attr_parallel=cost.attr_parallel,
+        )
         if len(views) > 1:
             candidates[node.name] = views
     base = space.default_dp_strategy(graph, axis_sizes)
@@ -59,7 +63,16 @@ def mcmc_optimize(
         )
         if verbose:
             print(f"mcmc (native): best {best_cost * 1e3:.3f} ms")
-        return table.to_strategy(best_assign)
+        strategy = table.to_strategy(best_assign)
+        if polish:
+            from flexflow_tpu.search.dp import greedy_polish
+
+            strategy, polished_cost = greedy_polish(
+                graph, strategy, cost, training=training
+            )
+            if verbose:
+                print(f"mcmc polished: {polished_cost * 1e3:.3f} ms")
+        return strategy
 
     # ---- pure-Python fallback over the same tables --------------------
     rng = random.Random(seed)
@@ -98,7 +111,12 @@ def mcmc_optimize(
                     print(f"mcmc iter {it}: best {best_cost * 1e3:.3f} ms")
         else:
             cur[i] = prev
-    return table.to_strategy(best)
+    strategy = table.to_strategy(best)
+    if polish:
+        from flexflow_tpu.search.dp import greedy_polish
+
+        strategy, _ = greedy_polish(graph, strategy, cost, training=training)
+    return strategy
 
 
 def mcmc_search(graph: Graph, mesh, config) -> Dict[str, ShardingView]:

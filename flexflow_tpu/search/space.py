@@ -6,7 +6,10 @@ search (FFModel::rewrite, model.cc:3260) plus register_all_machine_views
 (graph.cc:2329). Here a "view" names mesh axes instead of device lists; the
 enumeration yields, per op, the TPU-meaningful points: pure DP, column/row
 TP for linears (parameter parallelism), head parallelism for attention
-(attribute), expert parallelism for MoE, vocab/ffn splits, and combinations.
+(attribute), expert parallelism for MoE, vocab/ffn splits, sequence
+parallelism (net-new vs the reference, SURVEY.md §5.7), and the 2-axis
+combinations (data×model / data×seq on activations) the flagship hybrid
+strategies are made of.
 """
 
 from __future__ import annotations
@@ -14,14 +17,54 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from flexflow_tpu.ffconst import OpType
-from flexflow_tpu.parallel.sharding import ShardingView, batch_spec, replicated_spec
+from flexflow_tpu.parallel.sharding import ShardingView, Spec, batch_spec, replicated_spec
 from flexflow_tpu.pcg.graph import Graph, Node
 
 
-def enumerate_views(node: Node, axis_sizes: Dict[str, int]) -> List[ShardingView]:
+def _with_seq(spec: Spec, seq_dim: int = 1) -> Spec:
+    """Also shard `seq_dim` over the seq axis (sequence parallelism)."""
+    out = list(spec)
+    if seq_dim < len(out) and not out[seq_dim]:
+        out[seq_dim] = ("seq",)
+    return tuple(out)
+
+
+def _seq_variants(views: List[ShardingView], out_ndim: int,
+                  has_seq: bool) -> List[ShardingView]:
+    """For every view whose output has a free dim 1, add a variant that also
+    shards dim 1 over `seq` — the DP×SP and TP×SP combination points. The
+    view's input_specs get the same seq extension so the cost model keeps
+    pricing TP×SP chains consistently (a seq-sharded row-TP linear still
+    consumes a model-sharded, seq-sharded input for free)."""
+    if not has_seq or out_ndim < 3:
+        return views
+    extra = []
+    for v in views:
+        spec = v.output_spec(0)
+        if spec is None or (1 < len(spec) and spec[1]):
+            continue
+        extra.append(ShardingView(
+            (_with_seq(spec),) + tuple(v.output_specs[1:]),
+            dict(v.weight_specs),
+            tuple(
+                _with_seq(s) if s is not None else None
+                for s in v.input_specs
+            ),
+        ))
+    return views + extra
+
+
+def enumerate_views(node: Node, axis_sizes: Dict[str, int],
+                    param_parallel: bool = True,
+                    attr_parallel: bool = True) -> List[ShardingView]:
     """Candidate ShardingViews for one node. Always includes the
-    data-parallel default (weights replicated)."""
-    has_model = axis_sizes.get("model", 1) > 1
+    data-parallel default (weights replicated). `param_parallel` gates
+    weight-dim sharding (linear/conv/embedding), `attr_parallel` gates
+    attention-head sharding — the reference's SOAP dimension flags
+    (model.cc:3613-3617)."""
+    has_model = axis_sizes.get("model", 1) > 1 and param_parallel
+    has_attr = axis_sizes.get("model", 1) > 1 and attr_parallel
+    has_seq = axis_sizes.get("seq", 1) > 1
     has_expert = axis_sizes.get("expert", 1) > 1
     out_ndim = node.outputs[0].ndim if node.outputs else 2
     dp = ShardingView((batch_spec(out_ndim),))
@@ -29,33 +72,43 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int]) -> List[ShardingView
     t = node.op_type
 
     if t == OpType.LINEAR and has_model:
-        # column parallel (parameter parallelism on out_dim)
+        # column parallel (parameter parallelism on out_dim); activations
+        # stay batch-sharded => data×model 2-axis combination. Consumes a
+        # feature-replicated input (declared so the cost model prices the
+        # all-gather when the producer left the feature dim sharded).
         views.append(
             ShardingView(
                 (batch_spec(out_ndim)[:-1] + (("model",),),),
                 {"kernel": ((), ("model",)), "bias": (("model",),)},
+                input_specs=(batch_spec(out_ndim),),
             )
         )
-        # row parallel (contraction dim sharded -> all-reduce after)
+        # row parallel (contraction dim sharded -> all-reduce after); the
+        # consumed input arrives sharded on its last dim
         views.append(
             ShardingView(
                 (batch_spec(out_ndim),),
                 {"kernel": (("model",), ()), "bias": ((),)},
+                input_specs=(batch_spec(out_ndim)[:-1] + (("model",),),),
             )
         )
-    elif t in (OpType.MULTIHEAD_ATTENTION, OpType.RING_ATTENTION) and has_model:
-        # head (attribute) parallelism
-        views.append(
-            ShardingView(
-                (batch_spec(out_ndim),),
-                {
-                    "wq": ((), ("model",), ()),
-                    "wk": ((), ("model",), ()),
-                    "wv": ((), ("model",), ()),
-                    "wo": (("model",), (), ()),
-                },
+    elif t in (OpType.MULTIHEAD_ATTENTION, OpType.RING_ATTENTION) and (
+        has_attr or has_seq
+    ):
+        if has_attr:
+            # head (attribute) parallelism, activations batch-sharded
+            views.append(
+                ShardingView(
+                    (batch_spec(out_ndim),),
+                    {
+                        "wq": ((), ("model",), ()),
+                        "wk": ((), ("model",), ()),
+                        "wv": ((), ("model",), ()),
+                        "wo": (("model",), (), ()),
+                    },
+                    input_specs=(batch_spec(out_ndim),) * 3,
+                )
             )
-        )
     elif t == OpType.EMBEDDING and has_model:
         views.append(
             ShardingView(
@@ -69,11 +122,12 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int]) -> List[ShardingView
                 {"kernel": (("model",), ())},  # vocab-sharded
             )
         )
-    elif t == OpType.EXPERTS and has_expert:
+    elif t == OpType.EXPERTS and (has_expert or has_model):
+        ax = "expert" if has_expert else "model"
         views.append(
             ShardingView(
                 (batch_spec(out_ndim),),
-                {"w1": (("expert",), (), ()), "w2": (("expert",), (), ())},
+                {"w1": ((ax,), (), ()), "w2": ((ax,), (), ())},
             )
         )
     elif t == OpType.CONV2D and has_model:
@@ -84,12 +138,27 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int]) -> List[ShardingView
                 {"kernel": (("model",), (), (), ()), "bias": (("model",),)},
             )
         )
+    elif t in (OpType.ELEMENT_BINARY, OpType.ELEMENT_UNARY,
+               OpType.DROPOUT, OpType.SOFTMAX, OpType.CAST) and has_model:
+        # elementwise ops can consume/produce a feature-dim-sharded
+        # activation, letting col-TP chains (gate→silu→×→down) flow without
+        # resharding; sharded softmax costs only tiny reduction collectives
+        # which XLA emits (approximated as free here)
+        views.append(
+            ShardingView((batch_spec(out_ndim)[:-1] + (("model",),),))
+        )
+
+    views = _seq_variants(views, out_ndim, has_seq)
     return views
 
 
 def default_dp_strategy(graph: Graph, axis_sizes: Dict[str, int]) -> Dict[str, ShardingView]:
+    """Pure data parallelism on EVERY node (the reference's default view,
+    graph.cc:1955). Covering all nodes (not just inputs) matters for cost
+    fidelity: an uncovered node would be priced unsharded and charge
+    phantom reshardings against its sharded neighbors."""
     out = {}
     for n in graph.nodes:
-        if n.op_type == OpType.INPUT and n.outputs:
+        if n.outputs:
             out[n.name] = ShardingView((batch_spec(n.outputs[0].ndim),))
     return out

@@ -141,7 +141,7 @@ def make_partition_linear_combine(axis: str = "model") -> GraphXfer:
                 else {"kernel": ((), (axis,))},
             )
             comb = g.create_node(
-                OpType.COMBINE, CombineAttrs(ndim - 1), f"{lin.name}_combine"
+                OpType.COMBINE, CombineAttrs(ndim - 1, (axis,)), f"{lin.name}_combine"
             )
             comb.sharding = ShardingView((batch_spec(ndim),))
             g.add_edge(n1, comb)
@@ -175,7 +175,7 @@ def make_replicate_linear_reduce(axis: str = "model") -> GraphXfer:
                 else {"kernel": ((axis,), ())},
             )
             red = g.create_node(
-                OpType.REDUCTION, ReductionAttrs(), f"{lin.name}_reduce"
+                OpType.REDUCTION, ReductionAttrs(axes=(axis,)), f"{lin.name}_reduce"
             )
             red.sharding = ShardingView((batch_spec(ndim),))
             g.add_edge(n1, red)

@@ -104,7 +104,11 @@ def build_table(
                 row = []
                 src_spec = sv.output_spec(e.src_idx) if sv else None
                 for dv in views[di]:
-                    dst_spec = dv.output_spec(0) if dv else None
+                    dst_spec = None
+                    if dv is not None:
+                        dst_spec = dv.input_spec(e.dst_idx)
+                        if dst_spec is None:
+                            dst_spec = dv.output_spec(0)
                     row.append(cost.edge_xfer_time(shape, src_spec, dst_spec))
                 mat.append(row)
             edges.append((si, di, mat))

@@ -7,6 +7,7 @@ import pytest
 from flexflow_tpu import DataType, FFConfig, FFModel, LossType, SGDOptimizer
 from flexflow_tpu.ffconst import ActiMode, OpType
 from flexflow_tpu.models.llama import LlamaConfig, build_llama
+from flexflow_tpu.parallel.sharding import ShardingView
 from flexflow_tpu.search.cost_model import CostModel, graph_cost
 from flexflow_tpu.search.dp import ViewDP
 from flexflow_tpu.search.machine_model import TPUMachineModel
@@ -141,3 +142,81 @@ def test_end_to_end_compile_with_search():
     assert np.isfinite(ev.sparse_cce_loss) or True  # metrics not configured
     preds = ff.predict(xs[:32])
     assert (preds.argmax(-1) == y[:32]).mean() > 0.8
+
+
+def _llama_tiny_graph():
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+
+    ff = FFModel(FFConfig(batch_size=8, num_devices=1))
+    lcfg = LlamaConfig.tiny(vocab=2048)
+    build_llama(ff, lcfg, batch_size=8, seq_len=128)
+    ff.graph.infer_shapes()
+    return ff.graph, lcfg
+
+
+def _filled(graph, strategy):
+    from flexflow_tpu.parallel.sharding import batch_spec
+
+    full = dict(strategy)
+    for n in graph.nodes:
+        if n.name not in full and n.outputs:
+            full[n.name] = ShardingView((batch_spec(n.outputs[0].ndim),))
+    return full
+
+
+def test_search_discovers_llama_tp_strategy():
+    """The VERDICT closing-the-loop test: on a data×model mesh, the search
+    must find a strategy within 10% of the hand-written Megatron TP+DP
+    strategy's modeled cost — with no hints — and beat pure DP."""
+    from flexflow_tpu.models.llama import llama_tp_strategy
+
+    g, lcfg = _llama_tiny_graph()
+    axis_sizes = {"data": 2, "model": 4}
+    cost = CostModel(TPUMachineModel.make("v5p", 8), axis_sizes)
+    hand = graph_cost(g, _filled(g, llama_tp_strategy(lcfg)), cost).time
+    dp = graph_cost(g, default_dp_strategy(g, axis_sizes), cost).time
+
+    _, strategy, found = unity_search(g, cost, budget=10)
+    assert found < dp, (found, dp)
+    assert found <= 1.10 * hand, (found, hand)
+
+
+def test_mcmc_polished_near_llama_tp():
+    """The views-only MCMC path (+greedy polish) gets within 25% of the
+    hand strategy and clearly beats DP on the same mesh."""
+    from flexflow_tpu.models.llama import llama_tp_strategy
+    from flexflow_tpu.search.mcmc import mcmc_optimize
+
+    g, lcfg = _llama_tiny_graph()
+    axis_sizes = {"data": 2, "model": 4}
+    cost = CostModel(TPUMachineModel.make("v5p", 8), axis_sizes)
+    hand = graph_cost(g, _filled(g, llama_tp_strategy(lcfg)), cost).time
+    dp = graph_cost(g, default_dp_strategy(g, axis_sizes), cost).time
+
+    s = mcmc_optimize(g, cost, budget=10000, seed=1)
+    found = graph_cost(g, s, cost).time
+    assert found < 0.75 * dp, (found, dp)
+    assert found <= 1.25 * hand, (found, hand)
+
+
+def test_search_beats_hand_strategy_with_seq_axis():
+    """On a data×seq×model mesh the search may combine sequence sharding
+    with TP; it must at least match the hand strategy."""
+    from flexflow_tpu.models.llama import llama_tp_strategy
+
+    g, lcfg = _llama_tiny_graph()
+    axis_sizes = {"data": 2, "seq": 2, "model": 2}
+    cost = CostModel(TPUMachineModel.make("v5p", 8), axis_sizes)
+    hand = graph_cost(
+        g, _filled(g, llama_tp_strategy(lcfg, seq_parallel=True)), cost
+    ).time
+    _, strategy, found = unity_search(g, cost, budget=10)
+    assert found <= 1.05 * hand, (found, hand)
+    # and the found strategy actually uses more than the data axis
+    used = set()
+    for v in strategy.values():
+        for spec in list(v.output_specs) + list(v.weight_specs.values()):
+            if spec:
+                for axes in spec:
+                    used.update(axes)
+    assert "model" in used or "seq" in used, used
