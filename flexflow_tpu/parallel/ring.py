@@ -20,13 +20,22 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
+def _shard_map(fn, mesh, in_specs, out_specs, check_vma: bool = True):
+    kw = {}
+    if not check_vma:
+        # pallas_call outputs carry no varying-mesh-axes annotation; the
+        # caller opts out of the replication check
+        kw["check_vma"] = False
     try:
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-    except AttributeError:  # older jax
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    except (AttributeError, TypeError):  # older jax
         from jax.experimental.shard_map import shard_map
 
-        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        if not check_vma:
+            kw = {"check_rep": False}
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **kw)
 
 
 def _mesh_axis_size(mesh, name: str) -> int:
@@ -97,10 +106,12 @@ def ring_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
     attention via ring rotation. Falls back to a single local computation
     when the seq axis has size 1."""
     n = _mesh_axis_size(mesh, seq_axis)
-    from flexflow_tpu.ops.jax_ops import _dot_product_attention
+    from flexflow_tpu.ops import jax_ops
 
     if n == 1:
-        return _dot_product_attention(q, k, v, causal, scale)
+        return jax_ops.fused_attention(q, k, v, causal=causal, scale=scale,
+                                       mesh=mesh)
+    jax_ops.LAST_ATTENTION_KERNEL = "ring_online_softmax"
 
     ba = batch_axis if _mesh_axis_size(mesh, batch_axis) > 1 else None
     ha = head_axis if _mesh_axis_size(mesh, head_axis) > 1 else None

@@ -1,0 +1,123 @@
+"""Multi-host orchestration.
+
+Reference analog: Legion control replication + GASNet (mapper.cc:291-306)
+ran one logical control thread across nodes, and the optimized PCG was
+serialized and shipped to every rank (`GraphOptimalViewSerialized`,
+graph.cc:2162-2317). JAX's multi-controller model instead runs the SAME
+program on every host (one process per host, `jax.distributed.initialize`),
+so the framework must guarantee every process compiles the identical step:
+
+  - `initialize()` — process bootstrap (the GASNet/MPI analog; on TPU pods
+    the runtime autodetects coordinator/process ids, on CPU test rigs they
+    are passed explicitly);
+  - `broadcast_strategy()` — process 0's search result is serialized
+    (JSON, like the reference's PCG serialization) and broadcast so a
+    non-deterministic or measured-cost search cannot diverge across hosts;
+  - `host_local_batch()` — per-host data feeding: each host holds only its
+    shard of the global batch and `jax.make_array_from_process_local_data`
+    assembles the logical global array (the SingleDataLoader analog for
+    multi-host).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids=None) -> None:
+    """Bootstrap multi-host JAX (no-op if already initialized or single
+    process). On TPU pods all arguments are autodetected; CPU/GPU rigs pass
+    them explicitly (reference: mpi_wrapper2.sh passes rank/size)."""
+    import jax
+
+    if num_processes is not None and num_processes <= 1:
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_multi_host() -> bool:
+    return process_count() > 1
+
+
+def broadcast_strategy(strategy: Optional[Dict], mesh=None) -> Optional[Dict]:
+    """Make every process use process 0's strategy (the reference ships the
+    optimized PCG to all ranks as GraphOptimalViewSerialized). The strategy
+    dict {node name -> ShardingView} is JSON-serialized, padded, and
+    broadcast device-side; identical on every host afterwards."""
+    import jax
+
+    if not is_multi_host():
+        return strategy
+
+    from jax.experimental import multihost_utils
+
+    from flexflow_tpu.parallel.sharding import view_from_json, view_to_json
+
+    if process_index() == 0 and strategy is not None:
+        payload = json.dumps(
+            {k: view_to_json(v) for k, v in sorted(strategy.items())}
+        ).encode()
+    else:
+        payload = b""
+    # two-phase broadcast: length, then fixed-size buffer
+    n = multihost_utils.broadcast_one_to_all(np.int64(len(payload)))
+    buf = np.zeros(int(n), np.uint8)
+    if process_index() == 0:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    buf = multihost_utils.broadcast_one_to_all(buf)
+    decoded = json.loads(bytes(bytearray(np.asarray(buf).tolist())).decode())
+    return {k: view_from_json(v) for k, v in decoded.items()}
+
+
+def host_local_batch(global_batch_arrays, mesh, shardings):
+    """Assemble logical global arrays from per-host shards.
+
+    `global_batch_arrays`: this host's LOCAL slice of each batch array
+    (first dim = global_batch / process_count). `shardings`: matching
+    NamedShardings (data-axis batch sharding). Single-process: device_put.
+    """
+    import jax
+
+    out = []
+    for arr, sh in zip(global_batch_arrays, shardings):
+        if sh is None or not is_multi_host():
+            out.append(jax.device_put(arr, sh) if sh is not None else jax.device_put(arr))
+        else:
+            out.append(jax.make_array_from_process_local_data(sh, arr))
+    return out
+
+
+def sync_global_devices(tag: str = "barrier") -> None:
+    """Cross-host barrier (Legion's implicit fence analog)."""
+    if not is_multi_host():
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)

@@ -1,0 +1,81 @@
+"""Worker script for the multi-host emulation test (reference pattern:
+tests/multinode_helpers/mpi_wrapper2.sh — N ranks on one box, disjoint
+device slices). Run as:
+
+    python tests/multihost_worker.py <process_id> <num_processes> <port> <model>
+
+Each process gets 4 virtual CPU devices; together they form one 8-device
+logical machine training over a data×model mesh with per-host feeding and
+strategy broadcast.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    pid, nproc, port, model = (
+        int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+    from flexflow_tpu.runtime import distributed as dist
+
+    dist.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert jax.process_count() == nproc
+    assert len(jax.devices()) == 4 * nproc
+
+    from flexflow_tpu import (
+        AdamOptimizer, FFConfig, FFModel, LossType, MetricsType,
+    )
+
+    if model == "mlp":
+        cfg = FFConfig(batch_size=16, mesh_shape={"data": 4, "model": 2},
+                       search_budget=2, seed=11)
+        ff = FFModel(cfg)
+        x = ff.create_tensor((16, 32), name="x")
+        t = ff.dense(x, 64, name="d0")
+        t = ff.relu(t, name="r0")
+        t = ff.dense(t, 4, name="d1")
+        ff.softmax(t, name="sm")
+        ff.compile(optimizer=AdamOptimizer(lr=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[MetricsType.ACCURACY])
+        rs = np.random.RandomState(5)
+        xs = rs.randn(64, 32).astype(np.float32)
+        ys = rs.randint(0, 4, 64).astype(np.int32)
+        m = ff.fit(xs, ys, epochs=2, verbose=False)
+        assert m.train_all == 64
+        print(f"proc {pid}: mlp OK correct={m.train_correct}")
+    else:  # llama
+        from flexflow_tpu.models.llama import (
+            LlamaConfig, build_llama, llama_tp_strategy,
+        )
+
+        lcfg = LlamaConfig.tiny()
+        cfg = FFConfig(batch_size=4, mesh_shape={"data": 2, "model": 4},
+                       seed=11)
+        ff = FFModel(cfg)
+        build_llama(ff, lcfg, batch_size=4, seq_len=32)
+        ff.compile(optimizer=AdamOptimizer(lr=1e-3),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   strategy=llama_tp_strategy(lcfg))
+        rs = np.random.RandomState(5)
+        x = rs.randint(0, lcfg.vocab_size, (8, 32)).astype(np.int32)
+        y = rs.randint(0, lcfg.vocab_size, (8, 32)).astype(np.int32)
+        m = ff.fit(x, y, epochs=1, batch_size=4, verbose=False)
+        assert m.train_all == 8
+        print(f"proc {pid}: llama OK")
+    dist.sync_global_devices("done")
+
+
+if __name__ == "__main__":
+    main()

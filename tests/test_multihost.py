@@ -1,0 +1,66 @@
+"""Multi-host emulation: N processes × 4 CPU devices on one box (reference
+pattern tests/multinode_helpers/mpi_wrapper2.sh:12-14 — mpirun ranks with
+disjoint CUDA_VISIBLE_DEVICES; here jax.distributed with per-process
+virtual CPU devices)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_workers(model: str, nproc: int = 2, timeout: int = 420):
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(ROOT, "tests", "multihost_worker.py"),
+             str(i), str(nproc), str(port), model],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(nproc)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    return outs
+
+
+def test_multihost_mlp_two_processes():
+    outs = _run_workers("mlp")
+    for i, out in enumerate(outs):
+        assert f"proc {i}: mlp OK" in out, out
+    # the broadcast strategy must make both processes train identically
+    c0 = [l for l in outs[0].splitlines() if "correct=" in l][0]
+    c1 = [l for l in outs[1].splitlines() if "correct=" in l][0]
+    assert c0.split("correct=")[1] == c1.split("correct=")[1]
+
+
+def test_multihost_llama_tiny_two_processes():
+    outs = _run_workers("llama")
+    for i, out in enumerate(outs):
+        assert f"proc {i}: llama OK" in out, out
