@@ -1,0 +1,39 @@
+"""AlexNet/CIFAR-10 — the reference bootcamp demo
+(bootcamp_demo/ff_alexnet_cifar10.py analog; BASELINE config 1) on
+synthetic CIFAR-shaped data.
+
+Run:  python examples/python/alexnet_cifar10.py -b 64 -e 2
+"""
+
+import numpy as np
+
+from flexflow_tpu import (
+    FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+)
+from flexflow_tpu.models.alexnet import build_alexnet_cifar10
+
+
+def synthetic_cifar(n=2048, seed=0):
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 10, n).astype(np.int32)
+    x = rs.randn(n, 3, 32, 32).astype(np.float32) + y[:, None, None, None] * 0.05
+    return x, y
+
+
+def main(argv=None):
+    import sys
+
+    cfg = FFConfig.from_args(argv if argv is not None else sys.argv[1:])
+    ff = FFModel(cfg)
+    build_alexnet_cifar10(ff, batch_size=cfg.batch_size)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    x, y = synthetic_cifar()
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""BERT-base with attribute (attention-head) parallelism — BASELINE
+config 3 (reference SOAP attribute-parallel dimension, model.cc:3617).
+
+Run:  python examples/python/bert_attribute_parallel.py -b 8 -e 1 \\
+          --mesh data=2,model=4
+"""
+
+import numpy as np
+
+from flexflow_tpu import (
+    AdamOptimizer, FFConfig, FFModel, LossType, MetricsType,
+)
+from flexflow_tpu.models.bert import (
+    BertConfig, bert_attribute_parallel_strategy, build_bert,
+)
+
+
+def main(argv=None):
+    import sys
+
+    cfg = FFConfig.from_args(argv if argv is not None else sys.argv[1:])
+    bcfg = BertConfig(vocab_size=1024, hidden=128, layers=2, heads=8,
+                      intermediate=256, max_seq=128)
+    ff = FFModel(cfg)
+    build_bert(ff, bcfg, batch_size=cfg.batch_size, seq_len=128)
+    strategy = None
+    if cfg.mesh_shape and cfg.mesh_shape.get("model", 1) > 1:
+        strategy = bert_attribute_parallel_strategy(bcfg)
+    ff.compile(
+        optimizer=AdamOptimizer(lr=1e-4),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        strategy=strategy,
+    )
+    rs = np.random.RandomState(0)
+    n = cfg.batch_size * 4
+    x = rs.randint(0, bcfg.vocab_size, (n, 128)).astype(np.int32)
+    y = rs.randint(0, bcfg.num_classes, n).astype(np.int32)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

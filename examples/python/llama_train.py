@@ -1,0 +1,45 @@
+"""Llama decoder training with a hybrid TP+DP strategy — BASELINE config 4
+(the reference's examples/cpp/Transformer analog, scaled by flags).
+
+Run (single chip):   python examples/python/llama_train.py -b 8 -e 1
+Run (8-dev search):  python examples/python/llama_train.py --budget 10 --devices 8
+The search (--budget) discovers the strategy; without it the hand TP
+strategy is used when the mesh has a model axis.
+"""
+
+import numpy as np
+
+from flexflow_tpu import (
+    AdamOptimizer, FFConfig, FFModel, LossType, MetricsType,
+)
+from flexflow_tpu.models.llama import (
+    LlamaConfig, build_llama, llama_tp_strategy,
+)
+
+
+def main(argv=None):
+    import sys
+
+    cfg = FFConfig.from_args(argv if argv is not None else sys.argv[1:])
+    lcfg = LlamaConfig.tiny(vocab=2048)
+    seq = 256
+    ff = FFModel(cfg)
+    build_llama(ff, lcfg, batch_size=cfg.batch_size, seq_len=seq)
+    strategy = None
+    if cfg.search_budget == 0 and cfg.mesh_shape and cfg.mesh_shape.get("model", 1) > 1:
+        strategy = llama_tp_strategy(lcfg)
+    ff.compile(
+        optimizer=AdamOptimizer(lr=1e-3),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+        strategy=strategy,
+    )
+    rs = np.random.RandomState(0)
+    n = cfg.batch_size * 8
+    x = rs.randint(0, lcfg.vocab_size, (n, seq)).astype(np.int32)
+    y = np.roll(x, -1, axis=1).astype(np.int32)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Mixtral-style MoE training with expert parallelism — BASELINE config 5
+(the reference's examples/cpp/mixture_of_experts analog).
+
+Run:  python examples/python/mixtral_moe.py -b 8 -e 1
+"""
+
+import numpy as np
+
+from flexflow_tpu import (
+    AdamOptimizer, FFConfig, FFModel, LossType,
+)
+from flexflow_tpu.models.mixtral import (
+    MixtralConfig, build_mixtral, mixtral_ep_strategy,
+)
+
+
+def main(argv=None):
+    import sys
+
+    cfg = FFConfig.from_args(argv if argv is not None else sys.argv[1:])
+    mcfg = MixtralConfig.tiny()
+    ff = FFModel(cfg)
+    build_mixtral(ff, mcfg, batch_size=cfg.batch_size, seq_len=128)
+    strategy = None
+    if cfg.mesh_shape and cfg.mesh_shape.get("expert", 1) > 1:
+        strategy = mixtral_ep_strategy(mcfg)
+    ff.compile(
+        optimizer=AdamOptimizer(lr=1e-3),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=strategy,
+    )
+    rs = np.random.RandomState(0)
+    n = cfg.batch_size * 4
+    x = rs.randint(0, mcfg.vocab_size, (n, 128)).astype(np.int32)
+    y = np.roll(x, -1, axis=1).astype(np.int32)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""MLP classifier — the reference's examples/python/native/mnist_mlp.py
+analog, on synthetic MNIST-shaped data (zero-egress image: no downloads).
+
+Run:  python examples/python/mnist_mlp.py -b 64 -e 3 [--devices N]
+"""
+
+import numpy as np
+
+from flexflow_tpu import (
+    FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+)
+from flexflow_tpu.models.mlp import build_mlp
+
+
+def synthetic_mnist(n=4096, seed=0):
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 10, n)
+    protos = rs.randn(10, 784).astype(np.float32)
+    x = protos[y] + 0.3 * rs.randn(n, 784).astype(np.float32)
+    return x, y.astype(np.int32)
+
+
+def main(argv=None):
+    import sys
+
+    cfg = FFConfig.from_args(argv if argv is not None else sys.argv[1:])
+    ff = FFModel(cfg)
+    build_mlp(ff, 784, [512, 512], 10, batch_size=cfg.batch_size)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    x, y = synthetic_mnist()
+    ff.fit(x, y, epochs=cfg.epochs)
+    ff.eval(x[:1024], y[:1024])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""ResNet imported from torchvision-style PyTorch code via the torch.fx
+frontend — BASELINE config 2 (reference examples/python/pytorch flow:
+torch module -> fx trace -> FFModel).
+
+Run:  python examples/python/resnet_torch_import.py -b 8 -e 1
+"""
+
+import numpy as np
+
+from flexflow_tpu import (
+    FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+)
+
+
+def make_torch_resnet_block():
+    import torch.nn as nn
+
+    # small residual CNN standing in for full ResNet-50 (same op mix;
+    # torchvision isn't baked into the image)
+    class Block(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 16, 3, padding=1)
+            self.bn1 = nn.BatchNorm2d(16)
+            self.relu = nn.ReLU()
+            self.conv2 = nn.Conv2d(16, 16, 3, padding=1)
+            self.bn2 = nn.BatchNorm2d(16)
+            self.pool = nn.AdaptiveAvgPool2d(1) if hasattr(nn, "AdaptiveAvgPool2d") else nn.AvgPool2d(32)
+            self.fc = nn.Linear(16, 10)
+
+        def forward(self, x):
+            h = self.relu(self.bn1(self.conv1(x)))
+            h = self.bn2(self.conv2(h))
+            h = self.relu(h)
+            h = nn.functional.avg_pool2d(h, 32)  # static: fx-traceable
+            h = h.flatten(1)
+            return self.fc(h)
+
+    return Block()
+
+
+def main(argv=None):
+    import sys
+
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+
+    cfg = FFConfig.from_args(argv if argv is not None else sys.argv[1:])
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 3, 32, 32), name="input")
+    module = make_torch_resnet_block()
+    out = PyTorchModel(module).torch_to_ff(ff, [x])[0]
+    ff.softmax(out, name="softmax")
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    rs = np.random.RandomState(0)
+    n = cfg.batch_size * 4
+    xs = rs.randn(n, 3, 32, 32).astype(np.float32)
+    ys = rs.randint(0, 10, n).astype(np.int32)
+    ff.fit(xs, ys, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

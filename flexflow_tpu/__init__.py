@@ -58,3 +58,15 @@ __all__ = [
     "UniformInitializer",
     "NormInitializer",
 ]
+
+# set by the CLI driver (`python -m flexflow_tpu SCRIPT [flags]`)
+_driver_config = None
+
+
+def get_driver_config():
+    """The FFConfig parsed from the CLI by the `python -m flexflow_tpu`
+    driver; FFConfig() defaults when not running under the driver."""
+    return _driver_config or FFConfig()
+
+
+__all__.append("get_driver_config")

@@ -54,6 +54,11 @@ class FFConfig:
     # --search-num-workers, model.cc:3692); extra chips extend `data`
     search_num_devices: Optional[int] = None
     machine_model_file: Optional[str] = None
+    # measure real per-op shard times on the local device and use them in
+    # the search cost model (reference measure_operator_cost discipline,
+    # simulator.cc:537); cache file avoids re-measuring across runs
+    measure_costs: bool = False
+    measure_cache_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
     export_strategy_computation_graph_file: Optional[str] = None
@@ -114,6 +119,12 @@ class FFConfig:
                 cfg.seed = int(take())
             elif a in ("--devices", "-ll:gpu", "-ll:tpu"):
                 cfg.num_devices = int(take())
+            elif a == "--mesh":
+                # e.g. --mesh data=2,model=4 (net-new: explicit mesh axes)
+                cfg.mesh_shape = {
+                    k: int(v)
+                    for k, v in (p.split("=") for p in take().split(","))
+                }
             elif a == "--budget" or a == "--search-budget":
                 cfg.search_budget = int(take())
             elif a == "--alpha" or a == "--search-alpha":

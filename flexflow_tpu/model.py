@@ -481,19 +481,23 @@ class FFModel:
                     k: view_from_json(v) for k, v in _json.load(f).items()
                 }
         if strategy is None and not cfg.only_data_parallel and cfg.search_budget > 0:
-            if cfg.search_budget > 5:
+            from flexflow_tpu.runtime import distributed as dist
+
+            if cfg.search_budget > 5 and not dist.is_multi_host():
                 from flexflow_tpu.search.api import graph_optimize
 
                 self.graph, strategy = graph_optimize(self.graph, self._mesh, cfg)
             else:
+                # multi-host uses the views-only search: the strategy dict
+                # broadcast below covers it, whereas a graph-rewriting
+                # search would need whole-PCG serialization to guarantee
+                # identical graphs on every host (reference ships the full
+                # serialized PCG, graph.cc:2162 — future work here)
                 from flexflow_tpu.search.api import search_strategy
 
                 strategy = search_strategy(self.graph, self._mesh, cfg)
-            # multi-host: every process must lower the identical strategy;
-            # ship process 0's search result to all (the reference
-            # serializes the optimized PCG to every rank, graph.cc:2162)
-            from flexflow_tpu.runtime import distributed as dist
-
+            # every process must lower the identical strategy: ship
+            # process 0's search result to all
             if dist.is_multi_host():
                 strategy = dist.broadcast_strategy(strategy, self._mesh)
 
@@ -723,6 +727,15 @@ class FFModel:
         if verbose:
             print(f"eval: {pm.report(self._metrics)}")
         return pm
+
+    def serve(self, batch_sizes=(1, 8), max_delay_ms: float = 2.0,
+              warmup: bool = True):
+        """Start a serving endpoint over this compiled model (the
+        reference triton/ backend analog — flexflow_tpu.serving)."""
+        from flexflow_tpu.serving import serve as _serve
+
+        return _serve(self, batch_sizes=batch_sizes, max_delay_ms=max_delay_ms,
+                      warmup=warmup)
 
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],
                 batch_size: Optional[int] = None) -> np.ndarray:

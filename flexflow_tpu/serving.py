@@ -1,0 +1,234 @@
+"""Inference serving — the `triton/` backend analog.
+
+The reference ships a ~13K-LoC Legion-based Triton backend (triton/README.md
+:1-6): ONNX parse → partitioned model instances → request batching →
+strategy-file-driven multi-GPU serving. TPU-native redesign: a served model
+is ONE jit-compiled forward per padded batch size over the model's mesh
+(strategies via the same ShardingViews as training); a dynamic batcher
+queues requests, pads to the nearest compiled batch, runs, and splits the
+results. No separate runtime — the executor's forward is the instance.
+
+  ff = FFModel(...); ...build/compile...
+  server = ff.serve(batch_sizes=(1, 4, 8), max_delay_ms=2)
+  fut = server.submit(x)          # per-request async
+  y = fut.result()
+  server.stop()
+
+ONNX serving parity: `serve_onnx(path, ...)` loads the model through the
+ONNX frontend (the triton onnx_parser.cc analog) and serves it.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ModelInstance:
+    """One compiled forward per allowed batch size (the reference's
+    per-instance compiled model, triton/src/instance.cc analog)."""
+
+    def __init__(self, ff, batch_sizes: Sequence[int]):
+        self.ff = ff
+        self.batch_sizes = tuple(sorted(set(batch_sizes)))
+        self._fwd = ff.executor.forward_fn()
+        self._params = ff._params
+
+    def pick_batch(self, n: int) -> int:
+        for b in self.batch_sizes:
+            if n <= b:
+                return b
+        return self.batch_sizes[-1]
+
+    def run(self, inputs: List[np.ndarray]) -> np.ndarray:
+        """Run one already-padded batch."""
+        tr, ntr = self._params
+        out = self._fwd(tr, ntr, *[self.ff._device_put_batch([x])[0]
+                                   for x in inputs])
+        return np.asarray(out)
+
+    def warmup(self):
+        """Compile every batch size up front (instances are ready before
+        the first request, like the reference's instance init)."""
+        specs = [n.outputs[0] for n in self.ff.executor.input_nodes]
+        for b in self.batch_sizes:
+            fakes = [
+                np.zeros((b,) + tuple(d.size for d in s.dims[1:]),
+                         s.dtype.jnp_dtype)
+                for s in specs
+            ]
+            self.run(fakes)
+
+
+class _Request:
+    __slots__ = ("inputs", "future", "n")
+
+    def __init__(self, inputs: List[np.ndarray]):
+        self.inputs = inputs
+        self.n = inputs[0].shape[0]
+        self.future: Future = Future()
+
+
+class Server:
+    """Dynamic batcher: requests queue up, are concatenated up to the
+    largest compiled batch (or until `max_delay_ms` passes), run as one
+    forward, and split back per request — the reference triton backend's
+    scheduling core, minus the wire protocol."""
+
+    def __init__(self, instance: ModelInstance, max_delay_ms: float = 2.0):
+        self.instance = instance
+        self.max_delay = max_delay_ms / 1e3
+        self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._running = True
+        self._served = 0
+        self._thread.start()
+
+    # -- client side ----------------------------------------------------
+
+    def submit(self, *inputs: np.ndarray) -> Future:
+        """Queue one request (batch dim may be any size ≥ 1)."""
+        if not self._running:
+            raise RuntimeError("server is stopped")
+        req = _Request([np.asarray(x) for x in inputs])
+        self._q.put(req)
+        return req.future
+
+    def predict(self, *inputs: np.ndarray) -> np.ndarray:
+        return self.submit(*inputs).result()
+
+    def stop(self):
+        self._running = False
+        self._q.put(None)
+        self._thread.join(timeout=10)
+        self._drain()
+
+    def _drain(self):
+        """Fail any request still queued when the loop exits (a request
+        racing stop() must not leave its future forever pending)."""
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if req is not None and not req.future.done():
+                req.future.set_exception(RuntimeError("server stopped"))
+
+    @property
+    def requests_served(self) -> int:
+        return self._served
+
+    # -- scheduler ------------------------------------------------------
+
+    def _loop(self):
+        max_b = self.instance.batch_sizes[-1]
+        while self._running:
+            req = self._q.get()
+            if req is None:
+                break
+            batch = [req]
+            total = req.n
+            deadline = time.monotonic() + self.max_delay
+            while total < max_b:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._running = False
+                    break
+                batch.append(nxt)
+                total += nxt.n
+            self._run_batch(batch, total)
+        self._drain()
+
+    def _run_batch(self, batch: List[_Request], total: int):
+        b = self.instance.pick_batch(total)
+        try:
+            n_inputs = len(batch[0].inputs)
+            cat = [np.concatenate([r.inputs[i] for r in batch])
+                   for i in range(n_inputs)]
+            # pad to the compiled batch (excess rows are garbage-in,
+            # sliced-off-out) — may need several chunks if total > max
+            outs = []
+            for off in range(0, total, b):
+                chunk = [c[off:off + b] for c in cat]
+                pad = b - chunk[0].shape[0]
+                if pad:
+                    chunk = [np.concatenate([c, np.repeat(c[-1:], pad, 0)])
+                             for c in chunk]
+                out = self.instance.run(chunk)
+                outs.append(out[:min(b, total - off)])
+            full = np.concatenate(outs)
+            off = 0
+            for r in batch:
+                r.future.set_result(full[off:off + r.n])
+                off += r.n
+                self._served += 1
+        except Exception as e:  # propagate to every waiting client
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+
+def serve(ff, batch_sizes: Sequence[int] = (1, 8), max_delay_ms: float = 2.0,
+          warmup: bool = True) -> Server:
+    """Create a serving endpoint for a compiled FFModel."""
+    inst = ModelInstance(ff, batch_sizes)
+    if warmup:
+        inst.warmup()
+    return Server(inst, max_delay_ms=max_delay_ms)
+
+
+def serve_onnx(path: str, config=None, batch_sizes: Sequence[int] = (1, 8),
+               strategy_file: Optional[str] = None,
+               input_shapes: Optional[Dict[str, Sequence[int]]] = None,
+               **kw) -> Tuple[Server, "object"]:
+    """ONNX → served model (the triton backend's onnx_parser + strategy
+    file flow, triton/src/onnx_parser.cc / strategy.cc analog). Returns
+    (server, ffmodel). Only the FIRST (batch) dim may be symbolic in the
+    ONNX graph; fix other dynamic dims via `input_shapes[name]`."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.ffconst import CompMode, LossType
+    from flexflow_tpu.frontends.onnx_model import ONNXModel
+    from flexflow_tpu.model import FFModel
+
+    from flexflow_tpu.ffconst import DataType
+
+    cfg = config or FFConfig()
+    cfg.comp_mode = CompMode.INFERENCE
+    if strategy_file:
+        cfg.import_strategy_file = strategy_file
+    ff = FFModel(cfg)
+    onnx_model = ONNXModel(path)
+    # declared graph inputs (minus initializers) become framework tensors
+    graph = onnx_model.model.graph
+    init_names = {i.name for i in graph.initializer}
+    inputs = {}
+    for vi in graph.input:
+        if vi.name in init_names:
+            continue
+        if input_shapes and vi.name in input_shapes:
+            dims = list(input_shapes[vi.name])
+        else:
+            raw = [d.dim_value for d in vi.type.tensor_type.shape.dim]
+            dims = [raw[0] or cfg.batch_size] + raw[1:]
+            if any(not d for d in dims[1:]):
+                raise ValueError(
+                    f"ONNX input {vi.name!r} has symbolic non-batch dims "
+                    f"{raw}; pass input_shapes={{'{vi.name}': (...)}}"
+                )
+        dt = DataType.INT32 if vi.type.tensor_type.elem_type in (6, 7) \
+            else DataType.FLOAT
+        inputs[vi.name] = ff.create_tensor(tuple(dims), dt, name=vi.name)
+    onnx_model.apply(ff, inputs)
+    ff.compile(loss_type=LossType.IDENTITY)
+    return serve(ff, batch_sizes=batch_sizes, **kw), ff

@@ -1,0 +1,68 @@
+"""Inference serving slice (reference triton/ backend analog)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+
+
+def _compiled_model():
+    cfg = FFConfig(batch_size=8)
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 16), name="x")
+    t = ff.dense(x, 32, name="d0")
+    t = ff.relu(t, name="r0")
+    t = ff.dense(t, 4, name="d1")
+    ff.softmax(t, name="sm")
+    ff.compile(optimizer=SGDOptimizer(),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def test_serve_matches_predict():
+    ff = _compiled_model()
+    server = ff.serve(batch_sizes=(1, 4, 8), max_delay_ms=1.0)
+    try:
+        rs = np.random.RandomState(0)
+        x = rs.randn(3, 16).astype(np.float32)
+        got = server.predict(x)
+        want = ff.predict(x)
+        np.testing.assert_allclose(got, np.asarray(want)[:3], rtol=1e-5, atol=1e-5)
+        assert got.shape == (3, 4)
+    finally:
+        server.stop()
+
+
+def test_serve_batches_concurrent_requests():
+    ff = _compiled_model()
+    server = ff.serve(batch_sizes=(8,), max_delay_ms=30.0)
+    try:
+        rs = np.random.RandomState(1)
+        xs = [rs.randn(2, 16).astype(np.float32) for _ in range(4)]
+        futs = [server.submit(x) for x in xs]  # 4 x 2 rows -> one batch of 8
+        outs = [f.result(timeout=60) for f in futs]
+        ref = ff.predict(np.concatenate(xs))
+        np.testing.assert_allclose(
+            np.concatenate(outs), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+        assert server.requests_served == 4
+    finally:
+        server.stop()
+
+
+def test_serve_oversized_request_chunks():
+    ff = _compiled_model()
+    server = ff.serve(batch_sizes=(4,), max_delay_ms=1.0)
+    try:
+        rs = np.random.RandomState(2)
+        x = rs.randn(11, 16).astype(np.float32)  # > max batch, chunked
+        got = server.predict(x)
+        assert got.shape == (11, 4)
+        ref = ff.predict(x)
+        np.testing.assert_allclose(got, np.asarray(ref)[:11], rtol=1e-5,
+                                   atol=1e-5)
+    finally:
+        server.stop()
