@@ -247,6 +247,22 @@ class PyTorchModel:
             dims = a[1] if len(a) > 1 else node.kwargs.get("dim")
             keep = node.kwargs.get("keepdim", False)
             return ff.mean(a[0], axes=tuple(dims) if isinstance(dims, (list, tuple)) else (dims,), keepdims=keep)
+        if fn in (F.avg_pool2d, F.max_pool2d):
+            from flexflow_tpu.ffconst import PoolType
+
+            ks = a[1] if len(a) > 1 else node.kwargs["kernel_size"]
+            kh, kw = (ks, ks) if isinstance(ks, int) else tuple(ks)
+            st = (a[2] if len(a) > 2 else None) or node.kwargs.get("stride") or ks
+            sh, sw = (st, st) if isinstance(st, int) else tuple(st)
+            pad = a[3] if len(a) > 3 else node.kwargs.get("padding", 0)
+            ph, pw = (pad, pad) if isinstance(pad, int) else tuple(pad)
+            pt = PoolType.AVG if fn is F.avg_pool2d else PoolType.MAX
+            return ff.pool2d(a[0], kh, kw, sh, sw, ph, pw, pool_type=pt)
+        if fn in (F.silu,):
+            return ff.silu(a[0])
+        if fn is F.dropout:
+            rate = node.kwargs.get("p", a[1] if len(a) > 1 else 0.5)
+            return ff.dropout(a[0], rate=float(rate))
         raise NotImplementedError(f"torch function {fn} not supported")
 
     def _lower_method(self, ff: FFModel, node, val):

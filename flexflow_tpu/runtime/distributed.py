@@ -86,8 +86,11 @@ def broadcast_strategy(strategy: Optional[Dict], mesh=None) -> Optional[Dict]:
         ).encode()
     else:
         payload = b""
-    # two-phase broadcast: length, then fixed-size buffer
+    # two-phase broadcast: length, then fixed-size buffer. Length 0 is the
+    # None sentinel (process 0 had no strategy) — every host returns None.
     n = multihost_utils.broadcast_one_to_all(np.int64(len(payload)))
+    if int(n) == 0:
+        return None
     buf = np.zeros(int(n), np.uint8)
     if process_index() == 0:
         buf[:] = np.frombuffer(payload, np.uint8)

@@ -45,19 +45,44 @@ def _cost_model(mesh, config) -> CostModel:
         if config.machine_model_file
         else TPUMachineModel.make("v5e", num_chips=num_chips)
     )
-    return CostModel(
-        machine,
-        axis_sizes,
+    kw = dict(
         param_parallel=config.enable_parameter_parallel,
         attr_parallel=config.enable_attribute_parallel,
     )
+    if getattr(config, "measure_costs", False):
+        from flexflow_tpu.search.measured import MeasuredCostModel
+
+        m = MeasuredCostModel(
+            machine, axis_sizes,
+            cache_path=config.measure_cache_file, **kw,
+        )
+        m.load_cache()
+        return m
+    return CostModel(machine, axis_sizes, **kw)
+
+
+def _maybe_measure(cost, graph, config) -> None:
+    """When measure_costs is on, run the on-device microbenchmarks for the
+    graph's ops and calibrate the analytic knobs BEFORE searching (the
+    reference measures inside the cost query, simulator.cc:537; here the
+    sweep is up-front so the search loop stays cheap)."""
+    from flexflow_tpu.search.measured import MeasuredCostModel
+
+    if isinstance(cost, MeasuredCostModel):
+        cost.measure_graph(graph, {}, training=True)
+        cost.calibrate(graph, {})
+        if config.profiling:
+            print(f"[search] measured {len(cost._measured)} op shards; "
+                  f"mxu_eff={cost.machine.mxu_efficiency:.3f}")
 
 
 def search_strategy(graph, mesh, config) -> Dict[str, ShardingView]:
     """Views-only search on a fixed graph (MCMC)."""
     from flexflow_tpu.search.mcmc import mcmc_search
 
-    return mcmc_search(graph, mesh, config)
+    cost = _cost_model(mesh, config)
+    _maybe_measure(cost, graph, config)
+    return mcmc_search(graph, mesh, config, cost=cost)
 
 
 def graph_optimize(graph: Graph, mesh, config) -> Tuple[Graph, Dict[str, ShardingView]]:
@@ -66,6 +91,7 @@ def graph_optimize(graph: Graph, mesh, config) -> Tuple[Graph, Dict[str, Shardin
     from flexflow_tpu.search.substitution import unity_search
 
     cost = _cost_model(mesh, config)
+    _maybe_measure(cost, graph, config)
     memory_limit = cost.machine.memory_per_chip() if config.memory_search else None
     best_graph, strategy, best_time = unity_search(
         graph,

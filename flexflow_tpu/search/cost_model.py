@@ -5,9 +5,11 @@ estimate_xfer_cost (graph.cc:1438). The reference MEASURES each op's kernels
 with CUDA events and caches by (op params, machine view); on TPU per-op
 measurement is less faithful (XLA fuses across ops, and each sharding change
 recompiles), so the default is an analytic roofline against the
-TPUMachineModel, with an optional measured calibration path
-(`MeasuredCostModel`) that times jitted single ops on the local chip and
-caches by (attrs, shard shape) exactly like strict_hash_to_operator_cost.
+TPUMachineModel; `flexflow_tpu.search.measured.MeasuredCostModel` is the
+measured path — it times jitted single ops on the local chip, caches by
+(attrs, shard shapes, dtype) exactly like strict_hash_to_operator_cost,
+and can calibrate this model's efficiency knobs (enable with
+FFConfig.measure_costs).
 """
 
 from __future__ import annotations

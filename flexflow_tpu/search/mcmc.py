@@ -119,11 +119,12 @@ def mcmc_optimize(
     return strategy
 
 
-def mcmc_search(graph: Graph, mesh, config) -> Dict[str, ShardingView]:
+def mcmc_search(graph: Graph, mesh, config, cost=None) -> Dict[str, ShardingView]:
     """Entry used by FFModel.compile (search/api.py)."""
-    from flexflow_tpu.search.api import _cost_model
+    if cost is None:
+        from flexflow_tpu.search.api import _cost_model
 
-    cost = _cost_model(mesh, config)
+        cost = _cost_model(mesh, config)
     machine = cost.machine
     return mcmc_optimize(
         graph,

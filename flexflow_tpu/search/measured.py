@@ -1,0 +1,232 @@
+"""Measured cost model — on-device per-op microbenchmarks.
+
+Reference analog: `Simulator::measure_operator_cost` (simulator.cc:537-577)
+runs each op's real kernels with CUDA-event timing (warmup + repeat loop,
+model.cu:38-75) and caches by a strict hash of (op params, machine view)
+(`strict_hash_to_operator_cost`, simulator.cc:542-553). The TPU version
+jits ONE op's lowering at its per-shard shapes, times it with
+block_until_ready, and caches by (op type, attrs, shard shapes, dtype) —
+optionally persisted to disk so repeated searches skip re-measurement.
+
+Because XLA fuses across ops inside the real step program, a sum of per-op
+times over-counts memory traffic the fused program never pays; measurements
+are therefore used two ways:
+  - directly, as `node_compute_time` for ops that were measured;
+  - as calibration: `calibrate()` fits the analytic model's
+    `mxu_efficiency` / `hbm_efficiency` knobs to the measured sample so
+    un-measured ops inherit realistic constants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.ffconst import OpType, PARALLEL_OP_TYPES
+from flexflow_tpu.parallel.sharding import ShardingView
+from flexflow_tpu.pcg.graph import Graph, Node
+from flexflow_tpu.search.cost_model import CostModel, spec_degree, _in_shapes
+
+
+def _shard_shape(shape, spec, axis_sizes) -> Tuple[int, ...]:
+    """Local (per-shard) shape of a global tensor under a spec."""
+    dims = []
+    for i, d in enumerate(shape.dims):
+        deg = 1
+        if spec is not None and i < len(spec):
+            for a in spec[i]:
+                deg *= axis_sizes.get(a, 1)
+        dims.append(d.size // deg if d.size % deg == 0 else d.size)
+    return tuple(dims)
+
+
+def _weight_shard_shape(shape, spec, axis_sizes) -> Tuple[int, ...]:
+    dims = []
+    for i, size in enumerate(shape):
+        deg = 1
+        if spec is not None and i < len(spec):
+            for a in spec[i]:
+                deg *= axis_sizes.get(a, 1)
+        dims.append(size // deg if size % deg == 0 else size)
+    return tuple(dims)
+
+
+@dataclasses.dataclass
+class MeasuredCostModel(CostModel):
+    """CostModel whose node_compute_time is backed by real on-device
+    timings when available (measure() must be called, or measurements
+    loaded from `cache_path`)."""
+
+    cache_path: Optional[str] = None
+    warmup: int = 2
+    repeats: int = 5
+    _measured: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    # ------------------------------------------------------------------
+
+    def _key(self, node: Node, view: Optional[ShardingView],
+             in_shards, w_shards) -> str:
+        return json.dumps(
+            [str(node.op_type), repr(node.attrs), in_shards, w_shards],
+            sort_keys=True,
+        )
+
+    def load_cache(self) -> None:
+        if self.cache_path and os.path.exists(self.cache_path):
+            with open(self.cache_path) as f:
+                self._measured.update(json.load(f))
+
+    def save_cache(self) -> None:
+        if self.cache_path:
+            with open(self.cache_path, "w") as f:
+                json.dump(self._measured, f)
+
+    # ------------------------------------------------------------------
+
+    def _shard_inputs(self, graph: Graph, node: Node,
+                      view: Optional[ShardingView]):
+        ins = _in_shapes(graph, node)
+        out_spec = view.output_spec(0) if view is not None else None
+        in_shards = []
+        for i, s in enumerate(ins):
+            spec = None
+            if view is not None:
+                spec = view.input_spec(i)
+            if spec is None:
+                # inputs follow the output's batch sharding by default
+                spec = out_spec
+            in_shards.append((_shard_shape(s, spec, self.axis_sizes),
+                              str(s.dtype.value)))
+        w_shards = {}
+        if node.attrs is not None:
+            for name, wdecl in node.attrs.weights(*ins).items():
+                wspec = None
+                if view is not None:
+                    wspec = view.weight_specs.get(name)
+                w_shards[name] = (
+                    _weight_shard_shape(wdecl.shape.dims, wspec, self.axis_sizes),
+                    str(wdecl.shape.dtype.value),
+                )
+        return in_shards, w_shards
+
+    def measure_node(self, graph: Graph, node: Node,
+                     view: Optional[ShardingView],
+                     training: bool = True) -> Optional[float]:
+        """Time this op's jitted lowering at its per-shard shapes on the
+        local device. Returns seconds (fwd × (1+backward_factor) when
+        training), cached by the strict key."""
+        if node.op_type in PARALLEL_OP_TYPES or node.attrs is None:
+            return 0.0
+        if node.op_type == OpType.INPUT:
+            return 0.0
+        in_shards, w_shards = self._shard_inputs(graph, node, view)
+        key = self._key(node, view, in_shards, w_shards)
+        if key in self._measured:
+            t = self._measured[key]
+        else:
+            t = self._time_lowering(node, in_shards, w_shards)
+            if t is None:
+                return None
+            self._measured[key] = t
+        factor = (1.0 + self.backward_factor) if training else 1.0
+        return t * factor
+
+    def _time_lowering(self, node: Node, in_shards, w_shards) -> Optional[float]:
+        import jax
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.registry import LowerCtx, get_lowering
+
+        try:
+            lowering = get_lowering(node.op_type)
+        except KeyError:
+            return None
+        rng = np.random.RandomState(0)
+
+        def mk(shape, dt):
+            if "int" in dt:
+                return jnp.asarray(rng.randint(0, 2, shape), jnp.dtype(dt))
+            return jnp.asarray(rng.randn(*shape), np.float32).astype(jnp.dtype(dt))
+
+        try:
+            inputs = [mk(s, dt) for s, dt in in_shards]
+            params = {n: mk(s, dt) for n, (s, dt) in w_shards.items()}
+
+            def run(inputs, params):
+                ctx = LowerCtx(training=False, rng=jax.random.key(0),
+                               mesh=None, seq_length=None,
+                               node_guid=node.guid)
+                outs = lowering(node.attrs, list(inputs), params, ctx)
+                return outs[0]
+
+            fn = jax.jit(run)
+            for _ in range(self.warmup):
+                out = fn(inputs, params)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(self.repeats):
+                out = fn(inputs, params)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / self.repeats
+        except Exception:
+            return None  # unmeasurable op (shape constraints, rng needs…)
+
+    # ------------------------------------------------------------------
+
+    def measure_graph(self, graph: Graph,
+                      strategy: Dict[str, ShardingView],
+                      training: bool = True) -> int:
+        """Measure every (node, view) in `strategy`; returns measured count."""
+        n = 0
+        for node in graph.topo_order():
+            view = strategy.get(node.name, node.sharding)
+            if self.measure_node(graph, node, view, training) is not None:
+                n += 1
+        self.save_cache()
+        return n
+
+    def node_compute_time(self, graph: Graph, node: Node,
+                          view: Optional[ShardingView],
+                          training: bool = True) -> float:
+        if node.op_type in PARALLEL_OP_TYPES or node.attrs is None:
+            return 0.0
+        in_shards, w_shards = self._shard_inputs(graph, node, view)
+        key = self._key(node, view, in_shards, w_shards)
+        if key in self._measured:
+            factor = (1.0 + self.backward_factor) if training else 1.0
+            return self._measured[key] * factor
+        return super().node_compute_time(graph, node, view, training)
+
+    # ------------------------------------------------------------------
+
+    def calibrate(self, graph: Graph, strategy: Dict[str, ShardingView],
+                  training: bool = True) -> Dict[str, float]:
+        """Fit the analytic machine's efficiency knobs to the measured
+        sample: the median ratio of analytic/measured over compute-bound
+        ops scales mxu_efficiency (reference discipline: measured kernels
+        feed the simulator, simulator.cc:537). Returns the fitted knobs."""
+        ratios = []
+        for node in graph.topo_order():
+            view = strategy.get(node.name, node.sharding)
+            measured = self.measure_node(graph, node, view, training=False)
+            if not measured:
+                continue
+            analytic = super().node_compute_time(graph, node, view, False)
+            if analytic > 0:
+                ratios.append(analytic / measured)
+        if ratios:
+            scale = float(np.median(ratios))
+            # analytic = flops / (peak * eff): analytic/measured = k means
+            # efficiency should be multiplied by k to match measurements
+            new_eff = min(max(self.machine.mxu_efficiency * scale, 0.01), 1.0)
+            self.machine.mxu_efficiency = new_eff
+        self.save_cache()
+        return {
+            "mxu_efficiency": self.machine.mxu_efficiency,
+            "samples": len(ratios),
+        }

@@ -1,0 +1,51 @@
+"""Smoke-run every example script (the reference's multi_gpu_tests.sh
+pattern: examples ARE the integration suite) on the virtual CPU mesh."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(ROOT, "examples", "python")
+
+
+def _run(script, *flags, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # the CLI driver's --platform flag configures the backend before any
+    # jax touch (env vars alone can be overridden by TPU site plugins)
+    p = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu", "--platform", "cpu",
+         "--cpu-devices", "8", os.path.join(EX, script), "-e", "1", *flags],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    assert p.returncode == 0, f"{script} failed:\n{p.stdout}\n{p.stderr}"
+    return p.stdout
+
+
+@pytest.mark.parametrize("script,flags", [
+    ("mnist_mlp.py", ("-b", "64")),
+    ("alexnet_cifar10.py", ("-b", "32")),
+    ("llama_train.py", ("-b", "4", "--mesh", "data=2,model=4")),
+    ("llama_train.py", ("-b", "4", "--budget", "8", "--mesh", "data=2,model=4")),
+    ("bert_attribute_parallel.py", ("-b", "8", "--mesh", "data=2,model=4")),
+    ("mixtral_moe.py", ("-b", "8", "--mesh", "data=2,expert=4")),
+    ("resnet_torch_import.py", ("-b", "8",)),
+])
+def test_example_runs(script, flags):
+    out = _run(script, *flags)
+    assert "epoch 0" in out or "samples=" in out
+
+
+def test_cli_driver():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu", "--platform", "cpu",
+         os.path.join(EX, "mnist_mlp.py"), "-b", "64", "-e", "1"],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "samples=" in p.stdout
