@@ -1,0 +1,494 @@
+// C API implementation — embeds CPython and drives flexflow_tpu.
+//
+// Reference analog: python/flexflow_c.cc (1,937 LoC of flat wrappers over
+// FFModel). Architecture differs by necessity: the reference's runtime is
+// C++ underneath a C API underneath Python; ours is Python/JAX underneath
+// a C API, so handles hold PyObject* and every entry point runs a small
+// amount of Python. Single-threaded embedding contract (one OS thread owns
+// the interpreter), matching how the reference's cffi layer is used.
+
+#include "flexflow_tpu_c.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    g_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+    Py_XDECREF(s);
+  } else {
+    g_error = "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// module caches are globals (not function-local statics) so ffc_finalize
+// can reset them — otherwise a finalize/init cycle would dereference
+// pointers from the destroyed interpreter
+PyObject *g_ff_module = nullptr;
+PyObject *g_np_module = nullptr;
+
+PyObject *ff_module() {
+  if (g_ff_module == nullptr) {
+    g_ff_module = PyImport_ImportModule("flexflow_tpu");
+    if (g_ff_module == nullptr) set_error_from_python();
+  }
+  return g_ff_module;
+}
+
+PyObject *np_module() {
+  if (g_np_module == nullptr) {
+    g_np_module = PyImport_ImportModule("numpy");
+    if (g_np_module == nullptr) set_error_from_python();
+  }
+  return g_np_module;
+}
+
+// call obj.method(*args) returning new ref (nullptr + error set on failure)
+PyObject *call_method(PyObject *obj, const char *name, PyObject *args,
+                      PyObject *kwargs = nullptr) {
+  PyObject *fn = PyObject_GetAttrString(obj, name);
+  if (fn == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject *out = PyObject_Call(fn, args, kwargs);
+  Py_DECREF(fn);
+  if (out == nullptr) set_error_from_python();
+  return out;
+}
+
+const char *act_name(ffc_activation_t a) {
+  switch (a) {
+    case FFC_AC_RELU: return "RELU";
+    case FFC_AC_SIGMOID: return "SIGMOID";
+    case FFC_AC_TANH: return "TANH";
+    case FFC_AC_GELU: return "GELU";
+    default: return "NONE";
+  }
+}
+
+PyObject *enum_member(const char *enum_name, const char *member) {
+  PyObject *mod = ff_module();
+  if (!mod) return nullptr;
+  PyObject *en = PyObject_GetAttrString(mod, enum_name);
+  if (!en) { set_error_from_python(); return nullptr; }
+  PyObject *m = PyObject_GetAttrString(en, member);
+  Py_DECREF(en);
+  if (!m) set_error_from_python();
+  return m;
+}
+
+// numpy array from a host buffer (copies; caller keeps ownership)
+PyObject *np_from_buffer(const void *data, int64_t n_elems,
+                         const char *dtype, int64_t rows, int64_t row_elems) {
+  PyObject *np = np_module();
+  if (!np) return nullptr;
+  PyObject *mem = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<void *>(data)),
+      n_elems * (strcmp(dtype, "int32") == 0 ? 4 : 4), PyBUF_READ);
+  if (!mem) { set_error_from_python(); return nullptr; }
+  PyObject *arr = PyObject_CallMethod(np, "frombuffer", "Os", mem, dtype);
+  Py_DECREF(mem);
+  if (!arr) { set_error_from_python(); return nullptr; }
+  PyObject *shaped;
+  if (row_elems > 1) {
+    shaped = PyObject_CallMethod(arr, "reshape", "(LL)", (long long)rows,
+                                 (long long)row_elems);
+  } else {
+    shaped = PyObject_CallMethod(arr, "reshape", "(L)", (long long)rows);
+  }
+  Py_DECREF(arr);
+  if (!shaped) { set_error_from_python(); return nullptr; }
+  // copy so the framework may keep the array beyond the caller's buffer
+  PyObject *copied = PyObject_CallMethod(shaped, "copy", nullptr);
+  Py_DECREF(shaped);
+  if (!copied) set_error_from_python();
+  return copied;
+}
+
+struct ModelState {
+  PyObject *model;        // FFModel
+  PyObject *last_metrics; // PerfMetrics from the last fit
+  std::vector<long long> input_dims;  // first input's dims (for fit reshape)
+};
+
+}  // namespace
+
+extern "C" {
+
+const char *ffc_last_error(void) { return g_error.c_str(); }
+
+int ffc_init(int argc, char **argv) {
+  if (Py_IsInitialized()) return 0;
+  Py_Initialize();
+  // FFC_PLATFORM / FFC_CPU_DEVICES pin the jax backend BEFORE any backend
+  // touch (site plugins can override env vars; jax.config cannot be)
+  PyRun_SimpleString(
+      "import os\n"
+      "_p = os.environ.get('FFC_PLATFORM')\n"
+      "if _p:\n"
+      "    import jax\n"
+      "    jax.config.update('jax_platforms', _p)\n"
+      "    _n = os.environ.get('FFC_CPU_DEVICES')\n"
+      "    if _n:\n"
+      "        jax.config.update('jax_num_cpu_devices', int(_n))\n");
+  if (!ff_module()) return -1;
+  (void)argc;
+  (void)argv;
+  return 0;
+}
+
+void ffc_finalize(void) {
+  if (Py_IsInitialized()) {
+    Py_XDECREF(g_ff_module);
+    Py_XDECREF(g_np_module);
+    Py_Finalize();
+  }
+  g_ff_module = nullptr;
+  g_np_module = nullptr;
+}
+
+ffc_config_t ffc_config_create(int batch_size, int num_devices) {
+  g_error.clear();
+  PyObject *mod = ff_module();
+  if (!mod) return nullptr;
+  PyObject *cls = PyObject_GetAttrString(mod, "FFConfig");
+  if (!cls) { set_error_from_python(); return nullptr; }
+  PyObject *kwargs = Py_BuildValue("{s:i}", "batch_size", batch_size);
+  if (num_devices > 0) {
+    PyObject *nd = PyLong_FromLong(num_devices);
+    PyDict_SetItemString(kwargs, "num_devices", nd);
+    Py_DECREF(nd);
+  }
+  PyObject *args = PyTuple_New(0);
+  PyObject *cfg = PyObject_Call(cls, args, kwargs);
+  Py_DECREF(cls);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  if (!cfg) set_error_from_python();
+  return cfg;
+}
+
+void ffc_config_destroy(ffc_config_t cfg) {
+  Py_XDECREF(reinterpret_cast<PyObject *>(cfg));
+}
+
+ffc_model_t ffc_model_create(ffc_config_t cfg) {
+  g_error.clear();
+  PyObject *mod = ff_module();
+  if (!mod) return nullptr;
+  PyObject *cls = PyObject_GetAttrString(mod, "FFModel");
+  if (!cls) { set_error_from_python(); return nullptr; }
+  PyObject *model = PyObject_CallFunctionObjArgs(
+      cls, reinterpret_cast<PyObject *>(cfg), nullptr);
+  Py_DECREF(cls);
+  if (!model) { set_error_from_python(); return nullptr; }
+  auto *st = new ModelState{model, nullptr, {}};
+  return st;
+}
+
+void ffc_model_destroy(ffc_model_t handle) {
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  if (!st) return;
+  Py_XDECREF(st->model);
+  Py_XDECREF(st->last_metrics);
+  delete st;
+}
+
+ffc_tensor_t ffc_model_create_tensor(ffc_model_t handle, int ndims,
+                                     const int64_t *dims, ffc_dtype_t dtype) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *dim_tuple = PyTuple_New(ndims);
+  for (int i = 0; i < ndims; i++) {
+    PyTuple_SetItem(dim_tuple, i, PyLong_FromLongLong(dims[i]));
+  }
+  const char *dt = dtype == FFC_DT_INT32 ? "INT32"
+                   : dtype == FFC_DT_BFLOAT16 ? "BFLOAT16" : "FLOAT";
+  PyObject *dt_obj = enum_member("DataType", dt);
+  if (!dt_obj) { Py_DECREF(dim_tuple); return nullptr; }
+  PyObject *args = PyTuple_Pack(2, dim_tuple, dt_obj);
+  PyObject *t = call_method(st->model, "create_tensor", args);
+  Py_DECREF(args);
+  Py_DECREF(dim_tuple);
+  Py_DECREF(dt_obj);
+  if (t && st->input_dims.empty()) {
+    for (int i = 0; i < ndims; i++) st->input_dims.push_back(dims[i]);
+  }
+  return t;
+}
+
+ffc_tensor_t ffc_model_dense(ffc_model_t handle, ffc_tensor_t input,
+                             int out_dim, ffc_activation_t act, int use_bias) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *act_obj = enum_member("ActiMode", act_name(act));
+  if (!act_obj) return nullptr;
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue("{s:i,s:O,s:i}", "out_dim", out_dim,
+                                   "activation", act_obj, "use_bias",
+                                   use_bias ? 1 : 0);
+  PyObject *t = call_method(st->model, "dense", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(act_obj);
+  return t;
+}
+
+ffc_tensor_t ffc_model_conv2d(ffc_model_t handle, ffc_tensor_t input,
+                              int out_channels, int kernel_h, int kernel_w,
+                              int stride_h, int stride_w, int padding_h,
+                              int padding_w, ffc_activation_t act) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *act_obj = enum_member("ActiMode", act_name(act));
+  if (!act_obj) return nullptr;
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue(
+      "{s:i,s:i,s:i,s:i,s:i,s:i,s:i,s:O}", "out_channels", out_channels,
+      "kernel_h", kernel_h, "kernel_w", kernel_w, "stride_h", stride_h,
+      "stride_w", stride_w, "padding_h", padding_h, "padding_w", padding_w,
+      "activation", act_obj);
+  PyObject *t = call_method(st->model, "conv2d", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(act_obj);
+  return t;
+}
+
+ffc_tensor_t ffc_model_pool2d(ffc_model_t handle, ffc_tensor_t input,
+                              int kernel_h, int kernel_w, int stride_h,
+                              int stride_w, int padding_h, int padding_w,
+                              int is_max) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *pt = enum_member("PoolType", is_max ? "MAX" : "AVG");
+  if (!pt) return nullptr;
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue(
+      "{s:i,s:i,s:i,s:i,s:i,s:i,s:O}", "kernel_h", kernel_h, "kernel_w",
+      kernel_w, "stride_h", stride_h, "stride_w", stride_w, "padding_h",
+      padding_h, "padding_w", padding_w, "pool_type", pt);
+  PyObject *t = call_method(st->model, "pool2d", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(pt);
+  return t;
+}
+
+ffc_tensor_t ffc_model_embedding(ffc_model_t handle, ffc_tensor_t input,
+                                 int num_entries, int out_dim) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue("{s:i,s:i}", "num_entries", num_entries,
+                                   "out_dim", out_dim);
+  PyObject *t = call_method(st->model, "embedding", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return t;
+}
+
+static ffc_tensor_t unary(ffc_model_t handle, ffc_tensor_t input,
+                          const char *name) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *t = call_method(st->model, name, args);
+  Py_DECREF(args);
+  return t;
+}
+
+ffc_tensor_t ffc_model_relu(ffc_model_t m, ffc_tensor_t x) {
+  return unary(m, x, "relu");
+}
+ffc_tensor_t ffc_model_softmax(ffc_model_t m, ffc_tensor_t x) {
+  return unary(m, x, "softmax");
+}
+ffc_tensor_t ffc_model_flat(ffc_model_t m, ffc_tensor_t x) {
+  return unary(m, x, "flat");
+}
+
+ffc_tensor_t ffc_model_add(ffc_model_t handle, ffc_tensor_t a, ffc_tensor_t b) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(2, reinterpret_cast<PyObject *>(a),
+                                reinterpret_cast<PyObject *>(b));
+  PyObject *t = call_method(st->model, "add", args);
+  Py_DECREF(args);
+  return t;
+}
+
+ffc_tensor_t ffc_model_concat(ffc_model_t handle, int n,
+                              const ffc_tensor_t *tensors, int axis) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *lst = PyList_New(n);
+  for (int i = 0; i < n; i++) {
+    PyObject *t = reinterpret_cast<PyObject *>(tensors[i]);
+    Py_INCREF(t);
+    PyList_SetItem(lst, i, t);
+  }
+  PyObject *args = PyTuple_Pack(1, lst);
+  PyObject *kwargs = Py_BuildValue("{s:i}", "axis", axis);
+  PyObject *t = call_method(st->model, "concat", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(lst);
+  return t;
+}
+
+void ffc_tensor_destroy(ffc_tensor_t t) {
+  Py_XDECREF(reinterpret_cast<PyObject *>(t));
+}
+
+int ffc_model_compile(ffc_model_t handle, ffc_loss_t loss, float lr) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *mod = ff_module();
+  PyObject *opt_cls = PyObject_GetAttrString(mod, "SGDOptimizer");
+  if (!opt_cls) { set_error_from_python(); return -1; }
+  PyObject *okw = Py_BuildValue("{s:f}", "lr", lr);
+  PyObject *oargs = PyTuple_New(0);
+  PyObject *opt = PyObject_Call(opt_cls, oargs, okw);
+  Py_DECREF(opt_cls);
+  Py_DECREF(oargs);
+  Py_DECREF(okw);
+  if (!opt) { set_error_from_python(); return -1; }
+  const char *ln = loss == FFC_LOSS_CCE ? "CATEGORICAL_CROSSENTROPY"
+                   : loss == FFC_LOSS_MSE ? "MEAN_SQUARED_ERROR_AVG_REDUCE"
+                   : "SPARSE_CATEGORICAL_CROSSENTROPY";
+  PyObject *loss_obj = enum_member("LossType", ln);
+  PyObject *acc = enum_member("MetricsType", "ACCURACY");
+  if (!loss_obj || !acc) { Py_DECREF(opt); return -1; }
+  PyObject *metrics = PyList_New(1);
+  Py_INCREF(acc);
+  PyList_SetItem(metrics, 0, acc);
+  PyObject *args = PyTuple_New(0);
+  PyObject *kwargs = Py_BuildValue("{s:O,s:O,s:O}", "optimizer", opt,
+                                   "loss_type", loss_obj, "metrics", metrics);
+  PyObject *r = call_method(st->model, "compile", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(opt);
+  Py_DECREF(loss_obj);
+  Py_DECREF(acc);
+  Py_DECREF(metrics);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int64_t ffc_model_fit(ffc_model_t handle, const float *x, const int32_t *y,
+                      int64_t n, int64_t x_row_elems, int epochs) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *xa = np_from_buffer(x, n * x_row_elems, "float32", n, x_row_elems);
+  if (!xa) return -1;
+  // reshape x to the first input tensor's trailing dims
+  if (st->input_dims.size() > 2) {
+    PyObject *shape = PyTuple_New(st->input_dims.size());
+    PyTuple_SetItem(shape, 0, PyLong_FromLongLong(n));
+    for (size_t i = 1; i < st->input_dims.size(); i++) {
+      PyTuple_SetItem(shape, i, PyLong_FromLongLong(st->input_dims[i]));
+    }
+    PyObject *xr = PyObject_CallMethod(xa, "reshape", "(O)", shape);
+    Py_DECREF(shape);
+    Py_DECREF(xa);
+    if (!xr) { set_error_from_python(); return -1; }
+    xa = xr;
+  }
+  PyObject *ya = np_from_buffer(y, n, "int32", n, 1);
+  if (!ya) { Py_DECREF(xa); return -1; }
+  PyObject *args = PyTuple_Pack(2, xa, ya);
+  PyObject *kwargs = Py_BuildValue("{s:i,s:O}", "epochs", epochs, "verbose",
+                                   Py_False);
+  PyObject *metrics = call_method(st->model, "fit", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(xa);
+  Py_DECREF(ya);
+  if (!metrics) return -1;
+  Py_XDECREF(st->last_metrics);
+  st->last_metrics = metrics;
+  PyObject *ta = PyObject_GetAttrString(metrics, "train_all");
+  int64_t out = ta ? PyLong_AsLongLong(ta) : -1;
+  Py_XDECREF(ta);
+  return out;
+}
+
+int ffc_model_predict(ffc_model_t handle, const float *x, int64_t n,
+                      int64_t x_row_elems, float *out, int64_t out_elems) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *xa = np_from_buffer(x, n * x_row_elems, "float32", n, x_row_elems);
+  if (!xa) return -1;
+  if (st->input_dims.size() > 2) {
+    PyObject *shape = PyTuple_New(st->input_dims.size());
+    PyTuple_SetItem(shape, 0, PyLong_FromLongLong(n));
+    for (size_t i = 1; i < st->input_dims.size(); i++) {
+      PyTuple_SetItem(shape, i, PyLong_FromLongLong(st->input_dims[i]));
+    }
+    PyObject *xr = PyObject_CallMethod(xa, "reshape", "(O)", shape);
+    Py_DECREF(shape);
+    Py_DECREF(xa);
+    if (!xr) { set_error_from_python(); return -1; }
+    xa = xr;
+  }
+  PyObject *args = PyTuple_Pack(1, xa);
+  PyObject *empty = PyDict_New();
+  PyObject *pred = call_method(st->model, "predict", args, empty);
+  Py_DECREF(args);
+  Py_DECREF(empty);
+  Py_DECREF(xa);
+  if (!pred) return -1;
+  PyObject *np = np_module();
+  PyObject *flat = PyObject_CallMethod(np, "ascontiguousarray", "O", pred);
+  Py_DECREF(pred);
+  if (!flat) { set_error_from_python(); return -1; }
+  PyObject *f32 = PyObject_CallMethod(flat, "astype", "s", "float32");
+  Py_DECREF(flat);
+  if (!f32) { set_error_from_python(); return -1; }
+  Py_buffer view;
+  if (PyObject_GetBuffer(f32, &view, PyBUF_CONTIG_RO) != 0) {
+    set_error_from_python();
+    Py_DECREF(f32);
+    return -1;
+  }
+  int64_t want = n * out_elems * (int64_t)sizeof(float);
+  int64_t have = (int64_t)view.len;
+  memcpy(out, view.buf, want < have ? want : have);
+  PyBuffer_Release(&view);
+  Py_DECREF(f32);
+  return 0;
+}
+
+double ffc_model_last_accuracy(ffc_model_t handle) {
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  if (!st || !st->last_metrics) return -1.0;
+  PyObject *c = PyObject_GetAttrString(st->last_metrics, "train_correct");
+  PyObject *a = PyObject_GetAttrString(st->last_metrics, "train_all");
+  double res = -1.0;
+  if (c && a && PyLong_AsLongLong(a) > 0) {
+    res = (double)PyLong_AsLongLong(c) / (double)PyLong_AsLongLong(a);
+  }
+  Py_XDECREF(c);
+  Py_XDECREF(a);
+  return res;
+}
+
+}  // extern "C"

@@ -1,0 +1,110 @@
+/* C API for flexflow_tpu — the reference's python/flexflow_c.h analog.
+ *
+ * The reference exported ~200 flat C wrappers over FFModel so non-Python
+ * hosts (and the cffi bindings) could drive training. Here the runtime IS
+ * Python/JAX, so the C API embeds CPython: ffc_init boots an interpreter,
+ * and each handle wraps a Python object. Intended for embedding the
+ * framework in C/C++ services; one OS thread drives all calls.
+ *
+ * Example:
+ *   ffc_init(0, NULL);
+ *   ffc_config_t cfg = ffc_config_create(64, 1);
+ *   ffc_model_t m = ffc_model_create(cfg);
+ *   int64_t dims[2] = {64, 784};
+ *   ffc_tensor_t x = ffc_model_create_tensor(m, 2, dims, FFC_DT_FLOAT);
+ *   ffc_tensor_t h = ffc_model_dense(m, x, 128, FFC_AC_RELU, 1);
+ *   ffc_tensor_t o = ffc_model_dense(m, h, 10, FFC_AC_NONE, 1);
+ *   ffc_model_softmax(m, o);
+ *   ffc_model_compile(m, FFC_LOSS_SPARSE_CCE, 0.05f);
+ *   ffc_model_fit(m, xdata, ydata, 4096, 784, 3);
+ */
+
+#ifndef FLEXFLOW_TPU_C_H
+#define FLEXFLOW_TPU_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *ffc_config_t;
+typedef void *ffc_model_t;
+typedef void *ffc_tensor_t;
+
+typedef enum {
+  FFC_DT_FLOAT = 0,
+  FFC_DT_INT32 = 1,
+  FFC_DT_BFLOAT16 = 2,
+} ffc_dtype_t;
+
+typedef enum {
+  FFC_AC_NONE = 0,
+  FFC_AC_RELU = 1,
+  FFC_AC_SIGMOID = 2,
+  FFC_AC_TANH = 3,
+  FFC_AC_GELU = 4,
+} ffc_activation_t;
+
+typedef enum {
+  FFC_LOSS_SPARSE_CCE = 0,
+  FFC_LOSS_CCE = 1,
+  FFC_LOSS_MSE = 2,
+} ffc_loss_t;
+
+/* interpreter + framework bootstrap; argv carries reference-style flags
+ * ("-b", "--devices", "--budget", ...). Returns 0 on success. */
+int ffc_init(int argc, char **argv);
+void ffc_finalize(void);
+
+/* last error message (empty string when the previous call succeeded) */
+const char *ffc_last_error(void);
+
+ffc_config_t ffc_config_create(int batch_size, int num_devices);
+void ffc_config_destroy(ffc_config_t cfg);
+
+ffc_model_t ffc_model_create(ffc_config_t cfg);
+void ffc_model_destroy(ffc_model_t model);
+
+ffc_tensor_t ffc_model_create_tensor(ffc_model_t model, int ndims,
+                                     const int64_t *dims, ffc_dtype_t dtype);
+ffc_tensor_t ffc_model_dense(ffc_model_t model, ffc_tensor_t input,
+                             int out_dim, ffc_activation_t act, int use_bias);
+ffc_tensor_t ffc_model_conv2d(ffc_model_t model, ffc_tensor_t input,
+                              int out_channels, int kernel_h, int kernel_w,
+                              int stride_h, int stride_w, int padding_h,
+                              int padding_w, ffc_activation_t act);
+ffc_tensor_t ffc_model_pool2d(ffc_model_t model, ffc_tensor_t input,
+                              int kernel_h, int kernel_w, int stride_h,
+                              int stride_w, int padding_h, int padding_w,
+                              int is_max);
+ffc_tensor_t ffc_model_embedding(ffc_model_t model, ffc_tensor_t input,
+                                 int num_entries, int out_dim);
+ffc_tensor_t ffc_model_relu(ffc_model_t model, ffc_tensor_t input);
+ffc_tensor_t ffc_model_softmax(ffc_model_t model, ffc_tensor_t input);
+ffc_tensor_t ffc_model_flat(ffc_model_t model, ffc_tensor_t input);
+ffc_tensor_t ffc_model_add(ffc_model_t model, ffc_tensor_t a, ffc_tensor_t b);
+ffc_tensor_t ffc_model_concat(ffc_model_t model, int n,
+                              const ffc_tensor_t *tensors, int axis);
+void ffc_tensor_destroy(ffc_tensor_t t);
+
+/* compile with SGD(lr); returns 0 on success */
+int ffc_model_compile(ffc_model_t model, ffc_loss_t loss, float lr);
+
+/* x: float32 [n, feature...] flattened; y: int32 [n]; returns samples
+ * trained, or -1 on error */
+int64_t ffc_model_fit(ffc_model_t model, const float *x, const int32_t *y,
+                      int64_t n, int64_t x_row_elems, int epochs);
+
+/* run inference for n rows; writes n*out_elems floats; returns 0/-1 */
+int ffc_model_predict(ffc_model_t model, const float *x, int64_t n,
+                      int64_t x_row_elems, float *out, int64_t out_elems);
+
+/* training accuracy of the last fit() epoch in [0,1]; -1 when unknown */
+double ffc_model_last_accuracy(ffc_model_t model);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* FLEXFLOW_TPU_C_H */

@@ -1,0 +1,40 @@
+"""C API (native/ffc.cc — reference python/flexflow_c.cc analog):
+compile the C smoke test against libflexflow_tpu_c.so and run it."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(ROOT, "native")
+LIBDIR = os.path.join(ROOT, "flexflow_tpu", "native")
+LIB = os.path.join(LIBDIR, "libflexflow_tpu_c.so")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_c_api_trains_mlp(tmp_path):
+    if not os.path.exists(LIB):
+        r = subprocess.run(["make", "-C", NATIVE], capture_output=True,
+                           text=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+    exe = str(tmp_path / "ffc_test")
+    cc = shutil.which("gcc") or "g++"
+    r = subprocess.run(
+        [cc, "-O1", os.path.join(NATIVE, "ffc_test.c"),
+         "-I", NATIVE, "-L", LIBDIR, "-lflexflow_tpu_c",
+         f"-Wl,-rpath,{LIBDIR}", "-o", exe],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["FFC_PLATFORM"] = "cpu"
+    env["FFC_CPU_DEVICES"] = "8"
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=420)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "C_API_OK" in r.stdout, r.stdout
