@@ -34,3 +34,7 @@ __all__ = [
     "Add",
     "Activation",
 ]
+
+from flexflow_tpu.frontends.keras import callbacks, datasets  # noqa: E402
+
+__all__ += ["callbacks", "datasets"]

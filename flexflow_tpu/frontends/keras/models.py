@@ -53,15 +53,63 @@ class _BaseModel:
         self._optimizer = optimizer
         self._loss = _LOSSES[loss] if isinstance(loss, str) else loss
         self._metrics = [_METRICS[m] if isinstance(m, str) else m for m in metrics]
+        # always measure the loss itself so History/EarlyStopping see a
+        # real "loss" value (keras semantics)
+        loss_metric = {
+            LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+                MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            LossType.CATEGORICAL_CROSSENTROPY:
+                MetricsType.CATEGORICAL_CROSSENTROPY,
+            LossType.MEAN_SQUARED_ERROR_AVG_REDUCE:
+                MetricsType.MEAN_SQUARED_ERROR,
+            LossType.MEAN_SQUARED_ERROR_SUM_REDUCE:
+                MetricsType.MEAN_SQUARED_ERROR,
+        }.get(self._loss)
+        if loss_metric is not None and loss_metric not in self._metrics:
+            self._metrics.append(loss_metric)
         self.ffmodel = self._build(self.config.batch_size)
         self.ffmodel.compile(optimizer=self._optimizer, loss_type=self._loss,
                              metrics=self._metrics)
         return self
 
     def fit(self, x, y, epochs: int = 1, batch_size: Optional[int] = None,
-            verbose: bool = True):
-        return self.ffmodel.fit(x, y, epochs=epochs, batch_size=batch_size,
-                                verbose=verbose)
+            verbose: bool = True, callbacks: Sequence = ()):
+        """Training loop with callback hooks (reference base_model.py:198).
+        Always returns a History callback (keras convention)."""
+        from flexflow_tpu.frontends.keras.callbacks import (
+            EarlyStopping, History,
+        )
+
+        history = next((c for c in callbacks if isinstance(c, History)), None)
+        if history is None:
+            history = History()
+            callbacks = list(callbacks) + [history]
+        for cb in callbacks:
+            cb.model = self
+            cb.on_train_begin()
+        for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            pm = self.ffmodel.fit(x, y, epochs=1, batch_size=batch_size,
+                                  verbose=verbose)
+            n = max(pm.train_all, 1)
+            loss_field = {
+                LossType.SPARSE_CATEGORICAL_CROSSENTROPY: pm.sparse_cce_loss,
+                LossType.CATEGORICAL_CROSSENTROPY: pm.cce_loss,
+                LossType.MEAN_SQUARED_ERROR_AVG_REDUCE: pm.mse_loss,
+                LossType.MEAN_SQUARED_ERROR_SUM_REDUCE: pm.mse_loss,
+            }.get(self._loss, pm.sparse_cce_loss)
+            logs = {
+                "loss": loss_field / n,
+                "accuracy": pm.train_correct / n,
+            }
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, logs)
+            if any(getattr(cb, "stop_training", False) for cb in callbacks):
+                break
+        for cb in callbacks:
+            cb.on_train_end()
+        return history
 
     def evaluate(self, x, y, batch_size: Optional[int] = None, verbose: bool = True):
         return self.ffmodel.eval(x, y, batch_size=batch_size, verbose=verbose)

@@ -2,9 +2,11 @@
 `ONNXModel(onnx.load(path)).apply(ffmodel, inputs)`).
 
 The onnx package is optional — the class raises a clear ImportError when
-it's missing. Supported ops mirror the reference's set: Gemm/MatMul, Conv,
-Relu/Sigmoid/Tanh/Softmax, MaxPool/AveragePool, Add/Sub/Mul, Concat,
-Flatten, Reshape, Dropout, BatchNormalization.
+it's missing. Supported ops extend the reference's set: Gemm/MatMul, Conv,
+Relu/Sigmoid/Tanh/Softmax/Gelu/Erf, MaxPool/AveragePool, Add/Sub/Mul/Div/
+Pow/Sqrt/Exp, Concat/Split/Gather/Transpose/Squeeze/Unsqueeze, Flatten,
+Reshape, Cast, Dropout, BatchNormalization, LayerNormalization,
+ReduceMean/ReduceSum, TopK.
 """
 
 from __future__ import annotations
@@ -15,6 +17,23 @@ import numpy as np
 
 from flexflow_tpu.ffconst import PoolType
 from flexflow_tpu.model import FFModel, Tensor
+
+
+def _operand(ff: FFModel, env, inits, input_name: str, node_name: str):
+    """Resolve an op input: an env tensor, or a constant initializer
+    materialized as a weight node (handles every ONNX tensor encoding via
+    numpy_helper)."""
+    if input_name in env:
+        return env[input_name]
+    from onnx import numpy_helper
+
+    from flexflow_tpu.runtime.initializer import ArrayInitializer
+
+    arr = numpy_helper.to_array(inits[input_name])
+    t = ff.create_weight(arr.shape, initializer=ArrayInitializer(arr),
+                         name=f"{node_name}_const")
+    env[input_name] = t
+    return t
 
 
 class ONNXModel:
@@ -96,24 +115,11 @@ class ONNXModel:
             elif op == "Softmax":
                 env[node.output[0]] = ff.softmax(env[node.input[0]],
                                                  attr(node, "axis", -1), name=name)
-            elif op in ("Add", "Sub", "Mul"):
+            elif op in ("Add", "Sub", "Mul", "Div"):
                 a = env[node.input[0]]
-                if node.input[1] in env:
-                    b = env[node.input[1]]
-                else:
-                    # constant operand: materialize the initializer as a
-                    # weight node holding its values
-                    from onnx import numpy_helper
-
-                    from flexflow_tpu.runtime.initializer import ArrayInitializer
-
-                    arr = numpy_helper.to_array(inits[node.input[1]])
-                    b = ff.create_weight(
-                        arr.shape, initializer=ArrayInitializer(arr),
-                        name=f"{name}_const",
-                    )
-                    env[node.input[1]] = b
-                fn = {"Add": ff.add, "Sub": ff.subtract, "Mul": ff.multiply}[op]
+                b = _operand(ff, env, inits, node.input[1], name)
+                fn = {"Add": ff.add, "Sub": ff.subtract, "Mul": ff.multiply,
+                      "Div": ff.divide}[op]
                 env[node.output[0]] = fn(a, b, name=name)
             elif op == "Concat":
                 env[node.output[0]] = ff.concat(
@@ -142,6 +148,117 @@ class ONNXModel:
                                                     relu=False, name=name)
             elif op == "Identity":
                 env[node.output[0]] = env[node.input[0]]
+            elif op == "Pow":
+                exp_init = inits.get(node.input[1])
+                if exp_init is None:
+                    raise NotImplementedError(
+                        f"ONNX Pow {name!r}: dynamic exponent not supported"
+                    )
+                from onnx import numpy_helper
+
+                exponent = float(numpy_helper.to_array(exp_init).reshape(-1)[0])
+                env[node.output[0]] = ff.pow(env[node.input[0]], exponent,
+                                             name=name)
+            elif op == "Sqrt":
+                env[node.output[0]] = ff.pow(env[node.input[0]], 0.5, name=name)
+            elif op == "Exp":
+                env[node.output[0]] = ff.exp(env[node.input[0]], name=name)
+            elif op in ("Gelu", "Erf"):
+                # Erf appears inside exported gelu subgraphs; lowering the
+                # whole pattern as gelu matches the reference's HF handling
+                env[node.output[0]] = ff.gelu(env[node.input[0]], name=name)
+            elif op == "Transpose":
+                perm = attr(node, "perm")
+                env[node.output[0]] = ff.transpose(env[node.input[0]],
+                                                   perm, name=name)
+            elif op == "Split":
+                axis = attr(node, "axis", 0)
+                sizes = attr(node, "split")
+                x = env[node.input[0]]
+                if sizes is None and len(node.input) > 1 and node.input[1] in inits:
+                    sizes = [int(s) for s in np.frombuffer(
+                        inits[node.input[1]].raw_data, np.int64)]
+                if sizes is None:
+                    n_out = len(node.output)
+                    sizes = [x.shape[axis] // n_out] * n_out
+                outs = ff.split(x, sizes, axis, name=name)
+                for o_name, o in zip(node.output, outs):
+                    env[o_name] = o
+            elif op == "Gather":
+                # embedding-style gather: data is an initializer table
+                if node.input[0] in inits and node.input[0] not in env:
+                    table = inits[node.input[0]]
+                    dims = list(table.dims)
+                    env[node.output[0]] = ff.embedding(
+                        env[node.input[1]], dims[0], dims[1], name=name
+                    )
+                else:
+                    env[node.output[0]] = ff.gather(
+                        env[node.input[0]], env[node.input[1]],
+                        attr(node, "axis", 0), name=name,
+                    )
+            elif op in ("Squeeze", "Unsqueeze"):
+                x = env[node.input[0]]
+                axes = attr(node, "axes")
+                if axes is None and len(node.input) > 1 and node.input[1] in inits:
+                    axes = [int(s) for s in np.frombuffer(
+                        inits[node.input[1]].raw_data, np.int64)]
+                if op == "Unsqueeze" and axes is None:
+                    raise NotImplementedError(
+                        f"ONNX Unsqueeze {name!r}: axes from a dynamic "
+                        "tensor are not supported"
+                    )
+                shape = list(x.shape)
+                if op == "Squeeze":
+                    axes = sorted([a % len(shape) for a in (axes or
+                                  [i for i, s in enumerate(shape) if s == 1])],
+                                  reverse=True)
+                    for a in axes:
+                        shape.pop(a)
+                else:
+                    for a in sorted(a % (len(shape) + 1) for a in axes):
+                        shape.insert(a, 1)
+                env[node.output[0]] = ff.reshape(x, shape, name=name)
+            elif op == "Cast":
+                from flexflow_tpu.ffconst import DataType
+
+                onnx_to_dt = {1: DataType.FLOAT, 6: DataType.INT32,
+                              7: DataType.INT64, 10: DataType.HALF,
+                              16: DataType.BFLOAT16}
+                to = onnx_to_dt.get(attr(node, "to", 1), DataType.FLOAT)
+                env[node.output[0]] = ff.cast(env[node.input[0]], to, name=name)
+            elif op == "LayerNormalization":
+                env[node.output[0]] = ff.layer_norm(
+                    env[node.input[0]], axes=(attr(node, "axis", -1),),
+                    eps=attr(node, "epsilon", 1e-5), name=name,
+                )
+            elif op in ("ReduceMean", "ReduceSum"):
+                axes = attr(node, "axes")
+                if axes is None and len(node.input) > 1 and node.input[1] in inits:
+                    axes = [int(s) for s in np.frombuffer(
+                        inits[node.input[1]].raw_data, np.int64)]
+                if axes is None:
+                    if len(node.input) > 1:
+                        raise NotImplementedError(
+                            f"ONNX {op} {name!r}: axes from a dynamic "
+                            "tensor are not supported"
+                        )
+                    # per spec: no axes attr = reduce over ALL dims
+                    axes = list(range(len(env[node.input[0]].shape)))
+                keep = bool(attr(node, "keepdims", 1))
+                fn = ff.mean if op == "ReduceMean" else ff.reduce_sum
+                env[node.output[0]] = fn(env[node.input[0]],
+                                         tuple(axes), keepdims=keep,
+                                         name=name)
+            elif op == "TopK":
+                k = attr(node, "k")
+                if k is None and len(node.input) > 1 and node.input[1] in inits:
+                    k = int(np.frombuffer(inits[node.input[1]].raw_data,
+                                          np.int64)[0])
+                vals, idx = ff.top_k(env[node.input[0]], int(k), name=name)
+                env[node.output[0]] = vals
+                if len(node.output) > 1:
+                    env[node.output[1]] = idx
             else:
                 raise NotImplementedError(f"ONNX op {op} not supported")
         return [env[o.name] for o in graph.output]

@@ -74,6 +74,8 @@ class FFModel:
         self._loss_type: Optional[LossType] = None
         self._metrics: List[MetricsType] = []
         self._init_overrides: Dict[str, Dict] = {}
+        self._cache_scores: Dict[str, object] = {}
+        self._cache_snapshots: Dict[str, object] = {}
         self._used_names: set = set()
         self._rng_seed = self.config.seed
         self._step_count = 0
@@ -199,13 +201,26 @@ class FFModel:
     def ring_attention(self, query: Tensor, key: Tensor, value: Tensor,
                        embed_dim: int, num_heads: int, causal: bool = True,
                        kv_heads: Optional[int] = None, rope: bool = False,
-                       rope_theta: float = 10000.0,
+                       rope_theta: float = 10000.0, seq_mode: str = "ring",
                        name: Optional[str] = None) -> Tensor:
         return self._one(
             OpType.RING_ATTENTION,
             A.RingAttentionAttrs(embed_dim, num_heads, kv_heads, None, causal,
-                                 False, 0.0, rope, rope_theta),
+                                 False, 0.0, rope, rope_theta, seq_mode),
             [query, key, value], name or "ring_attention",
+        )
+
+    def ulysses_attention(self, query: Tensor, key: Tensor, value: Tensor,
+                          embed_dim: int, num_heads: int, causal: bool = True,
+                          kv_heads: Optional[int] = None, rope: bool = False,
+                          rope_theta: float = 10000.0,
+                          name: Optional[str] = None) -> Tensor:
+        """Sequence parallelism via seq<->head all-to-all exchange
+        (DeepSpeed-Ulysses; lowers through OpType.ALL_TO_ALL semantics)."""
+        return self.ring_attention(
+            query, key, value, embed_dim, num_heads, causal=causal,
+            kv_heads=kv_heads, rope=rope, rope_theta=rope_theta,
+            seq_mode="ulysses", name=name or "ulysses_attention",
         )
 
     def silu(self, x, name=None):
@@ -421,8 +436,40 @@ class FFModel:
         agg_inputs = [topk_values, topk_assign, topk_assign, gate_sm] + expert_outs
         return self.aggregate(agg_inputs, num_exp, lambda_bal, name=name)
 
-    def cache(self, input: Tensor, name=None) -> Tensor:
-        return self._one(OpType.CACHE, A.CacheAttrs(), [input], name or "cache")
+    def cache(self, input: Tensor, score_func=None, name=None) -> Tensor:
+        """Activation cache (reference src/ops/cache.cc). During training
+        the op stores its input into a non-trainable buffer each step;
+        `score_func(old, new) -> float` (the reference's user score, e.g.
+        moe.cc similarity) is evaluated host-side via `cache_score(name)`
+        — typically inside a RecompileState trigger that swaps the model
+        between recompute and cached modes when the score degrades."""
+        name = name or "cache"
+        t = self._one(OpType.CACHE, A.CacheAttrs(), [input], name)
+        if score_func is not None:
+            self._cache_scores[t.node.name] = score_func
+        return t
+
+    def cache_score(self, name: str) -> float:
+        """Run the cache's score function on (previous snapshot, current
+        buffer); snapshots the current buffer for the next call. Returns
+        1.0 on the first call (nothing to compare)."""
+        import numpy as np_
+
+        node = next(n for n in self.graph.nodes if n.name == name)
+        key = node_key(node)
+        _, ntr = self._params
+        cur = np_.asarray(ntr[key]["cached"])
+        prev = self._cache_snapshots.get(name)
+        self._cache_snapshots[name] = cur
+        if prev is None:
+            return 1.0
+        fn = self._cache_scores.get(name)
+        if fn is None:
+            # default score: cosine-like similarity (reference default is a
+            # user-provided function; this mirrors the moe.cc example)
+            denom = float((prev * prev).sum() ** 0.5 * (cur * cur).sum() ** 0.5)
+            return float((prev * cur).sum()) / max(denom, 1e-30)
+        return float(fn(prev, cur))
 
     # ------------------------------------------------------------------
     # compile / fit / eval  (reference flexflow_cffi.py:2004-2088)

@@ -48,17 +48,21 @@ class LlamaConfig:
 
 def build_llama(ff: FFModel, cfg: LlamaConfig, batch_size: int = None,
                 seq_len: int = 2048, dtype: DataType = DataType.BFLOAT16,
-                use_ring_attention: bool = False) -> Tensor:
+                use_ring_attention: bool = False,
+                seq_mode: str = "ring") -> Tensor:
     b = batch_size or ff.config.batch_size
     ids = ff.create_tensor((b, seq_len), DataType.INT32, name="input_ids")
     h = ff.embedding(ids, cfg.vocab_size, cfg.dim, dtype=dtype, name="tok_emb")
     for i in range(cfg.layers):
         a = ff.rms_norm(h, eps=cfg.norm_eps, name=f"l{i}_attn_norm")
-        attn_fn = ff.ring_attention if use_ring_attention else (
-            lambda q, k, v, e, nh, **kw: ff.multihead_attention(
+        if use_ring_attention:
+            attn_fn = lambda q, k, v, e, nh, **kw: ff.ring_attention(
+                q, k, v, e, nh, seq_mode=seq_mode, **kw
+            )
+        else:
+            attn_fn = lambda q, k, v, e, nh, **kw: ff.multihead_attention(
                 q, k, v, e, nh, bias=False, **kw
             )
-        )
         a = attn_fn(a, a, a, cfg.dim, cfg.heads, causal=True,
                     kv_heads=cfg.kv_heads, rope=True, rope_theta=cfg.rope_theta,
                     name=f"l{i}_attn")

@@ -271,11 +271,16 @@ class MultiHeadAttentionAttrs(OpAttrs):
 
 @dataclasses.dataclass(frozen=True)
 class RingAttentionAttrs(MultiHeadAttentionAttrs):
-    """Sequence-parallel ring attention (net-new vs reference, SURVEY §5.7):
-    identical math to MultiHeadAttention with the sequence dim sharded over a
-    mesh axis; lowering overlaps blockwise attention with ICI ppermute."""
+    """Sequence-parallel attention (net-new vs reference, SURVEY §5.7):
+    identical math to MultiHeadAttention with the sequence dim sharded over
+    a mesh axis. `seq_mode` picks the exchange pattern:
+      - "ring":    k/v blocks rotate via ppermute, blockwise online softmax
+                   overlapping compute with ICI transfer;
+      - "ulysses": one all-to-all turns seq sharding into head sharding,
+                   full attention runs locally, a second all-to-all turns
+                   it back (DeepSpeed-Ulysses pattern)."""
 
-    pass
+    seq_mode: str = "ring"
 
 
 # ---------------------------------------------------------------------------
@@ -617,6 +622,10 @@ class ExpertsAttrs(OpAttrs):
     alpha: float = 1.0
     activation: ActiMode = ActiMode.GELU
     lambda_bal: float = 1e-2
+    # renormalize the top-k gate probs to sum 1 (Mixtral convention); False
+    # matches the composite group_by/aggregate path, which combines with
+    # raw softmax probs (reference aggregate.cc)
+    normalize: bool = True
 
     def capacity(self, batch: int) -> int:
         return max(1, int(math.ceil(self.k * batch * self.alpha / self.n_experts)))

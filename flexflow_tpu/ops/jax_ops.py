@@ -559,7 +559,8 @@ def _experts(attrs, inputs, params, ctx):
     t = xt.shape[0]
     probs = jax.nn.softmax(gl.astype(jnp.float32), axis=-1)
     topv, topi = lax.top_k(probs, attrs.k)  # (t,k)
-    topv = topv / topv.sum(axis=-1, keepdims=True)
+    if attrs.normalize:
+        topv = topv / topv.sum(axis=-1, keepdims=True)
     cap = attrs.capacity(t)
     disp = _dispatch_mask(topi.astype(jnp.int32), attrs.n_experts, cap)  # (t,k,n,c)
     combine = disp * topv[..., None, None]

@@ -113,6 +113,42 @@ class ReductionAttrs(OpAttrs):
 
 
 @dataclasses.dataclass(frozen=True)
+class FusedParallelOpAttrs(OpAttrs):
+    """A chain of parallel-op steps fused into ONE resharding node
+    (reference src/parallel_ops/fused_parallel_op.cc; fusion enabled by
+    SimplificationSettings.fuse_parallel_ops, substitution.cc:1924). Each
+    step is (kind, dim, axes) with kind in repartition|combine|replicate|
+    reduction|all_to_all. On TPU the whole chain is a single sharding
+    constraint — XLA emits one fused collective where possible — and the
+    cost model prices the steps with a single latency term."""
+
+    steps: Tuple[Tuple[str, int, Tuple[str, ...]], ...]
+
+    def infer(self, x: ParallelTensorShape):
+        dims = list(x.dims)
+        for kind, dim, axes in self.steps:
+            if kind == "repartition":
+                dims[dim] = ParallelDim(dims[dim].size, dims[dim].degree,
+                                        tuple(axes))
+            elif kind in ("combine", "reduction", "replicate"):
+                if 0 <= dim < len(dims):
+                    dims[dim] = ParallelDim(dims[dim].size)
+            elif kind == "all_to_all":
+                dims[dim] = ParallelDim(dims[dim].size, dims[dim].degree,
+                                        tuple(axes))
+        return (dataclasses.replace(x, dims=tuple(dims)),)
+
+    def final_spec(self, ndim: int) -> Spec:
+        spec = [()] * ndim
+        for kind, dim, axes in self.steps:
+            if kind in ("repartition", "all_to_all") and 0 <= dim < ndim:
+                spec[dim] = tuple(axes)
+            elif kind in ("combine", "reduction", "replicate") and 0 <= dim < ndim:
+                spec[dim] = ()
+        return tuple(spec)
+
+
+@dataclasses.dataclass(frozen=True)
 class AllToAllAttrs(OpAttrs):
     """Move sharding from `src_dim` to `dst_dim` (Ulysses sequence<->head
     exchange; net-new vs reference, whose closest analog is
@@ -154,7 +190,9 @@ def _make_parallel_lowering(op_type):
     def _lower(attrs, inputs, params, ctx):
         (x,) = inputs
         spec = None
-        if hasattr(attrs, "spec") and isinstance(attrs, RepartitionAttrs):
+        if isinstance(attrs, FusedParallelOpAttrs):
+            spec = attrs.final_spec(x.ndim)
+        elif hasattr(attrs, "spec") and isinstance(attrs, RepartitionAttrs):
             spec = attrs.spec(x.ndim)
         elif isinstance(attrs, AllToAllAttrs):
             spec = tuple(attrs.axes if i == attrs.dst_dim else () for i in range(x.ndim))
@@ -173,5 +211,6 @@ for _t in (
     OpType.REPLICATE,
     OpType.REDUCTION,
     OpType.ALL_TO_ALL,
+    OpType.FUSED_PARALLEL,
 ):
     _make_parallel_lowering(_t)

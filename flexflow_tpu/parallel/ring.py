@@ -127,6 +127,65 @@ def ring_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
     return _shard_map(fn, mesh, (spec, spec, spec), spec)(q, k, v)
 
 
+def ulysses_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
+                                  seq_axis: str = "seq", batch_axis: str = "data",
+                                  head_axis: str = "model"):
+    """DeepSpeed-Ulysses sequence parallelism: q/k/v arrive seq-sharded;
+    ONE all-to-all over the seq axis re-shards heads instead of sequence
+    (each device gets ALL positions of H/n heads), full attention runs
+    locally, and a second all-to-all restores seq sharding. Lowers the
+    OpType.ALL_TO_ALL pattern (parallel_ops.py) into lax.all_to_all pairs.
+    Requires heads % seq_degree == 0."""
+    n = _mesh_axis_size(mesh, seq_axis)
+    from flexflow_tpu.ops import jax_ops
+
+    if n == 1:
+        return jax_ops.fused_attention(q, k, v, causal=causal, scale=scale,
+                                       mesh=mesh)
+    H = q.shape[2]
+    h_deg = _mesh_axis_size(mesh, head_axis)
+    # the all_to_all splits each shard's LOCAL heads (H / head_degree) n
+    # ways — check divisibility at that granularity, not globally
+    local_heads = H // h_deg if H % h_deg == 0 else H
+    if local_heads % n != 0:
+        return ring_dot_product_attention(
+            q, k, v, mesh=mesh, causal=causal, scale=scale,
+            seq_axis=seq_axis, batch_axis=batch_axis, head_axis=head_axis,
+        )
+    jax_ops.LAST_ATTENTION_KERNEL = "ulysses_all_to_all"
+
+    ba = batch_axis if _mesh_axis_size(mesh, batch_axis) > 1 else None
+    ha = head_axis if h_deg > 1 and H % h_deg == 0 else None
+    spec = P(ba, seq_axis, ha, None)
+
+    def fn(ql, kl, vl):
+        # (B, s_loc, H, D) -> (B, S, H/n, D): split heads, gather sequence
+        ex = lambda t: lax.all_to_all(t, seq_axis, split_axis=2,
+                                      concat_axis=1, tiled=True)
+        qh, kh, vh = ex(ql), ex(kl), ex(vl)
+        out = _dot_attention_local(qh, kh, vh, causal, scale)
+        # (B, S, H/n, D) -> (B, s_loc, H, D)
+        return lax.all_to_all(out, seq_axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    return _shard_map(fn, mesh, (spec, spec, spec), spec,
+                      check_vma=False)(q, k, v)
+
+
+def _dot_attention_local(q, k, v, causal, scale):
+    """Per-shard full attention used inside the Ulysses body (flash when
+    the local backend supports it)."""
+    from flexflow_tpu.ops.jax_ops import _dot_product_attention
+    from flexflow_tpu.ops.pallas import (
+        flash_attention,
+        flash_attention_available,
+    )
+
+    if flash_attention_available(q.shape[1], k.shape[1]):
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return _dot_product_attention(q, k, v, causal, scale)
+
+
 def ring_attention_lowering(attrs, inputs, params, ctx):
     """Lowering for OpType.RING_ATTENTION: same projections as
     MULTIHEAD_ATTENTION, ring core for the attention itself."""
@@ -149,7 +208,12 @@ def ring_attention_lowering(attrs, inputs, params, ctx):
         rep = attrs.num_heads // attrs.num_kv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    out = ring_dot_product_attention(
+    seq_attn = (
+        ulysses_dot_product_attention
+        if getattr(attrs, "seq_mode", "ring") == "ulysses"
+        else ring_dot_product_attention
+    )
+    out = seq_attn(
         q, k, v, mesh=ctx.mesh, causal=attrs.causal, scale=1.0 / (hd**0.5)
     )
     y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))

@@ -183,9 +183,14 @@ class Graph:
 
     def infer_shapes(self):
         """Run shape inference over the whole graph in topo order. Each
-        node's attrs.infer(input_shapes) -> output shapes."""
+        node's attrs.infer(input_shapes) -> output shapes. A node whose
+        producers live outside this graph (a boundary node of a sequence
+        split) keeps its previously inferred shapes — in_shapes/outputs
+        are caches stamped when the full graph was inferred."""
         for node in self.topo_order():
             ins = self.input_shapes(node)
+            if node.in_shapes and len(ins) < len(node.in_shapes):
+                continue  # producers outside this subgraph: keep cache
             node.in_shapes = tuple(ins)
             if node.attrs is not None:
                 node.outputs = tuple(node.attrs.infer(*ins))
@@ -201,6 +206,8 @@ class Graph:
         pos = {n.guid: i for i, n in enumerate(order)}
         cut = pos[node.guid]
         first, second = Graph(), Graph()
+        first._guid_counter = self._guid_counter
+        second._guid_counter = self._guid_counter
         for n in order:
             if pos[n.guid] <= cut:
                 first.add_node(n)
@@ -228,6 +235,8 @@ class Graph:
         """Parallel-branch split (reference graph.cc:1113): partition nodes
         into `include` and the rest; no edges may cross."""
         a, b = Graph(), Graph()
+        a._guid_counter = self._guid_counter
+        b._guid_counter = self._guid_counter
         inc = {n.guid for n in include}
         for n in self.nodes:
             (a if n.guid in inc else b).add_node(n)
@@ -298,7 +307,8 @@ class Graph:
         g._guid_counter = self._guid_counter
         for n in self.nodes:
             g.add_node(
-                Node(n.guid, n.op_type, n.attrs, n.name, n.outputs, n.sharding)
+                Node(n.guid, n.op_type, n.attrs, n.name, n.outputs,
+                     n.sharding, n.in_shapes)
             )
         for n in self.nodes:
             for e in self._out[n.guid]:

@@ -88,12 +88,19 @@ def search_strategy(graph, mesh, config) -> Dict[str, ShardingView]:
 def graph_optimize(graph: Graph, mesh, config) -> Tuple[Graph, Dict[str, ShardingView]]:
     """Full Unity search: substitutions + view DP. Returns (possibly
     rewritten graph, strategy)."""
-    from flexflow_tpu.search.substitution import unity_search
+    from flexflow_tpu.search.substitution import (
+        sequence_unity_search,
+        unity_search,
+    )
 
     cost = _cost_model(mesh, config)
     _maybe_measure(cost, graph, config)
     memory_limit = cost.machine.memory_per_chip() if config.memory_search else None
-    best_graph, strategy, best_time = unity_search(
+    # deep graphs: sequence-DP decomposition at module boundaries
+    # (generic_sequence_optimize, substitution.cc:2572) — per-module
+    # best-first is ~linear in depth where the flat search is not
+    search_fn = sequence_unity_search if len(graph) > 40 else unity_search
+    best_graph, strategy, best_time = search_fn(
         graph,
         cost,
         budget=config.search_budget,

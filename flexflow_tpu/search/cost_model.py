@@ -114,6 +114,31 @@ class CostModel:
         if node.op_type == OpType.ALL_TO_ALL and ins:
             deg = max(axes_degree(getattr(node.attrs, "axes", ())), 2)
             return self.machine.all_to_all_time(ins[0].global_bytes(), deg)
+        if node.op_type == OpType.FUSED_PARALLEL and ins:
+            # fused chain: pay each step's bandwidth but ONE latency term
+            # (the reference fuses the chain into a single task,
+            # fused_parallel_op.cc)
+            total, lat = 0.0, 0.0
+            nbytes = ins[0].global_bytes()
+            for kind, _dim, axes in node.attrs.steps:
+                # same default as the unfused nodes (axes or "model"), so
+                # fusing never changes the priced degree of a step
+                deg = axes_degree(axes or ("model",))
+                if deg <= 1:
+                    continue
+                if kind == "reduction":
+                    t = self.machine.all_reduce_time(nbytes, deg)
+                elif kind == "combine":
+                    t = self.machine.all_gather_time(nbytes, deg)
+                elif kind == "all_to_all":
+                    t = self.machine.all_to_all_time(nbytes, deg)
+                elif kind == "replicate":
+                    t = self.machine.all_gather_time(nbytes, deg)
+                else:  # repartition: local slice
+                    t = 0.0
+                lat = max(lat, self.machine.ici_latency * deg)
+                total += max(t - self.machine.ici_latency * deg, 0.0)
+            return total + lat
         if node.op_type in PARALLEL_OP_TYPES:
             return 0.0
         # expert parallelism: an EXPERTS op whose weight stack is sharded

@@ -76,16 +76,33 @@ class ViewDP:
             if product > self.product_cap:
                 break
         if product <= self.product_cap:
-            # exhaustive product (optimal for this module)
-            names = list(cands)
-            best, best_cost = dict(fixed), float("inf")
-            for combo in itertools.product(*(cands[n] for n in names)):
-                s = dict(fixed)
-                s.update(dict(zip(names, combo)))
-                c = self._eval(graph, s)
+            # exhaustive product (optimal for this module). Costs are
+            # priced ONCE per (node, view) and per edge view-pair into
+            # tables (the reference's strict-hash cost cache discipline);
+            # each combination is then a cheap table sum instead of a full
+            # graph_cost walk.
+            from flexflow_tpu.search.table import build_table
+
+            base = dict(fixed)
+            for n in graph.nodes:
+                if n.name not in base and n.outputs:
+                    base[n.name] = space.ShardingView(
+                        (space.batch_spec(n.outputs[0].ndim),)
+                    )
+            table = build_table(graph, self.cost, cands, base, self.training)
+            searchable = table.searchable()
+            assign = [0] * len(table.nodes)
+            best_assign, best_cost = list(assign), table.eval(assign)[0]
+            view_counts = [len(table.views[i]) for i in searchable]
+            for combo in itertools.product(*(range(c) for c in view_counts)):
+                for idx, k in zip(searchable, combo):
+                    assign[idx] = k
+                c = table.eval(assign)[0]
                 if c < best_cost:
-                    best, best_cost = s, c
-            return best
+                    best_assign, best_cost = list(assign), c
+            strategy = dict(fixed)
+            strategy.update(table.to_strategy(best_assign))
+            return strategy
 
         # sequence split at a bottleneck (graph.cc:115)
         if len(graph) > self.max_exhaustive:

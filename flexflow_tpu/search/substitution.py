@@ -20,7 +20,7 @@ import heapq
 import itertools
 from typing import Callable, Dict, List, Optional, Tuple
 
-from flexflow_tpu.ffconst import ActiMode, OpType
+from flexflow_tpu.ffconst import ActiMode, OpType, PARALLEL_OP_TYPES
 from flexflow_tpu.ops import attrs as A
 from flexflow_tpu.parallel.parallel_ops import (
     CombineAttrs,
@@ -35,13 +35,14 @@ from flexflow_tpu.search.cost_model import CostModel, graph_cost
 
 @dataclasses.dataclass
 class OpX:
-    """One pattern node: match by op type + optional predicate on attrs."""
+    """One pattern node: match by op type (None = any) + optional
+    predicate on attrs (reference OpX, substitution.h:40)."""
 
-    op_type: OpType
+    op_type: Optional[OpType]
     predicate: Optional[Callable[[Node], bool]] = None
 
     def matches(self, node: Node) -> bool:
-        if node.op_type != self.op_type:
+        if self.op_type is not None and node.op_type != self.op_type:
             return False
         return self.predicate(node) if self.predicate else True
 
@@ -257,6 +258,61 @@ def make_fuse_linear_activation() -> GraphXfer:
     )
 
 
+def make_fuse_parallel_ops() -> GraphXfer:
+    """Fuse two adjacent parallel-op nodes into one FusedParallelOp
+    (reference SimplificationSettings.fuse_parallel_ops applied in
+    substitution.cc:1924-1930; op src/parallel_ops/fused_parallel_op.cc)."""
+    from flexflow_tpu.parallel.parallel_ops import FusedParallelOpAttrs
+
+    def step_of(node: Node):
+        a = node.attrs
+        if isinstance(a, FusedParallelOpAttrs):
+            return list(a.steps)
+        if isinstance(a, RepartitionAttrs):
+            return [("repartition", a.dim, tuple(a.axes))]
+        if isinstance(a, CombineAttrs):
+            return [("combine", a.dim, tuple(a.axes))]
+        if isinstance(a, ReplicateAttrs):
+            return [("replicate", -1, tuple(a.axes))]
+        if isinstance(a, ReductionAttrs):
+            return [("reduction", -1, tuple(a.axes))]
+        return None
+
+    def rewrite(graph: Graph, match: List[Node]) -> Optional[Graph]:
+        first, second = match
+        s1, s2 = step_of(first), step_of(second)
+        if s1 is None or s2 is None:
+            return None
+        g = graph.copy()
+        f, s = g.node(first.guid), g.node(second.guid)
+        in_e = g.in_edges(f)[0]
+        out_edges = list(g.out_edges(s))
+        mid = g.in_edges(s)[0]
+        for e in [in_e, mid] + out_edges:
+            g.remove_edge(e)
+        g.remove_node(f)
+        g.remove_node(s)
+        fused = g.create_node(
+            OpType.FUSED_PARALLEL,
+            FusedParallelOpAttrs(tuple(s1 + s2)),
+            f"{first.name}_{second.name}_fused",
+        )
+        g.add_edge(g.node(in_e.src), fused, in_e.src_idx, 0)
+        for e in out_edges:
+            g.add_edge(fused, g.node(e.dst), 0, e.dst_idx)
+        g.infer_shapes()
+        return g
+
+    pl = [OpType.REPARTITION, OpType.COMBINE, OpType.REPLICATE,
+          OpType.REDUCTION, OpType.FUSED_PARALLEL]
+    return GraphXfer(
+        "fuse_parallel_ops",
+        [OpX(None, lambda n: n.op_type in pl),
+         OpX(None, lambda n: n.op_type in pl)],
+        rewrite,
+    )
+
+
 def make_cancel_parallel_ops() -> GraphXfer:
     """Repartition followed by Combine on the same dim cancels (the
     SimplificationSettings.fuse_parallel_ops pass, substitution.cc:1924)."""
@@ -287,7 +343,8 @@ def make_cancel_parallel_ops() -> GraphXfer:
 
 
 def default_xfers(axis_sizes: Dict[str, int]) -> List[GraphXfer]:
-    xf = [make_fuse_linear_activation(), make_cancel_parallel_ops()]
+    xf = [make_fuse_linear_activation(), make_cancel_parallel_ops(),
+          make_fuse_parallel_ops()]
     if axis_sizes.get("model", 1) > 1:
         xf += [
             make_partition_linear_combine("model"),
@@ -295,6 +352,132 @@ def default_xfers(axis_sizes: Dict[str, int]) -> List[GraphXfer]:
             make_partition_attention_combine("model"),
         ]
     return xf
+
+
+# ---------------------------------------------------------------------------
+# sequence decomposition (generic_sequence_optimize, substitution.cc:2572)
+
+
+def find_split_nodes(graph: Graph) -> List[Node]:
+    """All valid sequence-split points in topo order (reference
+    find_split_node, substitution.cc:2094): positions no edge jumps over.
+    On a transformer these are the residual-add chain — the module
+    boundaries the sequence DP splits at."""
+    order = graph.topo_order()
+    pos = {n.guid: i for i, n in enumerate(order)}
+    far = -1
+    splits = []
+    for i, n in enumerate(order):
+        if 0 < i < len(order) - 1 and far <= i:
+            splits.append(n)
+        for e in graph.out_edges(n):
+            far = max(far, pos[e.dst])
+    return splits
+
+
+def _glue(parts: List[Graph]) -> Graph:
+    """Reassemble sequence modules into one graph (boundary nodes appear in
+    two consecutive parts and are deduped by guid)."""
+    out = Graph()
+    out._guid_counter = parts[-1]._guid_counter  # shared counter object
+    seen_nodes = set()
+    seen_edges = set()
+    for g in parts:
+        for n in g.topo_order():
+            if n.guid not in seen_nodes:
+                seen_nodes.add(n.guid)
+                out.add_node(n)
+    for g in parts:
+        for n in g.topo_order():
+            for e in g.out_edges(n):
+                key = (e.src, e.dst, e.src_idx, e.dst_idx)
+                if key not in seen_edges:
+                    seen_edges.add(key)
+                    out.add_edge(out.node(e.src), out.node(e.dst),
+                                 e.src_idx, e.dst_idx)
+    out.infer_shapes()
+    return out
+
+
+def sequence_unity_search(
+    graph: Graph,
+    cost: CostModel,
+    *,
+    budget: int = 20,
+    alpha: float = 1.05,
+    training: bool = True,
+    xfers: Optional[List[GraphXfer]] = None,
+    memory_limit: Optional[float] = None,
+    min_module: int = 6,
+) -> Tuple[Graph, Dict[str, ShardingView], float]:
+    """Sequence-DP outer decomposition (reference generic_sequence_optimize,
+    substitution.cc:2572): split the PCG at module boundaries, run the
+    budgeted best-first substitution search per module, and stitch the
+    rewritten modules + strategies back together. Keeps the search tractable
+    on deep graphs (a 32-layer Llama is ~66 small solves instead of one
+    best-first over ~450 nodes)."""
+    splits = [
+        s for s in find_split_nodes(graph)
+        if s.op_type not in PARALLEL_OP_TYPES
+    ]
+    # space the splits so each module has at least min_module nodes
+    order_pos = {n.guid: i for i, n in enumerate(graph.topo_order())}
+    spaced, last = [], -min_module
+    for s in splits:
+        if order_pos[s.guid] - last >= min_module:
+            spaced.append(s)
+            last = order_pos[s.guid]
+    if len(spaced) < 2 or len(graph) <= 2 * min_module:
+        return unity_search(graph, cost, budget=budget, alpha=alpha,
+                            training=training, xfers=xfers,
+                            memory_limit=memory_limit)
+
+    modules: List[Graph] = []
+    rest = graph
+    for s in spaced:
+        if s.guid not in {n.guid for n in rest.nodes}:
+            continue
+        try:
+            first, rest = rest.split_at_node(rest.node(s.guid))
+        except ValueError:
+            continue
+        modules.append(first)
+    modules.append(rest)
+
+    rewritten: List[Graph] = []
+    strategy: Dict[str, ShardingView] = {}
+    total = 0.0
+    for i, mod in enumerate(modules):
+        # all modules share the source graph's guid counter object (set by
+        # split_at_node), so rewrites across modules can never collide
+        boundary_guids = {n.guid for n in mod.nodes} & (
+            {n.guid for n in modules[i + 1].nodes} if i + 1 < len(modules)
+            else set()
+        )
+        g, s, t = unity_search(mod, cost, budget=budget, alpha=alpha,
+                               training=training, xfers=xfers,
+                               memory_limit=memory_limit)
+        # a rewrite must keep the shared boundary node AND keep it a sink
+        # of this module (a rewrite appending e.g. a Combine after a
+        # boundary Linear would make the next module's consumers bypass
+        # it when re-glued); otherwise fall back to the unrewritten module
+        bad = boundary_guids - {n.guid for n in g.nodes}
+        if not bad:
+            for bg in boundary_guids:
+                if g.out_edges(g.node(bg)):
+                    bad = {bg}
+                    break
+        if bad:
+            from flexflow_tpu.search.dp import ViewDP
+
+            g = mod
+            s = ViewDP(cost, training=training).optimize(mod)
+        rewritten.append(g)
+        strategy.update(s)
+        total += t
+    merged = _glue(rewritten)
+    gc = graph_cost(merged, strategy, cost, training)
+    return merged, strategy, gc.time
 
 
 # ---------------------------------------------------------------------------

@@ -209,3 +209,70 @@ def test_keras_unknown_activation_raises():
     with pytest.raises((ValueError, KeyError)):
         # layers apply lazily at compile time
         m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+
+
+def test_keras_callbacks_and_datasets():
+    """Callbacks (History/EarlyStopping/LearningRateScheduler) + synthetic
+    mnist dataset through the keras fit loop."""
+    from flexflow_tpu.frontends import keras
+    from flexflow_tpu.frontends.keras.callbacks import (
+        EarlyStopping, History, LearningRateScheduler,
+    )
+
+    (xtr, ytr), _ = keras.datasets.mnist.load_data(n_train=512, n_test=64)
+    x = (xtr.reshape(512, 784) / 255.0).astype(np.float32)
+    y = ytr.astype(np.int32)
+
+    model = keras.Sequential(config=FFConfig(batch_size=64))
+    model.add_input((784,))
+    model.add(keras.Dense(64, activation="relu"))
+    model.add(keras.Dense(10))
+    model.add(keras.Activation("softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"])
+
+    lrs = []
+    sched = LearningRateScheduler(lambda e, lr: lrs.append(lr) or 0.05 * (0.9 ** e))
+    es = EarlyStopping(monitor="accuracy", mode="max", patience=10)
+    hist = model.fit(x, y, epochs=4, verbose=False, callbacks=[sched, es])
+
+    assert len(hist.history["loss"]) == 4
+    assert len(lrs) == 4 and lrs[1] != lrs[2]  # lr actually changed
+    assert hist.history["accuracy"][-1] > 0.5
+    assert not es.stop_training
+
+
+def test_keras_early_stopping_halts():
+    from flexflow_tpu.frontends import keras
+    from flexflow_tpu.frontends.keras.callbacks import EarlyStopping
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 16).astype(np.float32)
+    y = rs.randint(0, 2, 128).astype(np.int32)  # pure noise: no improvement
+    model = keras.Sequential(config=FFConfig(batch_size=32))
+    model.add_input((16,))
+    model.add(keras.Dense(4))
+    model.add(keras.Activation("softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["sparse_categorical_crossentropy"])
+    es = EarlyStopping(monitor="loss", patience=0, min_delta=10.0)
+    hist = model.fit(x, y, epochs=10, verbose=False, callbacks=[es])
+    assert len(hist.history["loss"]) < 10  # stopped early
+
+
+def test_keras_model_checkpoint(tmp_path):
+    from flexflow_tpu.frontends import keras
+    from flexflow_tpu.frontends.keras.callbacks import ModelCheckpoint
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 8).astype(np.float32)
+    y = rs.randint(0, 2, 64).astype(np.int32)
+    model = keras.Sequential(config=FFConfig(batch_size=32))
+    model.add_input((8,))
+    model.add(keras.Dense(2))
+    model.add(keras.Activation("softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    ck = ModelCheckpoint(str(tmp_path / "ck_{epoch}"), save_freq=2)
+    model.fit(x, y, epochs=2, verbose=False, callbacks=[ck])
+    import os
+    assert os.path.exists(str(tmp_path / "ck_1"))

@@ -191,3 +191,44 @@ def test_dlrm_trains_mse():
     m = ff.fit([dense] + sparse, y, epochs=2, verbose=False)
     assert m.train_all == 64  # metrics reset each epoch
     assert np.isfinite(m.mse_loss)
+
+
+def test_llama_ulysses_attention_matches_full():
+    """Ulysses (all-to-all) sequence parallelism == full attention
+    numerics on a data x seq mesh (heads divisible by seq degree)."""
+    lcfg = LlamaConfig.tiny()  # 4 heads
+    x, _ = lm_data(lcfg.vocab_size, 4, 64)
+
+    ff_full = FFModel(FFConfig(batch_size=4, seed=3))
+    build_llama(ff_full, lcfg, seq_len=64, dtype=DataType.FLOAT)
+    ff_full.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    out_full = ff_full.predict(x)
+
+    ff_u = FFModel(
+        FFConfig(batch_size=4, seed=3, mesh_shape={"data": 2, "seq": 4})
+    )
+    build_llama(ff_u, lcfg, seq_len=64, dtype=DataType.FLOAT,
+                use_ring_attention=True, seq_mode="ulysses")
+    ff_u.compile(
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=llama_tp_strategy(lcfg, seq_parallel=True),
+    )
+    out_u = ff_u.predict(x)
+    from flexflow_tpu.ops import jax_ops
+    assert jax_ops.LAST_ATTENTION_KERNEL == "ulysses_all_to_all"
+    np.testing.assert_allclose(out_full, out_u, rtol=2e-3, atol=2e-5)
+
+
+def test_llama_ulysses_trains():
+    lcfg = LlamaConfig.tiny()
+    ff = FFModel(FFConfig(batch_size=4, mesh_shape={"data": 2, "seq": 4}))
+    build_llama(ff, lcfg, seq_len=64, dtype=DataType.FLOAT,
+                use_ring_attention=True, seq_mode="ulysses")
+    ff.compile(
+        optimizer=AdamOptimizer(lr=1e-3),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=llama_tp_strategy(lcfg, seq_parallel=True),
+    )
+    x, y = lm_data(lcfg.vocab_size, 8, 64)
+    m = ff.fit(x, y, epochs=1, verbose=False)
+    assert m.train_all == 8

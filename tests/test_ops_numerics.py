@@ -228,3 +228,88 @@ def test_predict_partial_batch():
     ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
     preds = ff.predict(rand(13, 4))  # 13 rows: not a multiple of 8
     assert preds.shape == (13, 3)
+
+
+def test_experts_matches_composite_moe_path():
+    """VERDICT r1 item 8: the fused EXPERTS op and the composite
+    group_by -> per-expert FFN -> aggregate pipeline produce identical
+    outputs given the same weights/routing."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops import attrs as A
+    from flexflow_tpu.ops.registry import LowerCtx
+
+    rs = np.random.RandomState(0)
+    b, d, h, n, k = 16, 8, 12, 4, 2
+    x = jnp.asarray(rs.randn(b, d), jnp.float32)
+    gate_logits = jnp.asarray(rs.randn(b, n), jnp.float32)
+    w1 = jnp.asarray(rs.randn(n, d, h) * 0.3, jnp.float32)
+    w2 = jnp.asarray(rs.randn(n, h, d) * 0.3, jnp.float32)
+
+    ctx = lambda: LowerCtx(training=False, rng=jax.random.key(0), mesh=None,
+                           seq_length=None, node_guid=0)
+
+    # fused op (normalize=False to match the composite's raw gate probs)
+    ex_attrs = A.ExpertsAttrs(n, k, h, d, alpha=float(n), lambda_bal=0.0,
+                              activation=ActiMode.GELU, normalize=False)
+    fused = get_lowering(OpType.EXPERTS)(
+        ex_attrs, [x, gate_logits], {"w1": w1, "w2": w2}, ctx()
+    )[0]
+
+    # composite: softmax -> top_k -> group_by -> per-expert 2-layer FFN
+    # -> aggregate, all through the ops' own lowerings
+    probs = get_lowering(OpType.SOFTMAX)(
+        A.SoftmaxAttrs(-1), [gate_logits], {}, ctx()
+    )[0]
+    topv, topi = get_lowering(OpType.TOPK)(
+        A.TopKAttrs(k), [probs], {}, ctx()
+    )
+    gb_attrs = A.GroupByAttrs(n, alpha=float(n))
+    grouped = get_lowering(OpType.GROUP_BY)(
+        gb_attrs, [x, topi], {}, ctx()
+    )
+    assert gb_attrs.capacity(b, k) == ex_attrs.capacity(b)
+    expert_outs = []
+    for i in range(n):
+        hcol = jnp.dot(grouped[i], w1[i])
+        hcol = jax.nn.gelu(hcol)
+        expert_outs.append(jnp.dot(hcol, w2[i]))
+    agg = get_lowering(OpType.AGGREGATE)(
+        A.AggregateAttrs(n, 0.0),
+        [topv, topi, topi, probs] + expert_outs, {}, ctx(),
+    )[0]
+
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(agg),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_aggregate_lambda_bal_gradient_flows_to_gate():
+    """The load-balance term must produce a nonzero gradient through the
+    full gate distribution (reference aggregate.cu lambda_bal)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops import attrs as A
+    from flexflow_tpu.ops.registry import LowerCtx
+
+    rs = np.random.RandomState(1)
+    b, d, n, k, cap = 8, 4, 4, 2, 4
+    topv = jnp.asarray(rs.rand(b, k), jnp.float32)
+    topi = jnp.asarray(rs.randint(0, n, (b, k)), jnp.int32)
+    experts = [jnp.asarray(rs.randn(cap, d), jnp.float32) for _ in range(n)]
+
+    def loss(gate_probs, lam):
+        ctx = LowerCtx(training=True, rng=jax.random.key(0), mesh=None,
+                       seq_length=None, node_guid=0)
+        out = get_lowering(OpType.AGGREGATE)(
+            A.AggregateAttrs(n, lam),
+            [topv, topi, topi, gate_probs] + experts, {}, ctx,
+        )[0]
+        aux = ctx.state_updates.get("__aux_loss__", 0.0)
+        return out.sum() + aux
+
+    gate = jnp.asarray(rs.rand(b, n), jnp.float32)
+    g_on = jax.grad(loss)(gate, 0.1)
+    g_off = jax.grad(loss)(gate, 0.0)
+    assert float(jnp.abs(g_on - g_off).max()) > 0.0

@@ -247,3 +247,90 @@ def test_attribute_parallel_gate_restricts_space():
     with_attr = enumerate_views(attn, axis_sizes, attr_parallel=True)
     without = enumerate_views(attn, axis_sizes, attr_parallel=False)
     assert len(with_attr) > len(without)
+
+
+def test_fused_parallel_op_lowering_and_cost():
+    """FusedParallelOp (reference fused_parallel_op.cc): chain of
+    reshardings as one node — fuse xfer builds it, lowering constrains to
+    the final spec, cost model pays one latency term."""
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.ffconst import OpType
+    from flexflow_tpu.parallel.parallel_ops import (
+        CombineAttrs, FusedParallelOpAttrs, RepartitionAttrs,
+    )
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.substitution import make_fuse_parallel_ops
+    from flexflow_tpu.pcg.graph import Graph
+
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 32), name="x")
+    t = ff.dense(x, 64, name="d0")
+    # hand-build repartition -> combine chain
+    g = ff.graph
+    rep = g.create_node(OpType.REPARTITION, RepartitionAttrs(1, ("model",)), "rep")
+    comb = g.create_node(OpType.COMBINE, CombineAttrs(1, ("model",)), "comb")
+    d0 = t.node
+    g.add_edge(d0, rep)
+    g.add_edge(rep, comb)
+    g.infer_shapes()
+
+    xf = make_fuse_parallel_ops()
+    cands = xf.apply_all(g)
+    assert cands, "fuse xfer found no match"
+    fused_g = cands[0]
+    fused_nodes = [n for n in fused_g.nodes
+                   if n.op_type == OpType.FUSED_PARALLEL]
+    assert len(fused_nodes) == 1
+    attrs = fused_nodes[0].attrs
+    assert isinstance(attrs, FusedParallelOpAttrs)
+    assert [s[0] for s in attrs.steps] == ["repartition", "combine"]
+
+    cost = CostModel(TPUMachineModel.make("v5e", 8), {"data": 2, "model": 4})
+    t_fused = cost.node_comm_time(fused_g, fused_nodes[0], None)
+    t_comb = cost.node_comm_time(g, comb, None)
+    assert 0.0 < t_fused <= t_comb * 1.01  # fused never dearer than parts
+
+
+def test_cache_score_and_recompile_swap():
+    """Cache op + user score + RecompileState: the reference moe.cc cache
+    swap flow — score degrades on distribution shift, trigger fires, alter
+    recompiles."""
+    import numpy as np
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.runtime.recompile import RecompileState
+
+    ff = FFModel(FFConfig(batch_size=16))
+    x = ff.create_tensor((16, 8), name="x")
+    c = ff.cache(x, score_func=lambda old, new: float(
+        1.0 - np.abs(old - new).mean()), name="acts")
+    t = ff.dense(c, 4, name="d0")
+    ff.softmax(t, name="sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(32, 8).astype(np.float32)
+    ys = rs.randint(0, 4, 32).astype(np.int32)
+
+    ff.fit(xs, ys, epochs=1, verbose=False)
+    assert ff.cache_score("acts") == 1.0  # first call only snapshots
+    ff.fit(xs, ys, epochs=1, verbose=False)
+    assert ff.cache_score("acts") > 0.5  # same distribution: high score
+    # drastic distribution shift: the score must degrade
+    ff.fit(xs * 100.0, ys, epochs=1, verbose=False)
+    s = ff.cache_score("acts")
+    assert s < 0.5, s
+
+    # the degraded score drives a recompile swap (reference moe.cc flow)
+    fired = []
+
+    def trigger(state):
+        return len(fired) == 0 and ff.cache_score("acts") < 10.0
+
+    def alter(state):
+        fired.append(True)
+
+    st = RecompileState(trigger, alter, ff)
+    ff.fit(xs, ys, epochs=1, verbose=False, recompile_state=st)
+    assert st.recompilations == 1 and fired

@@ -220,3 +220,30 @@ def test_search_beats_hand_strategy_with_seq_axis():
                 for axes in spec:
                     used.update(axes)
     assert "model" in used or "seq" in used, used
+
+
+def test_sequence_unity_matches_flat_on_deep_llama():
+    """Sequence-DP outer decomposition (generic_sequence_optimize analog)
+    finds the same-quality strategy as the flat search on a deep graph,
+    and still matches the hand TP strategy."""
+    from flexflow_tpu.models.llama import llama_tp_strategy
+    from flexflow_tpu.search.substitution import (
+        find_split_nodes, sequence_unity_search,
+    )
+
+    lcfg = LlamaConfig(vocab_size=1024, dim=64, layers=6, heads=4,
+                       kv_heads=2, hidden=128, rope_theta=10000.0)
+    ff = FFModel(FFConfig(batch_size=8, num_devices=1))
+    build_llama(ff, lcfg, batch_size=8, seq_len=64)
+    g = ff.graph
+    g.infer_shapes()
+    axis_sizes = {"data": 2, "model": 4}
+    cost = CostModel(TPUMachineModel.make("v5p", 8), axis_sizes)
+    assert len(find_split_nodes(g)) >= lcfg.layers  # residual chain splits
+
+    hand = graph_cost(g, _filled(g, llama_tp_strategy(lcfg)), cost).time
+    merged, strategy, found = sequence_unity_search(g, cost, budget=10)
+    assert found <= 1.05 * hand, (found, hand)
+    # the merged graph must be a complete stitched PCG
+    assert len(merged.sinks()) == 1
+    assert len(merged) >= len(g) - 2
