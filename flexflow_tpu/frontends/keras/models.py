@@ -99,10 +99,9 @@ class _BaseModel:
                 LossType.MEAN_SQUARED_ERROR_AVG_REDUCE: pm.mse_loss,
                 LossType.MEAN_SQUARED_ERROR_SUM_REDUCE: pm.mse_loss,
             }.get(self._loss, pm.sparse_cce_loss)
-            logs = {
-                "loss": loss_field / n,
-                "accuracy": pm.train_correct / n,
-            }
+            logs = {"loss": loss_field / n}
+            if MetricsType.ACCURACY in self._metrics:
+                logs["accuracy"] = pm.train_correct / n
             for cb in callbacks:
                 cb.on_epoch_end(epoch, logs)
             if any(getattr(cb, "stop_training", False) for cb in callbacks):

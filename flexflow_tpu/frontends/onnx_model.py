@@ -3,7 +3,7 @@
 
 The onnx package is optional — the class raises a clear ImportError when
 it's missing. Supported ops extend the reference's set: Gemm/MatMul, Conv,
-Relu/Sigmoid/Tanh/Softmax/Gelu/Erf, MaxPool/AveragePool, Add/Sub/Mul/Div/
+Relu/Sigmoid/Tanh/Softmax/Gelu, MaxPool/AveragePool, Add/Sub/Mul/Div/
 Pow/Sqrt/Exp, Concat/Split/Gather/Transpose/Squeeze/Unsqueeze, Flatten,
 Reshape, Cast, Dropout, BatchNormalization, LayerNormalization,
 ReduceMean/ReduceSum, TopK.
@@ -17,6 +17,14 @@ import numpy as np
 
 from flexflow_tpu.ffconst import PoolType
 from flexflow_tpu.model import FFModel, Tensor
+
+
+def _init_ints(init):
+    """Integer list from a TensorProto, via numpy_helper (raw_data may be
+    empty when values live in int64_data)."""
+    from onnx import numpy_helper
+
+    return [int(v) for v in numpy_helper.to_array(init).reshape(-1)]
 
 
 def _operand(ff: FFModel, env, inits, input_name: str, node_name: str):
@@ -128,9 +136,7 @@ class ONNXModel:
             elif op == "Flatten":
                 env[node.output[0]] = ff.flat(env[node.input[0]], name=name)
             elif op == "Reshape":
-                shape_init = inits[node.input[1]]
-                shape = [int(s) for s in
-                         np.frombuffer(shape_init.raw_data, dtype=np.int64)]
+                shape = _init_ints(inits[node.input[1]])
                 x = env[node.input[0]]
                 # ONNX: 0 copies the corresponding input dim, -1 is inferred
                 shape = [x.shape[i] if s == 0 else s
@@ -163,9 +169,7 @@ class ONNXModel:
                 env[node.output[0]] = ff.pow(env[node.input[0]], 0.5, name=name)
             elif op == "Exp":
                 env[node.output[0]] = ff.exp(env[node.input[0]], name=name)
-            elif op in ("Gelu", "Erf"):
-                # Erf appears inside exported gelu subgraphs; lowering the
-                # whole pattern as gelu matches the reference's HF handling
+            elif op == "Gelu":
                 env[node.output[0]] = ff.gelu(env[node.input[0]], name=name)
             elif op == "Transpose":
                 perm = attr(node, "perm")
@@ -176,8 +180,7 @@ class ONNXModel:
                 sizes = attr(node, "split")
                 x = env[node.input[0]]
                 if sizes is None and len(node.input) > 1 and node.input[1] in inits:
-                    sizes = [int(s) for s in np.frombuffer(
-                        inits[node.input[1]].raw_data, np.int64)]
+                    sizes = _init_ints(inits[node.input[1]])
                 if sizes is None:
                     n_out = len(node.output)
                     sizes = [x.shape[axis] // n_out] * n_out
@@ -185,24 +188,33 @@ class ONNXModel:
                 for o_name, o in zip(node.output, outs):
                     env[o_name] = o
             elif op == "Gather":
-                # embedding-style gather: data is an initializer table
-                if node.input[0] in inits and node.input[0] not in env:
-                    table = inits[node.input[0]]
-                    dims = list(table.dims)
+                # embedding-style gather: a 2-D initializer table becomes
+                # an embedding carrying the table's PRETRAINED values
+                table = inits.get(node.input[0])
+                if table is not None and node.input[0] not in env \
+                        and len(table.dims) == 2:
+                    from onnx import numpy_helper
+
+                    from flexflow_tpu.runtime.initializer import (
+                        ArrayInitializer,
+                    )
+
+                    arr = numpy_helper.to_array(table)
                     env[node.output[0]] = ff.embedding(
-                        env[node.input[1]], dims[0], dims[1], name=name
+                        env[node.input[1]], arr.shape[0], arr.shape[1],
+                        kernel_initializer=ArrayInitializer(arr), name=name,
                     )
                 else:
                     env[node.output[0]] = ff.gather(
-                        env[node.input[0]], env[node.input[1]],
+                        _operand(ff, env, inits, node.input[0], name),
+                        env[node.input[1]],
                         attr(node, "axis", 0), name=name,
                     )
             elif op in ("Squeeze", "Unsqueeze"):
                 x = env[node.input[0]]
                 axes = attr(node, "axes")
                 if axes is None and len(node.input) > 1 and node.input[1] in inits:
-                    axes = [int(s) for s in np.frombuffer(
-                        inits[node.input[1]].raw_data, np.int64)]
+                    axes = _init_ints(inits[node.input[1]])
                 if op == "Unsqueeze" and axes is None:
                     raise NotImplementedError(
                         f"ONNX Unsqueeze {name!r}: axes from a dynamic "
@@ -235,8 +247,7 @@ class ONNXModel:
             elif op in ("ReduceMean", "ReduceSum"):
                 axes = attr(node, "axes")
                 if axes is None and len(node.input) > 1 and node.input[1] in inits:
-                    axes = [int(s) for s in np.frombuffer(
-                        inits[node.input[1]].raw_data, np.int64)]
+                    axes = _init_ints(inits[node.input[1]])
                 if axes is None:
                     if len(node.input) > 1:
                         raise NotImplementedError(
@@ -253,8 +264,7 @@ class ONNXModel:
             elif op == "TopK":
                 k = attr(node, "k")
                 if k is None and len(node.input) > 1 and node.input[1] in inits:
-                    k = int(np.frombuffer(inits[node.input[1]].raw_data,
-                                          np.int64)[0])
+                    k = _init_ints(inits[node.input[1]])[0]
                 vals, idx = ff.top_k(env[node.input[0]], int(k), name=name)
                 env[node.output[0]] = vals
                 if len(node.output) > 1:

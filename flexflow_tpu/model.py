@@ -79,6 +79,7 @@ class FFModel:
         self._used_names: set = set()
         self._rng_seed = self.config.seed
         self._step_count = 0
+        self._fit_calls = 0
         self.current_metrics: Optional[PerfMetrics] = None
 
     # ------------------------------------------------------------------
@@ -692,7 +693,11 @@ class FFModel:
         step = self.executor.train_step()
         tr, ntr = self._params
         opt_state = self._opt_state
-        rng = jax.random.key(self._rng_seed + 1)
+        # fold the fit-call counter in so repeated fit() calls (e.g. the
+        # keras per-epoch loop) draw FRESH dropout/rng streams instead of
+        # replaying the first call's masks
+        rng = jax.random.key(self._rng_seed + 1 + self._fit_calls)
+        self._fit_calls += 1
         for epoch in range(epochs):
             self.current_metrics = PerfMetrics()
             if dataloaders is not None:

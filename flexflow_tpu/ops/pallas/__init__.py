@@ -12,3 +12,7 @@ from flexflow_tpu.ops.pallas.flash_attention import (  # noqa: F401
     flash_attention,
     flash_attention_available,
 )
+from flexflow_tpu.ops.pallas.ring_flash import (  # noqa: F401
+    ring_flash_attention,
+    ring_flash_available,
+)

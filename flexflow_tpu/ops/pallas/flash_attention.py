@@ -118,6 +118,91 @@ def _fwd(q, k, v, causal, scale, bq, bk, interpret):
 
 
 # ---------------------------------------------------------------------------
+# ring-step forward: same online softmax, but the (m, l, acc) statistics
+# carry IN from previous ring steps and OUT to the next — one call per
+# rotating k/v block (used by ring_flash_attention below)
+
+
+def _fwd_carry_kernel(q_ref, k_ref, v_ref, m_in, l_in, acc_in,
+                      m_out, l_out, acc_out, m_scr, l_scr, acc_scr,
+                      *, scale, causal, nk, bq, bk):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        m_scr[:] = m_in[0]
+        l_scr[:] = l_in[0]
+        acc_scr[:] = acc_in[0]
+
+    live = (iq * bq + bq - 1 >= ik * bk) if causal else (ik >= 0)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, iq, ik, bq, bk)
+        m_prev = m_scr[:, 0:1]
+        l_prev = l_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        m_out[0] = m_scr[:]
+        l_out[0] = l_scr[:]
+        acc_out[0] = acc_scr[:]
+
+
+def _fwd_carry(q, k, v, m, l, acc, causal, scale, bq, bk, interpret):
+    """One ring step: fold k/v's contribution into carried (m, l, acc).
+    q: (BH,S,D); k,v: (BH,T,D); m,l: (BH,S,LANES) f32; acc: (BH,S,D) f32."""
+    BH, S, D = q.shape
+    T = k.shape[1]
+    nq, nk = S // bq, T // bk
+    kernel = functools.partial(_fwd_carry_kernel, scale=scale, causal=causal,
+                               nk=nk, bq=bq, bk=bk)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, m, l, acc)
+
+
+# ---------------------------------------------------------------------------
 # backward
 
 

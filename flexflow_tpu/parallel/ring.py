@@ -105,17 +105,43 @@ def ring_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
     """q,k,v: (B, S, H, D) global, S sharded over `seq_axis`. Exact
     attention via ring rotation. Falls back to a single local computation
     when the seq axis has size 1."""
+    import os
+
     n = _mesh_axis_size(mesh, seq_axis)
     from flexflow_tpu.ops import jax_ops
 
     if n == 1:
         return jax_ops.fused_attention(q, k, v, causal=causal, scale=scale,
                                        mesh=mesh)
-    jax_ops.LAST_ATTENTION_KERNEL = "ring_online_softmax"
 
     ba = batch_axis if _mesh_axis_size(mesh, batch_axis) > 1 else None
     ha = head_axis if _mesh_axis_size(mesh, head_axis) > 1 else None
     spec = P(ba, seq_axis, ha, None)
+
+    # Pallas flash kernel as the per-block ring body (the S_loc×S_loc
+    # score tile stays in VMEM); einsum online-softmax fallback otherwise
+    from flexflow_tpu.ops.pallas import (
+        ring_flash_attention,
+        ring_flash_available,
+    )
+
+    s_loc = q.shape[1] // n
+    force_interp = os.environ.get("FF_TPU_FLASH_INTERPRET") == "1"
+    if q.shape[1] % n == 0 and ring_flash_available(
+        s_loc, interpret=force_interp
+    ):
+        jax_ops.LAST_ATTENTION_KERNEL = "ring_pallas_flash"
+
+        def fn(ql, kl, vl):
+            return ring_flash_attention(
+                ql, kl, vl, axis_name=seq_axis, n_shards=n, causal=causal,
+                scale=scale, interpret=force_interp,
+            )
+
+        return _shard_map(fn, mesh, (spec, spec, spec), spec,
+                          check_vma=False)(q, k, v)
+
+    jax_ops.LAST_ATTENTION_KERNEL = "ring_online_softmax"
     vary_axes = tuple(a for a in (ba, seq_axis, ha) if a is not None)
 
     def fn(ql, kl, vl):

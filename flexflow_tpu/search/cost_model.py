@@ -121,21 +121,22 @@ class CostModel:
             total, lat = 0.0, 0.0
             nbytes = ins[0].global_bytes()
             for kind, _dim, axes in node.attrs.steps:
-                # same default as the unfused nodes (axes or "model"), so
+                # same degrees as the unfused node branches above (axes or
+                # "model" default; combine/all_to_all floored at 2), so
                 # fusing never changes the priced degree of a step
                 deg = axes_degree(axes or ("model",))
-                if deg <= 1:
-                    continue
                 if kind == "reduction":
                     t = self.machine.all_reduce_time(nbytes, deg)
-                elif kind == "combine":
-                    t = self.machine.all_gather_time(nbytes, deg)
+                elif kind in ("combine", "replicate"):
+                    t = self.machine.all_gather_time(nbytes, max(deg, 2))
+                    deg = max(deg, 2)
                 elif kind == "all_to_all":
-                    t = self.machine.all_to_all_time(nbytes, deg)
-                elif kind == "replicate":
-                    t = self.machine.all_gather_time(nbytes, deg)
+                    t = self.machine.all_to_all_time(nbytes, max(deg, 2))
+                    deg = max(deg, 2)
                 else:  # repartition: local slice
                     t = 0.0
+                if deg <= 1:
+                    continue
                 lat = max(lat, self.machine.ici_latency * deg)
                 total += max(t - self.machine.ici_latency * deg, 0.0)
             return total + lat

@@ -450,23 +450,34 @@ def sequence_unity_search(
     for i, mod in enumerate(modules):
         # all modules share the source graph's guid counter object (set by
         # split_at_node), so rewrites across modules can never collide
-        boundary_guids = {n.guid for n in mod.nodes} & (
+        guids = {n.guid for n in mod.nodes}
+        next_shared = guids & (
             {n.guid for n in modules[i + 1].nodes} if i + 1 < len(modules)
             else set()
         )
+        prev_shared = guids & (
+            {n.guid for n in modules[i - 1].nodes} if i > 0 else set()
+        )
+        orig_attrs = {n.guid: n.attrs for n in mod.nodes}
         g, s, t = unity_search(mod, cost, budget=budget, alpha=alpha,
                                training=training, xfers=xfers,
                                memory_limit=memory_limit)
-        # a rewrite must keep the shared boundary node AND keep it a sink
-        # of this module (a rewrite appending e.g. a Combine after a
-        # boundary Linear would make the next module's consumers bypass
-        # it when re-glued); otherwise fall back to the unrewritten module
-        bad = boundary_guids - {n.guid for n in g.nodes}
-        if not bad:
-            for bg in boundary_guids:
-                if g.out_edges(g.node(bg)):
-                    bad = {bg}
-                    break
+        # boundary nodes shared with a neighbor module must come through
+        # the rewrite UNTOUCHED: present, attrs unchanged (a fusion that
+        # rewrites a source boundary's attrs would be deduped away by
+        # _glue), and — for the sink boundary — with no appended
+        # successors the next module's consumers would bypass. Otherwise
+        # fall back to the unrewritten module.
+        new_nodes = {n.guid: n for n in g.nodes}
+        bad = False
+        for bg in next_shared | prev_shared:
+            n = new_nodes.get(bg)
+            if n is None or n.attrs is not orig_attrs[bg]:
+                bad = True
+                break
+            if bg in next_shared and g.out_edges(n):
+                bad = True
+                break
         if bad:
             from flexflow_tpu.search.dp import ViewDP
 

