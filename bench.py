@@ -194,10 +194,20 @@ def bench_naive(x, y) -> float:
         return h + jnp.einsum("bsh,he->bse", jax.nn.silu(g) * u,
                               L["down"].astype(jnp.bfloat16))
 
+    # Best feasible baseline config on a 16GB chip: no-remat OOMs (the S^2
+    # fp32 attention residuals alone are ~3GB), so the baseline gets the
+    # standard best-practice policy — save projection matmul outputs,
+    # recompute attention internals. The framework side needs no remat at
+    # all (Pallas flash attention keeps memory O(S)); that asymmetry is a
+    # real framework win, not a baseline handicap.
+    layer_ckpt = jax.checkpoint(
+        layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    )
+
     def fwd(p, ids):
         h = p["emb"].astype(jnp.bfloat16)[ids]
         for i in range(cfg.layers):
-            h = layer(p[f"l{i}"], h)
+            h = layer_ckpt(p[f"l{i}"], h)
         h = rms(h, p["lnf"])
         return jnp.einsum("bse,ev->bsv", h, p["head"].astype(jnp.bfloat16))
 
@@ -243,12 +253,35 @@ def bench_naive(x, y) -> float:
     return BATCH * SEQ / dt
 
 
-def main():
+def _run_side(side: str) -> float:
     rs = np.random.RandomState(0)
     x = rs.randint(0, 32000, (BATCH, SEQ)).astype(np.int32)
     y = np.roll(x, -1, axis=1).astype(np.int32)
-    fw = bench_framework(x, y)
-    nv = bench_naive(x, y)
+    return bench_framework(x, y) if side == "framework" else bench_naive(x, y)
+
+
+def _spawn_side(side: str) -> float:
+    """Each side runs in its own process so HBM is fully released between
+    the framework and baseline runs (params + Adam state + compiled
+    executables of one side would otherwise crowd out the other)."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, __file__, "--side", side],
+        stdout=subprocess.PIPE, stderr=None, text=True, timeout=1200,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"bench side {side!r} failed (rc={proc.returncode})")
+    return float(json.loads(proc.stdout.strip().splitlines()[-1])["tokens_per_sec"])
+
+
+def main():
+    if len(sys.argv) > 2 and sys.argv[1] == "--side":
+        tps = _run_side(sys.argv[2])
+        print(json.dumps({"tokens_per_sec": tps}))
+        return
+    fw = _spawn_side("framework")
+    nv = _spawn_side("naive")
     mfu = fw * _flops_per_token(_llama_cfg(), SEQ) / _peak_flops()
     print(json.dumps({
         "metric": "llama_200m_train_tokens_per_sec",

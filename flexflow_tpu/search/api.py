@@ -89,23 +89,33 @@ def graph_optimize(graph: Graph, mesh, config) -> Tuple[Graph, Dict[str, Shardin
     """Full Unity search: substitutions + view DP. Returns (possibly
     rewritten graph, strategy)."""
     from flexflow_tpu.search.substitution import (
-        sequence_unity_search,
-        unity_search,
+        memory_lambda_search,
+        pick_search_fn,
     )
 
     cost = _cost_model(mesh, config)
     _maybe_measure(cost, graph, config)
-    memory_limit = cost.machine.memory_per_chip() if config.memory_search else None
+    if config.memory_search:
+        # memory-aware path: λ binary search blending run time and per-chip
+        # memory (graph.cc:2046-2131 analog)
+        best_graph, strategy, gc = memory_lambda_search(
+            graph, cost,
+            memory_limit=cost.machine.memory_per_chip(),
+            budget=config.search_budget,
+            alpha=config.search_alpha,
+        )
+        if config.profiling:
+            print(f"[search] best estimated step time {gc.time * 1e3:.3f} ms "
+                  f"@ {gc.memory_per_chip / 2**30:.2f} GiB/chip")
+        return best_graph, strategy
     # deep graphs: sequence-DP decomposition at module boundaries
     # (generic_sequence_optimize, substitution.cc:2572) — per-module
     # best-first is ~linear in depth where the flat search is not
-    search_fn = sequence_unity_search if len(graph) > 40 else unity_search
-    best_graph, strategy, best_time = search_fn(
+    best_graph, strategy, best_time = pick_search_fn(graph)(
         graph,
         cost,
         budget=config.search_budget,
         alpha=config.search_alpha,
-        memory_limit=memory_limit,
     )
     if config.profiling:
         print(f"[search] best estimated step time {best_time * 1e3:.3f} ms")

@@ -12,7 +12,7 @@ cheap for device lists but exponential for named-axis specs).
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from flexflow_tpu.parallel.sharding import ShardingView
 from flexflow_tpu.pcg.graph import Graph
@@ -22,10 +22,15 @@ from flexflow_tpu.search.cost_model import CostModel, graph_cost
 
 class ViewDP:
     def __init__(self, cost: CostModel, *, training: bool = True,
-                 max_exhaustive: int = 4, product_cap: int = 4096):
+                 max_exhaustive: int = 4, product_cap: int = 4096,
+                 objective: Optional[Callable[[float, float], float]] = None):
         self.cost = cost
         self.training = training
         self.max_exhaustive = max_exhaustive
+        # objective(time, memory_per_chip) -> scalar; None = pure run time.
+        # The memory-λ search (graph.cc:2046) passes a blend here so the DP
+        # itself prefers memory-lean views, not just the outer loop.
+        self.objective = objective
         # exhaustive base case bound: total view-combination count, not node
         # count — a 6-node module with 3 views each (432 combos) is cheap to
         # solve exactly, and exactness is what crosses TP chain barriers
@@ -63,7 +68,10 @@ class ViewDP:
         return out
 
     def _eval(self, graph: Graph, strategy: Dict[str, ShardingView]) -> float:
-        return graph_cost(graph, strategy, self.cost, self.training).time
+        gc = graph_cost(graph, strategy, self.cost, self.training)
+        if self.objective is not None:
+            return self.objective(gc.time, gc.memory_per_chip)
+        return gc.time
 
     def _solve_uncached(self, graph: Graph, fixed) -> Dict[str, ShardingView]:
         cands = {k: v for k, v in self._candidates(graph).items() if k not in fixed}
@@ -91,13 +99,18 @@ class ViewDP:
                     )
             table = build_table(graph, self.cost, cands, base, self.training)
             searchable = table.searchable()
+
+            def tab_cost(a) -> float:
+                t, m = table.eval(a)
+                return self.objective(t, m) if self.objective else t
+
             assign = [0] * len(table.nodes)
-            best_assign, best_cost = list(assign), table.eval(assign)[0]
+            best_assign, best_cost = list(assign), tab_cost(assign)
             view_counts = [len(table.views[i]) for i in searchable]
             for combo in itertools.product(*(range(c) for c in view_counts)):
                 for idx, k in zip(searchable, combo):
                     assign[idx] = k
-                c = table.eval(assign)[0]
+                c = tab_cost(assign)
                 if c < best_cost:
                     best_assign, best_cost = list(assign), c
             strategy = dict(fixed)
@@ -157,36 +170,75 @@ class ViewDP:
 
 def greedy_polish(graph: Graph, strategy: Dict[str, ShardingView],
                   cost: CostModel, *, training: bool = True,
-                  sweeps: int = 3) -> Tuple[Dict[str, ShardingView], float]:
-    """Hill-climb single-node view flips until a sweep finds no improvement.
-    Cheap local cleanup applied after the stochastic MCMC search (the
-    reference's annealing keeps a best-seen strategy; this removes its
-    residual noise)."""
-    s = dict(strategy)
-    cur = graph_cost(graph, s, cost, training).time
-    axis_sizes = cost.axis_sizes
+                  sweeps: int = 4, memory_limit: Optional[float] = None,
+                  objective=None, table=None,
+                  start=None) -> Tuple[Dict[str, ShardingView], float]:
+    """Hill-climb view flips until a sweep finds no improvement: single-node
+    flips plus joint flips of edge endpoints. The pair moves matter: a TP
+    chain only pays off when producer and consumer switch together, so a
+    single-flip climber stalls at the resharding barrier between them.
+    Runs on a StrategyTable, so each move is a cheap table sum instead of a
+    full graph_cost walk (the reference polishes inside the annealing loop
+    against its cached measurements, model.cc:3317). Callers that already
+    priced a table over the same candidate set (mcmc_optimize) pass it in
+    via `table`/`start` to avoid re-pricing every (node, view) pair;
+    `memory_limit`/`objective` keep the polish honoring the same constraint
+    the search enforced."""
+    from flexflow_tpu.search.table import build_table
+
+    if table is None:
+        candidates = {}
+        for n in graph.nodes:
+            views = space.enumerate_views(
+                n, cost.axis_sizes, param_parallel=cost.param_parallel,
+                attr_parallel=cost.attr_parallel,
+            )
+            if len(views) > 1:
+                candidates[n.name] = views
+        table = build_table(graph, cost, candidates, dict(strategy), training)
+    assign = list(start) if start is not None else [0] * len(table.nodes)
+
+    def ev(a) -> float:
+        t, m = table.eval(a)
+        if objective is not None:
+            return objective(t, m)
+        if memory_limit and m > memory_limit:
+            t += 1e3 * (m / memory_limit)
+        return t
+
+    cur = ev(assign)
+    searchable = set(table.searchable())
     for _ in range(sweeps):
         improved = False
-        for n in graph.nodes:
-            if not n.outputs:
-                continue
-            for v in space.enumerate_views(
-                n, axis_sizes, param_parallel=cost.param_parallel,
-                attr_parallel=cost.attr_parallel,
-            ):
-                old = s.get(n.name)
-                if v == old:
+        for i in sorted(searchable):
+            best_k, best_c = assign[i], cur
+            for k in range(len(table.views[i])):
+                if k == assign[i]:
                     continue
-                s[n.name] = v
-                c = graph_cost(graph, s, cost, training).time
-                if c < cur - 1e-15:
-                    cur = c
-                    improved = True
-                else:
-                    if old is None:
-                        s.pop(n.name, None)
-                    else:
-                        s[n.name] = old
+                assign[i] = k
+                c = ev(assign)
+                if c < best_c - 1e-15:
+                    best_k, best_c = k, c
+            assign[i] = best_k
+            if best_c < cur - 1e-15:
+                cur, improved = best_c, True
+        for src, dst, _ in table.edges:
+            if src not in searchable or dst not in searchable:
+                continue
+            best_pair, best_c = (assign[src], assign[dst]), cur
+            for ks in range(len(table.views[src])):
+                for kd in range(len(table.views[dst])):
+                    if (ks, kd) == (assign[src], assign[dst]):
+                        continue
+                    assign[src], assign[dst] = ks, kd
+                    c = ev(assign)
+                    if c < best_c - 1e-15:
+                        best_pair, best_c = (ks, kd), c
+            assign[src], assign[dst] = best_pair
+            if best_c < cur - 1e-15:
+                cur, improved = best_c, True
         if not improved:
             break
-    return s, cur
+    s = dict(strategy)
+    s.update(table.to_strategy(assign))
+    return s, graph_cost(graph, s, cost, training).time

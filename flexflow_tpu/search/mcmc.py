@@ -68,7 +68,8 @@ def mcmc_optimize(
             from flexflow_tpu.search.dp import greedy_polish
 
             strategy, polished_cost = greedy_polish(
-                graph, strategy, cost, training=training
+                graph, strategy, cost, training=training,
+                memory_limit=memory_limit, table=table, start=best_assign,
             )
             if verbose:
                 print(f"mcmc polished: {polished_cost * 1e3:.3f} ms")
@@ -115,7 +116,9 @@ def mcmc_optimize(
     if polish:
         from flexflow_tpu.search.dp import greedy_polish
 
-        strategy, _ = greedy_polish(graph, strategy, cost, training=training)
+        strategy, _ = greedy_polish(graph, strategy, cost, training=training,
+                                    memory_limit=memory_limit, table=table,
+                                    start=best)
     return strategy
 
 

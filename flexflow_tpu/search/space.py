@@ -148,6 +148,15 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
             ShardingView((batch_spec(out_ndim)[:-1] + (("model",),),))
         )
 
+    # full-mesh DP: batch sharded over data AND model — the "use every chip
+    # for samples" point (reference: a MachineView spanning all GPUs with a
+    # batch-dim stride). Time-optimal at inference (zero collectives) while
+    # keeping weights replicated; the memory-λ search trades it against TP.
+    if axis_sizes.get("model", 1) > 1 and out_ndim >= 1:
+        views.append(ShardingView(
+            ((("data", "model"),) + tuple(() for _ in range(out_ndim - 1)),)
+        ))
+
     views = _seq_variants(views, out_ndim, has_seq)
     return views
 

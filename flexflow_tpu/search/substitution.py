@@ -409,6 +409,7 @@ def sequence_unity_search(
     xfers: Optional[List[GraphXfer]] = None,
     memory_limit: Optional[float] = None,
     min_module: int = 6,
+    objective=None,
 ) -> Tuple[Graph, Dict[str, ShardingView], float]:
     """Sequence-DP outer decomposition (reference generic_sequence_optimize,
     substitution.cc:2572): split the PCG at module boundaries, run the
@@ -430,7 +431,7 @@ def sequence_unity_search(
     if len(spaced) < 2 or len(graph) <= 2 * min_module:
         return unity_search(graph, cost, budget=budget, alpha=alpha,
                             training=training, xfers=xfers,
-                            memory_limit=memory_limit)
+                            memory_limit=memory_limit, objective=objective)
 
     modules: List[Graph] = []
     rest = graph
@@ -461,7 +462,7 @@ def sequence_unity_search(
         orig_attrs = {n.guid: n.attrs for n in mod.nodes}
         g, s, t = unity_search(mod, cost, budget=budget, alpha=alpha,
                                training=training, xfers=xfers,
-                               memory_limit=memory_limit)
+                               memory_limit=memory_limit, objective=objective)
         # boundary nodes shared with a neighbor module must come through
         # the rewrite UNTOUCHED: present, attrs unchanged (a fusion that
         # rewrites a source boundary's attrs would be deduped away by
@@ -482,7 +483,8 @@ def sequence_unity_search(
             from flexflow_tpu.search.dp import ViewDP
 
             g = mod
-            s = ViewDP(cost, training=training).optimize(mod)
+            s = ViewDP(cost, training=training,
+                       objective=objective).optimize(mod)
         rewritten.append(g)
         strategy.update(s)
         total += t
@@ -505,19 +507,22 @@ def unity_search(
     xfers: Optional[List[GraphXfer]] = None,
     use_dp: bool = True,
     memory_limit: Optional[float] = None,
+    objective=None,
 ) -> Tuple[Graph, Dict[str, ShardingView], float]:
     """Best-first search over substitution rewrites; each candidate graph is
     costed at its optimal views (ViewDP when `use_dp`, else current views +
     DP default). Candidates worse than alpha × best are pruned; strategies
     over `memory_limit` bytes/chip are heavily penalized (the reference's
-    is_valid_strategy memory check, graph.cc:1983). Returns (best graph,
-    best strategy, best cost)."""
+    is_valid_strategy memory check, graph.cc:1983). `objective(time, mem)`
+    replaces the pure-time ranking when given (memory-λ search). Returns
+    (best graph, best strategy, best cost)."""
     from flexflow_tpu.search.dp import ViewDP
 
     xfers = xfers if xfers is not None else default_xfers(cost.axis_sizes)
     # one ViewDP across all candidates: its memo keys on (structure hash,
     # boundary views), so shared subgraphs are solved once
-    view_dp = ViewDP(cost, training=training) if use_dp else None
+    view_dp = (ViewDP(cost, training=training, objective=objective)
+               if use_dp else None)
 
     def views_of(g: Graph) -> Dict[str, ShardingView]:
         if view_dp is not None:
@@ -532,6 +537,8 @@ def unity_search(
     def evaluate(g: Graph) -> Tuple[float, Dict[str, ShardingView]]:
         s = views_of(g)
         gc = graph_cost(g, s, cost, training)
+        if objective is not None:
+            return objective(gc.time, gc.memory_per_chip), s
         t = gc.time
         if memory_limit is not None and gc.memory_per_chip > memory_limit:
             t += 1e3 * (gc.memory_per_chip / memory_limit)
@@ -560,3 +567,73 @@ def unity_search(
                 if cc <= alpha * best_cost:
                     heapq.heappush(heap, (cc, next(counter), cand))
     return best_graph, best_strategy, best_cost
+
+
+# deep graphs get the sequence-DP decomposition; flat best-first below this
+SEQUENCE_SEARCH_MIN_NODES = 40
+
+
+def pick_search_fn(graph: Graph):
+    """Flat best-first for small graphs, sequence-DP decomposition for deep
+    ones — shared by the plain and memory-λ search paths."""
+    return (sequence_unity_search if len(graph) > SEQUENCE_SEARCH_MIN_NODES
+            else unity_search)
+
+
+# ---------------------------------------------------------------------------
+# memory-λ search (graph_optimize_task λ binary search, graph.cc:2046-2131)
+
+
+def memory_lambda_search(
+    graph: Graph,
+    cost: CostModel,
+    *,
+    memory_limit: float,
+    budget: int = 20,
+    alpha: float = 1.05,
+    training: bool = True,
+    xfers: Optional[List[GraphXfer]] = None,
+    iters: int = 6,
+    search_fn=None,
+):
+    """Memory-aware strategy search: binary-search the run-time weight λ of
+    GraphCost.multi_obj until the best strategy fits `memory_limit`
+    bytes/chip (reference try_one_lambda loop, graph.cc:2046-2131). λ=1 is
+    pure run time; smaller λ weights per-chip memory more, pushing the DP
+    toward sharded (ZeRO/TP) views. Memory is normalized into time units by
+    the λ=1 solution's (time / memory) so the blend is scale-free. Returns
+    (graph, strategy, GraphCost of the chosen strategy)."""
+    search_fn = search_fn or pick_search_fn(graph)
+
+    def run(objective, mem_limit):
+        g, s, _ = search_fn(graph, cost, budget=budget, alpha=alpha,
+                            training=training, xfers=xfers,
+                            memory_limit=mem_limit, objective=objective)
+        gc = graph_cost(g, s, cost, training)
+        return g, s, gc
+
+    # λ=1 first: if the time-optimal strategy already fits, done
+    g, s, gc = run(None, memory_limit)
+    if gc.memory_per_chip <= memory_limit:
+        return g, s, gc
+    scale = gc.time / max(gc.memory_per_chip, 1.0)
+
+    def obj_of(lam):
+        return lambda t, m: lam * t + (1.0 - lam) * m * scale
+
+    # λ=0 anchor: the memory-minimal strategy. If even that does not fit,
+    # the model is infeasible on this machine — return it anyway (the
+    # reference reports the best-effort strategy and lets compile fail).
+    g0, s0, gc0 = run(obj_of(0.0), None)
+    if gc0.memory_per_chip > memory_limit:
+        return g0, s0, gc0
+    best = (g0, s0, gc0)
+    lo, hi = 0.0, 1.0
+    for _ in range(iters):
+        mid = 0.5 * (lo + hi)
+        g1, s1, gc1 = run(obj_of(mid), None)
+        if gc1.memory_per_chip <= memory_limit:
+            best, lo = (g1, s1, gc1), mid
+        else:
+            hi = mid
+    return best
