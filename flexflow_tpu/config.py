@@ -64,6 +64,12 @@ class FFConfig:
     export_strategy_computation_graph_file: Optional[str] = None
     include_costs_dot_graph: bool = False
 
+    # periodic training checkpoints (net-new vs the reference, SURVEY.md
+    # §5.4): every `checkpoint_every` steps fit() writes
+    # checkpoint_dir/step_N (orbax if available, else npz) + latest.json
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0
+
     # ---- execution ----
     profiling: bool = False
     # rematerialization: "attention" wraps attention ops in jax.checkpoint so
@@ -117,6 +123,10 @@ class FFConfig:
                 cfg.epochs = int(take())
             elif a == "--seed":
                 cfg.seed = int(take())
+            elif a == "--checkpoint-dir":
+                cfg.checkpoint_dir = take()
+            elif a == "--checkpoint-every":
+                cfg.checkpoint_every = int(take())
             elif a in ("--devices", "-ll:gpu", "-ll:tpu"):
                 cfg.num_devices = int(take())
             elif a == "--mesh":

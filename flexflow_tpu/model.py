@@ -747,6 +747,16 @@ class FFModel:
                         step = self.executor.train_step()
                         tr, ntr = self._params
                         opt_state = self._opt_state
+                if (
+                    self.config.checkpoint_every
+                    and self.config.checkpoint_dir
+                    and self._step_count % self.config.checkpoint_every == 0
+                ):
+                    from flexflow_tpu.runtime.checkpoint import periodic_save
+
+                    self._params = (tr, ntr)
+                    self._opt_state = opt_state
+                    periodic_save(self.config.checkpoint_dir, self)
             self.current_metrics.train_all = n_samples
             if dev_sums is not None:
                 host = {k: float(v) for k, v in dev_sums.items()}  # one sync

@@ -45,8 +45,13 @@ def _unflatten(flat: Dict[str, Any]) -> Dict:
     return out
 
 
-def save_checkpoint(path: str, ffmodel, extra: Optional[Dict] = None):
-    """Save params, optimizer state, and training metadata."""
+def save_checkpoint(path: str, ffmodel, extra: Optional[Dict] = None,
+                    backend: str = "npz"):
+    """Save params, optimizer state, and training metadata.
+
+    backend="npz" gathers every array to host into one file (single-host
+    only); backend="orbax" writes a sharding-aware orbax checkpoint (each
+    host writes its own shards — the multi-host path)."""
     os.makedirs(path, exist_ok=True)
     tr, ntr = ffmodel._params
     state = {
@@ -54,12 +59,27 @@ def save_checkpoint(path: str, ffmodel, extra: Optional[Dict] = None):
         "nontrainable": ntr,
         "opt_state": ffmodel._opt_state,
     }
-    flat = _flatten(state)
-    arrays = {k: np.asarray(v) for k, v in flat.items()}
-    np.savez(os.path.join(path, "arrays.npz"), **arrays)
+    if backend == "orbax":
+        import shutil
+
+        import orbax.checkpoint as ocp
+
+        state_dir = os.path.join(os.path.abspath(path), "state")
+        # orbax refuses to overwrite; a restarted job re-reaching the same
+        # step must behave like the npz path (overwrite), not crash
+        if os.path.exists(state_dir):
+            shutil.rmtree(state_dir)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(state_dir, state)
+        ckptr.wait_until_finished()
+    else:
+        flat = _flatten(state)
+        arrays = {k: np.asarray(v) for k, v in flat.items()}
+        np.savez(os.path.join(path, "arrays.npz"), **arrays)
     meta = {
         "step_count": ffmodel._step_count,
         "seed": ffmodel.config.seed,
+        "backend": backend,
         "extra": extra or {},
     }
     with open(os.path.join(path, "meta.json"), "w") as f:
@@ -79,8 +99,19 @@ def save_checkpoint(path: str, ffmodel, extra: Optional[Dict] = None):
 
 def restore_checkpoint(path: str, ffmodel) -> Dict:
     """Restore params/opt state into a compiled FFModel (shapes must match;
-    arrays are re-sharded by device_put against current shardings)."""
+    arrays are re-sharded by device_put against current shardings). The
+    arrays backend (npz vs orbax) is auto-detected from what was saved."""
     import jax
+
+    meta_path = os.path.join(path, "meta.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"no checkpoint at {path!r} (missing meta.json)")
+    with open(meta_path) as f:
+        saved_meta = json.load(f)
+    if saved_meta.get("backend") == "orbax":
+        restore_checkpoint_orbax(path, ffmodel)
+        ffmodel._step_count = saved_meta.get("step_count", 0)
+        return saved_meta
 
     data = np.load(os.path.join(path, "arrays.npz"))
     flat = {k: data[k] for k in data.files}
@@ -123,15 +154,41 @@ def restore_checkpoint(path: str, ffmodel) -> Dict:
 
 def save_checkpoint_orbax(path: str, ffmodel):
     """Orbax-backed variant (async-capable, large-scale)."""
-    import orbax.checkpoint as ocp
+    save_checkpoint(path, ffmodel, backend="orbax")
 
-    tr, ntr = ffmodel._params
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(
-        os.path.join(os.path.abspath(path), "state"),
-        {"trainable": tr, "nontrainable": ntr, "opt_state": ffmodel._opt_state},
-    )
-    ckptr.wait_until_finished()
+
+def periodic_save(ckpt_dir: str, ffmodel, *, backend: Optional[str] = None):
+    """One periodic training checkpoint under `ckpt_dir/step_N`, plus a
+    `latest.json` pointer. Called from fit() every
+    config.checkpoint_every steps. Prefers the sharding-aware orbax
+    backend; falls back to npz if orbax is unavailable."""
+    if backend is None:
+        try:
+            import orbax.checkpoint  # noqa: F401
+
+            backend = "orbax"
+        except Exception:
+            backend = "npz"
+    step = ffmodel._step_count
+    name = f"step_{step}"
+    path = os.path.join(ckpt_dir, name)
+    save_checkpoint(path, ffmodel, backend=backend)
+    # pointer holds only the basename (rejoined with ckpt_dir at restore,
+    # so a resume from another cwd works) and is replaced atomically (a
+    # crash mid-write must not corrupt the very pointer crash recovery
+    # depends on)
+    tmp = os.path.join(ckpt_dir, ".latest.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"name": name, "step": step}, f)
+    os.replace(tmp, os.path.join(ckpt_dir, "latest.json"))
+    return path
+
+
+def restore_latest(ckpt_dir: str, ffmodel) -> Dict:
+    """Resume from the newest periodic checkpoint in `ckpt_dir`."""
+    with open(os.path.join(ckpt_dir, "latest.json")) as f:
+        latest = json.load(f)
+    return restore_checkpoint(os.path.join(ckpt_dir, latest["name"]), ffmodel)
 
 
 def restore_checkpoint_orbax(path: str, ffmodel):

@@ -269,11 +269,15 @@ class GraphCost:
     time: float
     memory_per_chip: float
 
-    def multi_obj(self, run_time_cost_factor: float) -> float:
-        """λ-blend used by the memory-aware search (graph.cc:1155)."""
+    def multi_obj(self, run_time_cost_factor: float,
+                  memory_scale: float = 1.0) -> float:
+        """λ-blend used by the memory-aware search (graph.cc:1155).
+        `memory_scale` converts bytes into time-comparable units (the λ
+        binary search passes the λ=1 solution's time/memory ratio so the
+        blend is scale-free)."""
         return self.time * run_time_cost_factor + self.memory_per_chip * (
             1.0 - run_time_cost_factor
-        )
+        ) * memory_scale
 
 
 def graph_cost(graph: Graph, strategy: Dict[str, ShardingView],

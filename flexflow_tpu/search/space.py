@@ -152,7 +152,13 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
     # for samples" point (reference: a MachineView spanning all GPUs with a
     # batch-dim stride). Time-optimal at inference (zero collectives) while
     # keeping weights replicated; the memory-λ search trades it against TP.
-    if axis_sizes.get("model", 1) > 1 and out_ndim >= 1:
+    # Gated on batch divisibility: prune_spec drops the whole axes tuple at
+    # execution when the dim doesn't divide, so an indivisible view would
+    # be priced 8-way but run fully replicated.
+    full_deg = axis_sizes.get("data", 1) * axis_sizes.get("model", 1)
+    if (axis_sizes.get("model", 1) > 1 and node.outputs
+            and node.outputs[0].dims
+            and node.outputs[0].dims[0].size % full_deg == 0):
         views.append(ShardingView(
             ((("data", "model"),) + tuple(() for _ in range(out_ndim - 1)),)
         ))

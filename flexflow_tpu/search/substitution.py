@@ -30,7 +30,7 @@ from flexflow_tpu.parallel.parallel_ops import (
 )
 from flexflow_tpu.parallel.sharding import ShardingView, batch_spec
 from flexflow_tpu.pcg.graph import Graph, Node
-from flexflow_tpu.search.cost_model import CostModel, graph_cost
+from flexflow_tpu.search.cost_model import CostModel, GraphCost, graph_cost
 
 
 @dataclasses.dataclass
@@ -619,7 +619,7 @@ def memory_lambda_search(
     scale = gc.time / max(gc.memory_per_chip, 1.0)
 
     def obj_of(lam):
-        return lambda t, m: lam * t + (1.0 - lam) * m * scale
+        return lambda t, m: GraphCost(t, m).multi_obj(lam, memory_scale=scale)
 
     # λ=0 anchor: the memory-minimal strategy. If even that does not fit,
     # the model is infeasible on this machine — return it anyway (the
