@@ -166,6 +166,8 @@ PARALLEL_OP_TYPES = frozenset(
         OpType.REDUCTION,
         OpType.ALL_TO_ALL,
         OpType.FUSED_PARALLEL,
-        OpType.PIPELINE,
+        # NOTE: PIPELINE is NOT here — it was a stub enum in the reference
+        # but is a real compute composite in this framework (ops/attrs.py
+        # PipelineAttrs), priced like any op plus bubble/ppermute terms.
     }
 )

@@ -437,6 +437,20 @@ class FFModel:
         agg_inputs = [topk_values, topk_assign, topk_assign, gate_sm] + expert_outs
         return self.aggregate(agg_inputs, num_exp, lambda_bal, name=name)
 
+    def pipeline(self, input: Tensor, layers: int, heads: int, kv_heads: int,
+                 hidden: int, n_microbatches: int = 4, causal: bool = True,
+                 rope_theta: float = 500000.0, norm_eps: float = 1e-5,
+                 name=None) -> Tensor:
+        """Stacked decoder blocks as a GPipe pipeline composite (fills the
+        reference's OP_PIPELINE stub — runs as stages over the `pipe` mesh
+        axis when present, else as a layer-stacked scan)."""
+        return self._one(
+            OpType.PIPELINE,
+            A.PipelineAttrs(layers, heads, kv_heads, hidden, n_microbatches,
+                            causal, rope_theta, norm_eps),
+            [input], name or "pipeline",
+        )
+
     def cache(self, input: Tensor, score_func=None, name=None) -> Tensor:
         """Activation cache (reference src/ops/cache.cc). During training
         the op stores its input into a non-trainable buffer each step;

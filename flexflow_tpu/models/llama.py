@@ -49,10 +49,22 @@ class LlamaConfig:
 def build_llama(ff: FFModel, cfg: LlamaConfig, batch_size: int = None,
                 seq_len: int = 2048, dtype: DataType = DataType.BFLOAT16,
                 use_ring_attention: bool = False,
-                seq_mode: str = "ring") -> Tensor:
+                seq_mode: str = "ring",
+                use_pipeline: bool = False,
+                n_microbatches: int = 4) -> Tensor:
     b = batch_size or ff.config.batch_size
     ids = ff.create_tensor((b, seq_len), DataType.INT32, name="input_ids")
     h = ff.embedding(ids, cfg.vocab_size, cfg.dim, dtype=dtype, name="tok_emb")
+    if use_pipeline:
+        # all decoder blocks as ONE stacked-weight composite: GPipe stages
+        # over the `pipe` mesh axis, or a layer-stacked scan without one
+        h = ff.pipeline(h, cfg.layers, cfg.heads, cfg.kv_heads, cfg.hidden,
+                        n_microbatches=n_microbatches,
+                        rope_theta=cfg.rope_theta, norm_eps=cfg.norm_eps,
+                        name="decoder_pipeline")
+        h = ff.rms_norm(h, eps=cfg.norm_eps, name="final_norm")
+        logits = ff.dense(h, cfg.vocab_size, use_bias=False, name="lm_head")
+        return ff.softmax(logits, name="softmax")
     for i in range(cfg.layers):
         a = ff.rms_norm(h, eps=cfg.norm_eps, name=f"l{i}_attn_norm")
         if use_ring_attention:
@@ -120,3 +132,13 @@ def llama_tp_strategy(cfg: LlamaConfig, seq_parallel: bool = False) -> Dict[str,
         output_specs=(act3,), weight_specs={"kernel": ((), ("model",))}
     )
     return views
+
+
+def llama_pp_strategy(cfg: LlamaConfig, n_microbatches: int = 4
+                      ) -> Dict[str, ShardingView]:
+    """Pipeline strategy for the use_pipeline=True builder: the stacked
+    decoder weights shard their leading layer dim over `pipe` (stage s
+    holds its layer slice), activations stay batch-sharded over `data`."""
+    from flexflow_tpu.parallel.sharding import pipeline_pipe_view
+
+    return {"decoder_pipeline": pipeline_pipe_view(3)}

@@ -662,3 +662,67 @@ class CacheAttrs(OpAttrs):
 
     def weights(self, x: Shape):
         return {"cached": WeightSpec(x.to_shape(), "zeros", trainable=False)}
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineAttrs(OpAttrs):
+    """Stacked transformer decoder blocks run as a GPipe pipeline.
+
+    Fills the reference's OP_PIPELINE stub (ffconst.h / model.h:190-192 —
+    enum + task IDs with no implementation) with a real TPU execution mode:
+    the composite holds `layers` identical decoder blocks (RMSNorm -> GQA
+    attention with RoPE -> RMSNorm -> SwiGLU MLP) with weights STACKED on a
+    leading layer dim. On a mesh with a `pipe` axis the lowering runs them
+    as layers/pipe_degree stages with microbatches circulating via
+    lax.ppermute (parallel/pipeline.py); otherwise as a lax.scan over
+    layers (layer-stacking — one compiled block instead of L copies).
+    """
+
+    layers: int
+    heads: int
+    kv_heads: int
+    hidden: int
+    n_microbatches: int = 4
+    causal: bool = True
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+
+    def infer(self, x: Shape):
+        return (elementwise_like(x),)
+
+    def weights(self, x: Shape):
+        dim = x.dims[-1].size
+        hd = dim // self.heads
+        dt = x.dtype
+        L = self.layers
+
+        def w(*shape):
+            return WeightSpec(TensorShape((L,) + shape, dt))
+
+        return {
+            "ln1": WeightSpec(TensorShape((L, dim), dt), "ones"),
+            "wq": w(dim, self.heads, hd),
+            "wk": w(dim, self.kv_heads, hd),
+            "wv": w(dim, self.kv_heads, hd),
+            "wo": w(self.heads, hd, dim),
+            "ln2": WeightSpec(TensorShape((L, dim), dt), "ones"),
+            "gate": w(dim, self.hidden),
+            "up": w(dim, self.hidden),
+            "down": w(self.hidden, dim),
+        }
+
+    def flops(self, ins, outs):
+        x = ins[0]
+        tokens = math.prod(d.size for d in x.dims[:-1])
+        seq = x.dims[-2].size if x.ndim >= 2 else 1
+        dim = x.dims[-1].size
+        hd = dim // self.heads
+        per_layer = (
+            dim * self.heads * hd
+            + 2 * dim * self.kv_heads * hd
+            + self.heads * hd * dim
+            + 3 * dim * self.hidden
+        )
+        dense = 2 * tokens * per_layer
+        attn = 2 * tokens * seq * dim  # QK^T + PV at causal half density
+        return self.layers * (dense + attn)

@@ -585,3 +585,77 @@ def _cache(attrs, inputs, params, ctx):
         ctx.state_updates["cached"] = x
         return [x]
     return [params["cached"]]
+
+
+# ---------------------------------------------------------------------------
+# pipeline composite (fills the reference's OP_PIPELINE stub — see
+# ops/attrs.py PipelineAttrs and parallel/pipeline.py)
+
+
+def _decoder_block(p, h, attrs):
+    """One llama decoder block on per-layer params `p` (matches the
+    unstacked builder: rms_norm -> GQA+RoPE attention -> rms_norm ->
+    SwiGLU, residuals around both halves)."""
+    dt = h.dtype
+
+    def rms(x, scale):
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        return (xf * lax.rsqrt(ms + attrs.norm_eps)
+                * scale.astype(jnp.float32)).astype(dt)
+
+    hd = h.shape[-1] // attrs.heads
+    a = rms(h, p["ln1"])
+    q = jnp.einsum("bse,ehd->bshd", a, p["wq"].astype(dt))
+    k = jnp.einsum("bse,ehd->bshd", a, p["wk"].astype(dt))
+    v = jnp.einsum("bse,ehd->bshd", a, p["wv"].astype(dt))
+    q = apply_rope(q, attrs.rope_theta)
+    k = apply_rope(k, attrs.rope_theta)
+    o = fused_attention(q, k, v, causal=attrs.causal, scale=1.0 / (hd**0.5),
+                        mesh=None)
+    h = h + jnp.einsum("bshd,hde->bse", o, p["wo"].astype(dt))
+    m = rms(h, p["ln2"])
+    g = jnp.einsum("bse,eh->bsh", m, p["gate"].astype(dt))
+    u = jnp.einsum("bse,eh->bsh", m, p["up"].astype(dt))
+    return h + jnp.einsum("bsh,he->bse", jax.nn.silu(g) * u,
+                          p["down"].astype(dt))
+
+
+@register_lowering(OpType.PIPELINE)
+def _pipeline(attrs, inputs, params, ctx):
+    (x,) = inputs
+    mesh = ctx.mesh
+    pipe_deg = 1
+    if mesh is not None and "pipe" in mesh.axis_names:
+        pipe_deg = dict(zip(mesh.axis_names, mesh.devices.shape))["pipe"]
+
+    # GPipe only when the node's ASSIGNED view pipe-shards the stacked
+    # weights — a default-DP view was priced as a plain scan and must run
+    # as one (dispatching on the mesh alone would pay an unpriced bubble)
+    view = ctx.sharding
+    ln1 = view.weight_specs.get("ln1") if view is not None else None
+    pipe_view = bool(ln1 and ln1[0] and "pipe" in ln1[0])
+
+    def scan_layers(h, layer_params):
+        def body(carry, p):
+            return _decoder_block(p, carry, attrs), None
+
+        out, _ = lax.scan(body, h, layer_params)
+        return out
+
+    if (pipe_deg > 1 and pipe_view and attrs.layers % pipe_deg == 0
+            and x.shape[0] % attrs.n_microbatches == 0):
+        from flexflow_tpu.parallel.pipeline import pipeline_apply
+
+        per = attrs.layers // pipe_deg
+        stacked = jax.tree.map(
+            lambda a: a.reshape(pipe_deg, per, *a.shape[1:]), params
+        )
+        y = pipeline_apply(
+            lambda p, h: scan_layers(h, p),
+            stacked, x, mesh=mesh,
+            n_microbatches=attrs.n_microbatches, axis="pipe",
+        )
+        return [y]
+    # no pipe axis: layer-stacked scan (one compiled block instead of L)
+    return [scan_layers(x, params)]

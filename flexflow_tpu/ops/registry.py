@@ -25,6 +25,9 @@ class LowerCtx:
     mesh: Optional[object] = None
     seq_length: Optional[int] = None  # FFIterationConfig truncation
     node_guid: int = 0
+    # the node's assigned ShardingView (composites like PIPELINE dispatch
+    # on it: a pipe-sharded view selects the GPipe schedule)
+    sharding: Optional[object] = None
     # lowering writes non-trainable state updates here (BatchNorm running
     # stats, Cache buffers): key = weight name within the op
     state_updates: Dict[str, object] = dataclasses.field(default_factory=dict)

@@ -152,3 +152,21 @@ def used_axes(view: ShardingView) -> Tuple[str, ...]:
                     if a not in axes:
                         axes.append(a)
     return tuple(axes)
+
+
+def pipeline_pipe_view(out_ndim: int = 3) -> "ShardingView":
+    """The canonical view for a pipe-sharded PIPELINE composite: every
+    stacked decoder weight shards its leading layer dim over `pipe`,
+    activations stay batch-sharded over `data`. Single source of truth for
+    search/space.py enumeration and models.llama.llama_pp_strategy."""
+    pipe1 = (("pipe",),)
+    return ShardingView(
+        (batch_spec(out_ndim),),
+        {
+            "ln1": pipe1 + ((),), "ln2": pipe1 + ((),),
+            "wq": pipe1 + ((), (), ()), "wk": pipe1 + ((), (), ()),
+            "wv": pipe1 + ((), (), ()), "wo": pipe1 + ((), (), ()),
+            "gate": pipe1 + ((), ()), "up": pipe1 + ((), ()),
+            "down": pipe1 + ((), ()),
+        },
+    )

@@ -59,23 +59,42 @@ def save_checkpoint(path: str, ffmodel, extra: Optional[Dict] = None,
         "nontrainable": ntr,
         "opt_state": ffmodel._opt_state,
     }
+    import jax
+
+    # in a multi-controller job every process calls save (the orbax save
+    # is collective), but only process 0 may touch shared metadata or
+    # delete directories — concurrent rmtree/json writes would race
+    primary = jax.process_index() == 0
     if backend == "orbax":
         import shutil
 
         import orbax.checkpoint as ocp
 
         state_dir = os.path.join(os.path.abspath(path), "state")
-        # orbax refuses to overwrite; a restarted job re-reaching the same
-        # step must behave like the npz path (overwrite), not crash
-        if os.path.exists(state_dir):
-            shutil.rmtree(state_dir)
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(state_dir, state)
+        try:
+            # newer orbax overwrites atomically with force=True
+            ckptr.save(state_dir, state, force=True)
+        except TypeError:
+            # older orbax: a restarted job re-reaching the same step must
+            # overwrite like the npz path, not crash. Primary clears the old
+            # dir, then ALL processes barrier before the collective save —
+            # otherwise another host could be writing shards into the very
+            # directory primary is deleting.
+            if primary and os.path.exists(state_dir):
+                shutil.rmtree(state_dir)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("ckpt_overwrite_clear")
+            ckptr.save(state_dir, state)
         ckptr.wait_until_finished()
     else:
         flat = _flatten(state)
         arrays = {k: np.asarray(v) for k, v in flat.items()}
         np.savez(os.path.join(path, "arrays.npz"), **arrays)
+    if not primary:
+        return
     meta = {
         "step_count": ffmodel._step_count,
         "seed": ffmodel.config.seed,
@@ -146,10 +165,8 @@ def restore_checkpoint(path: str, ffmodel) -> Dict:
         put_like(state.get("nontrainable", {}), ntr_cur),
     )
     ffmodel._opt_state = put_like(state.get("opt_state", {}), ffmodel._opt_state)
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
-    ffmodel._step_count = meta.get("step_count", 0)
-    return meta
+    ffmodel._step_count = saved_meta.get("step_count", 0)
+    return saved_meta
 
 
 def save_checkpoint_orbax(path: str, ffmodel):
@@ -169,6 +186,8 @@ def periodic_save(ckpt_dir: str, ffmodel, *, backend: Optional[str] = None):
             backend = "orbax"
         except Exception:
             backend = "npz"
+    import jax
+
     step = ffmodel._step_count
     name = f"step_{step}"
     path = os.path.join(ckpt_dir, name)
@@ -176,11 +195,12 @@ def periodic_save(ckpt_dir: str, ffmodel, *, backend: Optional[str] = None):
     # pointer holds only the basename (rejoined with ckpt_dir at restore,
     # so a resume from another cwd works) and is replaced atomically (a
     # crash mid-write must not corrupt the very pointer crash recovery
-    # depends on)
-    tmp = os.path.join(ckpt_dir, ".latest.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump({"name": name, "step": step}, f)
-    os.replace(tmp, os.path.join(ckpt_dir, "latest.json"))
+    # depends on); process 0 only — every host runs fit()
+    if jax.process_index() == 0:
+        tmp = os.path.join(ckpt_dir, ".latest.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"name": name, "step": step}, f)
+        os.replace(tmp, os.path.join(ckpt_dir, "latest.json"))
     return path
 
 

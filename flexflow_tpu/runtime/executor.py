@@ -290,6 +290,7 @@ class Executor:
                 mesh=self.mesh,
                 seq_length=self.seq_length,
                 node_guid=n.guid,
+                sharding=n.sharding,
             )
             if (
                 skip_sink_softmax

@@ -33,6 +33,22 @@ def _in_shapes(graph, node):
     return ins
 
 
+def pipeline_compute_factor(node: Node, view: Optional[ShardingView],
+                            axis_sizes: Dict[str, int]) -> float:
+    """GPipe bubble multiplier for a pipe-sharded PIPELINE composite:
+    (M+P-1)/M — every stage idles for P-1 of the M+P-1 schedule ticks.
+    1.0 for anything else. Shared by the analytic and measured cost models
+    so measured cache hits pay the bubble too."""
+    if node.op_type != OpType.PIPELINE or view is None:
+        return 1.0
+    ln1 = view.weight_specs.get("ln1")
+    if not (ln1 and ln1[0] and "pipe" in ln1[0]):
+        return 1.0
+    p = axis_sizes.get("pipe", 1)
+    m = max(getattr(node.attrs, "n_microbatches", 1), 1)
+    return (m + p - 1) / m if p > 1 else 1.0
+
+
 def spec_degree(spec: Optional[Spec], axis_sizes: Dict[str, int],
                 ndim: Optional[int] = None) -> int:
     """Total sharding degree implied by a spec."""
@@ -88,7 +104,8 @@ class CostModel:
             )
         degree = max(degree, 1)
         factor = (1.0 + self.backward_factor) if training else 1.0
-        return self.machine.compute_time(flops * factor / degree, byts * factor / degree)
+        t = self.machine.compute_time(flops * factor / degree, byts * factor / degree)
+        return t * pipeline_compute_factor(node, view, self.axis_sizes)
 
     def node_comm_time(self, graph: Graph, node: Node,
                        view: Optional[ShardingView]) -> float:
@@ -154,6 +171,18 @@ class CostModel:
                     return 2.0 * self.machine.all_to_all_time(
                         ins[0].global_bytes(), deg
                     )
+        # pipeline: each of the (M+P-1) schedule ticks ppermutes one
+        # microbatch activation to the next stage (one ICI hop)
+        if node.op_type == OpType.PIPELINE and view is not None and ins:
+            ln1 = view.weight_specs.get("ln1")
+            if ln1 and ln1[0] and "pipe" in ln1[0]:
+                p = self.axis_sizes.get("pipe", 1)
+                m = max(getattr(node.attrs, "n_microbatches", 1), 1)
+                if p > 1:
+                    micro_bytes = ins[0].global_bytes() / m
+                    per_hop = (micro_bytes / self.machine._axis_bw(2)
+                               + self.machine.ici_latency)
+                    return (m + p - 1) * per_hop
         # contraction-dim sharding => partial-sum all-reduce of the output
         if view is not None and node.outputs:
             contraction_specs = {

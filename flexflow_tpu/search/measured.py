@@ -198,7 +198,12 @@ class MeasuredCostModel(CostModel):
         in_shards, w_shards = self._shard_inputs(graph, node, view)
         key = self._key(node, view, in_shards, w_shards)
         if key in self._measured:
+            from flexflow_tpu.search.cost_model import pipeline_compute_factor
+
             factor = (1.0 + self.backward_factor) if training else 1.0
+            # the microbenchmark times the per-stage compute only; a
+            # pipe-sharded PIPELINE still pays the GPipe bubble on top
+            factor *= pipeline_compute_factor(node, view, self.axis_sizes)
             return self._measured[key] * factor
         return super().node_compute_time(graph, node, view, training)
 

@@ -122,6 +122,16 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
                 {"kernel": (("model",), ())},  # vocab-sharded
             )
         )
+    elif t == OpType.PIPELINE and axis_sizes.get("pipe", 1) > 1:
+        from flexflow_tpu.parallel.sharding import pipeline_pipe_view
+
+        batch = node.outputs[0].dims[0].size if node.outputs else 0
+        # only executable views: the lowering falls back to a plain scan
+        # when layers don't divide into stages or the batch doesn't split
+        # into microbatches — pricing a bubble it won't pay would mislead
+        if (node.attrs.layers % axis_sizes["pipe"] == 0
+                and batch % max(node.attrs.n_microbatches, 1) == 0):
+            views.append(pipeline_pipe_view(out_ndim))
     elif t == OpType.EXPERTS and (has_expert or has_model):
         ax = "expert" if has_expert else "model"
         views.append(

@@ -343,14 +343,22 @@ def make_cancel_parallel_ops() -> GraphXfer:
 
 
 def default_xfers(axis_sizes: Dict[str, int]) -> List[GraphXfer]:
-    xf = [make_fuse_linear_activation(), make_cancel_parallel_ops(),
-          make_fuse_parallel_ops()]
+    # linear+activation fusion comes from the JSON corpus
+    # (fuse_linear_{relu,gelu,sigmoid,tanh,silu}); registering the
+    # hand-coded make_fuse_linear_activation too would double-match every
+    # pair and waste search budget on structure-hash-deduped twins
+    xf = [make_cancel_parallel_ops(), make_fuse_parallel_ops()]
     if axis_sizes.get("model", 1) > 1:
         xf += [
             make_partition_linear_combine("model"),
             make_replicate_linear_reduce("model"),
             make_partition_attention_combine("model"),
         ]
+    # declarative JSON corpus (general pattern graphs: multi-input merges,
+    # cancellations, conv/embedding parallelization — xfer_engine.py)
+    from flexflow_tpu.search.xfer_engine import default_decl_xfers
+
+    xf += default_decl_xfers(axis_sizes)
     return xf
 
 

@@ -1,0 +1,619 @@
+"""Declarative pattern-graph substitutions + JSON rule corpus loader.
+
+Reference analog: the general GraphXfer engine (OpX/TensorX pattern graphs
+with PM/TN constraints, substitution.h:40-110) and the TASO-style JSON rule
+corpus loaded by substitution_loader.cc (substitutions/graph_subst_3_v2.json,
+640 rules). The hand-coded Python builders in search/substitution.py cover
+the canonical TP chains; this engine covers everything declarative:
+
+  - patterns are small GRAPHS (multi-node, multi-input, shared inputs),
+    matched by backtracking subgraph isomorphism with per-node predicates
+    ("when") and cross-node constraints ("where") — not just linear chains;
+  - rewrites are declarative target graphs whose node attrs are either
+    copied from matched nodes ($copy), constructed from referenced fields
+    ($attr / $sum), or literal; parallelization rules attach ShardingViews
+    (the same JSON format as strategy export);
+  - rules serialize to/from JSON, and a generated default corpus ships in
+    search/rules/default_rules.json (templates instantiated over op types,
+    activations, and mesh axes — see gen_default_rules()).
+
+A DeclXfer exposes the same find_matches/apply_all surface as the
+hand-coded GraphXfer, so unity_search consumes both transparently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.ffconst import ActiMode, DataType, OpType
+from flexflow_tpu.ops import attrs as A
+from flexflow_tpu.parallel.parallel_ops import (
+    CombineAttrs,
+    ReductionAttrs,
+    RepartitionAttrs,
+    ReplicateAttrs,
+)
+from flexflow_tpu.parallel.sharding import view_from_json
+from flexflow_tpu.pcg.graph import Graph, Node
+
+# ---------------------------------------------------------------------------
+# registries
+
+ATTRS_CLASSES: Dict[OpType, type] = {
+    OpType.NOOP: A.NoOpAttrs,
+    OpType.LINEAR: A.LinearAttrs,
+    OpType.CONV2D: A.Conv2DAttrs,
+    OpType.EMBEDDING: A.EmbeddingAttrs,
+    OpType.ELEMENT_UNARY: A.ElementUnaryAttrs,
+    OpType.ELEMENT_BINARY: A.ElementBinaryAttrs,
+    OpType.RESHAPE: A.ReshapeAttrs,
+    OpType.TRANSPOSE: A.TransposeAttrs,
+    OpType.CONCAT: A.ConcatAttrs,
+    OpType.SPLIT: A.SplitAttrs,
+    OpType.CAST: A.CastAttrs,
+    OpType.SOFTMAX: A.SoftmaxAttrs,
+    OpType.COMBINE: CombineAttrs,
+    OpType.REDUCTION: ReductionAttrs,
+    OpType.REPARTITION: RepartitionAttrs,
+    OpType.REPLICATE: ReplicateAttrs,
+}
+
+_ENUMS = {"ActiMode": ActiMode, "DataType": DataType, "OpType": OpType}
+
+
+def _node_pred_no_weight_sharding(n: Node, want: bool) -> bool:
+    free = n.sharding is None or not n.sharding.weight_specs
+    return free == want
+
+
+def _node_pred_activation(n: Node, name: str) -> bool:
+    return getattr(n.attrs, "activation", None) == ActiMode[name]
+
+
+def _node_pred_attr_eq(n: Node, spec: Sequence) -> bool:
+    field, value = spec
+    return getattr(n.attrs, field, None) == value
+
+
+def _node_pred_unary_kind(n: Node, kinds: Sequence[str]) -> bool:
+    return getattr(n.attrs, "kind", None) in kinds
+
+
+def _node_pred_out_ndim(n: Node, ndim: int) -> bool:
+    return bool(n.outputs) and n.outputs[0].ndim == ndim
+
+
+NODE_PREDICATES: Dict[str, Callable[[Node, Any], bool]] = {
+    "no_weight_sharding": _node_pred_no_weight_sharding,
+    "activation": _node_pred_activation,
+    "attr_eq": _node_pred_attr_eq,
+    "unary_kind": _node_pred_unary_kind,
+    "out_ndim": _node_pred_out_ndim,
+}
+
+
+def _where_perms_inverse(nodes: Dict[str, Node], args: Sequence[str]) -> bool:
+    a, b = nodes[args[0]], nodes[args[1]]
+    pa = getattr(a.attrs, "perm", None)
+    pb = getattr(b.attrs, "perm", None)
+    if pa is None or pb is None or len(pa) != len(pb):
+        return False
+    return all(pb[pa[i]] == i for i in range(len(pa)))
+
+
+def _where_attrs_equal(nodes: Dict[str, Node], args: Sequence) -> bool:
+    ids, field = args[:-1], args[-1]
+    vals = [getattr(nodes[i].attrs, field, None) for i in ids]
+    return all(v == vals[0] for v in vals)
+
+
+WHERE_PREDICATES: Dict[str, Callable[[Dict[str, Node], Any], bool]] = {
+    "perms_inverse": _where_perms_inverse,
+    "attrs_equal": _where_attrs_equal,
+}
+
+
+# ---------------------------------------------------------------------------
+# matching
+
+
+@dataclasses.dataclass
+class Match:
+    nodes: Dict[str, Node]                       # pattern id -> graph node
+    inputs: Dict[str, Tuple[Node, int]]          # input id -> (producer, src_idx)
+
+
+def _candidates(graph: Graph, spec: Dict) -> List[Node]:
+    want = OpType[spec["type"]] if spec.get("type") else None
+    out = []
+    for n in graph.nodes:
+        if want is not None and n.op_type != want:
+            continue
+        ok = True
+        for pname, parg in (spec.get("when") or {}).items():
+            pred = NODE_PREDICATES.get(pname)
+            if pred is None or not pred(n, parg):
+                ok = False
+                break
+        if ok:
+            out.append(n)
+    return out
+
+
+def find_matches(rule: Dict, graph: Graph) -> List[Match]:
+    """Backtracking subgraph-isomorphism over the rule's src pattern.
+
+    Constraints enforced:
+      - internal pattern edges exist with matching output/input indices;
+      - shared external inputs bind consistently (two pattern nodes that
+        list the same input id must consume the SAME producer output);
+      - matched nodes' outputs are consumed only inside the match unless
+        declared a pattern output (a rewrite may not orphan consumers);
+      - rule-level "where" cross-node constraints hold.
+    """
+    src = rule["src"]
+    specs: List[Dict] = src["nodes"]
+    pedges = [tuple(e) for e in src.get("edges", ())]
+    pinputs = [tuple(e) for e in src.get("inputs", ())]
+    poutputs = [tuple(o) for o in src.get("outputs", ())]
+    cand = {s["id"]: _candidates(graph, s) for s in specs}
+    if any(not c for c in cand.values()):
+        return []
+
+    order = [s["id"] for s in specs]
+    matches: List[Match] = []
+
+    # symmetry breaking: pattern nodes with identical specs and no internal
+    # edge ordering them are interchangeable — without this, a symmetric
+    # 2-root pattern (merge_parallel_linears) matches every pair twice and
+    # both mirrored rewrites get fully evaluated by the search
+    spec_key = {
+        s["id"]: json.dumps({k: v for k, v in s.items() if k != "id"},
+                            sort_keys=True, default=str)
+        for s in specs
+    }
+    linked = {(e[0], e[2]) for e in pedges} | {(e[2], e[0]) for e in pedges}
+    sym_prev: Dict[str, str] = {}
+    for i, s in enumerate(specs):
+        for p in specs[:i]:
+            if (spec_key[p["id"]] == spec_key[s["id"]]
+                    and (p["id"], s["id"]) not in linked):
+                sym_prev[s["id"]] = p["id"]
+                break
+
+    def backtrack(i: int, assigned: Dict[str, Node]):
+        if i == len(order):
+            m = _check(assigned)
+            if m is not None:
+                matches.append(m)
+            return
+        pid = order[i]
+        used = set(n.guid for n in assigned.values())
+        floor = -1
+        if pid in sym_prev and sym_prev[pid] in assigned:
+            floor = assigned[sym_prev[pid]].guid
+        for n in cand[pid]:
+            if n.guid in used or n.guid < floor:
+                continue
+            assigned[pid] = n
+            backtrack(i + 1, assigned)
+            del assigned[pid]
+
+    def _check(assigned: Dict[str, Node]) -> Optional[Match]:
+        # internal edges present?
+        internal_pairs = set()
+        for (sid, si, did, di) in pedges:
+            hit = False
+            for e in graph.out_edges(assigned[sid]):
+                if (e.dst == assigned[did].guid and e.src_idx == si
+                        and e.dst_idx == di):
+                    hit = True
+                    break
+            if not hit:
+                return None
+            internal_pairs.add((assigned[sid].guid, assigned[did].guid))
+        # input bindings consistent?
+        binding: Dict[str, Tuple[Node, int]] = {}
+        for (iid, did, didx) in pinputs:
+            found = None
+            for e in graph.in_edges(assigned[did]):
+                if e.dst_idx == didx:
+                    found = (graph.node(e.src), e.src_idx)
+                    break
+            if found is None:
+                return None
+            if found[0].guid in {n.guid for n in assigned.values()}:
+                return None  # inputs must come from OUTSIDE the match
+            if iid in binding and binding[iid] != found:
+                return None
+            binding[iid] = found
+        # coverage: EVERY in-edge of every matched node must be declared
+        # (pattern input or internal edge) — apply_match removes all of
+        # them, so an undeclared operand would be silently dropped from a
+        # vararg op instead of rejecting the match
+        declared = {(did, didx) for (_, did, didx) in pinputs}
+        declared |= {(did, di) for (_, _, did, di) in pedges}
+        for pid, n in assigned.items():
+            for e in graph.in_edges(n):
+                if (pid, e.dst_idx) not in declared:
+                    return None
+        # closure: internal outputs only consumed inside unless pattern output
+        out_ok = {(assigned[nid].guid, oidx) for (nid, oidx) in poutputs}
+        guids = {n.guid for n in assigned.values()}
+        for n in assigned.values():
+            for e in graph.out_edges(n):
+                if e.dst in guids:
+                    continue
+                if (n.guid, e.src_idx) not in out_ok:
+                    return None
+        for w in rule.get("where", ()):
+            pred = WHERE_PREDICATES.get(w["kind"])
+            if pred is None or not pred(assigned, w["args"]):
+                return None
+        return Match(dict(assigned), binding)
+
+    backtrack(0, {})
+    return matches
+
+
+# ---------------------------------------------------------------------------
+# rewriting
+
+
+def _build_attrs(spec: Any, matched: Dict[str, Node], op_type: OpType):
+    """Attrs for a dst node: $copy reuses a matched node's attrs object
+    (identity-keyed metadata survives); otherwise kwargs for the op's attrs
+    class, with $attr/$sum/$enum value references resolved."""
+    if spec is None:
+        return None
+    if isinstance(spec, dict) and "$copy" in spec:
+        return matched[spec["$copy"]].attrs
+
+    def val(v):
+        if isinstance(v, dict):
+            if "$attr" in v:
+                nid, field = v["$attr"]
+                return getattr(matched[nid].attrs, field)
+            if "$sum" in v:
+                return sum(val(x) for x in v["$sum"])
+            if "$list_attr" in v:
+                nid, field = v["$list_attr"]
+                return list(getattr(matched[nid].attrs, field))
+            if "$enum" in v:
+                ename, member = v["$enum"]
+                return _ENUMS[ename][member]
+        if isinstance(v, list):
+            return tuple(val(x) for x in v)
+        return v
+
+    cls = ATTRS_CLASSES.get(op_type)
+    if cls is None:
+        raise ValueError(f"no attrs class registered for {op_type}")
+    return cls(**{k: val(v) for k, v in spec.items()})
+
+
+def apply_match(rule: Dict, graph: Graph, match: Match) -> Optional[Graph]:
+    """Replace the matched subgraph with the rule's dst graph."""
+    dst = rule["dst"]
+    g = graph.copy()
+    matched = {pid: g.node(n.guid) for pid, n in match.nodes.items()}
+    guids = {n.guid for n in matched.values()}
+
+    # record external consumers per pattern output, in declaration order
+    src_outputs = [tuple(o) for o in rule["src"].get("outputs", ())]
+    ext_consumers: List[List[Tuple[int, int, int]]] = []  # (dst_guid, dst_idx)
+    for (nid, oidx) in src_outputs:
+        cons = []
+        for e in g.out_edges(matched[nid]):
+            if e.dst not in guids and e.src_idx == oidx:
+                cons.append((e.dst, e.dst_idx))
+        ext_consumers.append(cons)
+
+    # drop the matched subgraph (edges first)
+    for n in matched.values():
+        for e in list(g.in_edges(n)) + list(g.out_edges(n)):
+            g.remove_edge(e)
+    for n in matched.values():
+        g.remove_node(n)
+
+    # build dst nodes
+    new_nodes: Dict[str, Node] = {}
+    for spec in dst["nodes"]:
+        op_type = OpType[spec["type"]]
+        attrs = _build_attrs(spec.get("attrs"), matched, op_type)
+        name = spec.get("name", spec["id"]).format(
+            **{pid: n.name for pid, n in matched.items()}
+        )
+        if "reuse" in spec:
+            node = g.add_node(
+                Node(matched[spec["reuse"]].guid, op_type, attrs, name)
+            )
+        else:
+            node = g.create_node(op_type, attrs, name)
+        if spec.get("sharding") is not None:
+            node.sharding = view_from_json(spec["sharding"])
+        new_nodes[spec["id"]] = node
+
+    for (sid, si, did, di) in dst.get("edges", ()):
+        g.add_edge(new_nodes[sid], new_nodes[did], si, di)
+    for (iid, did, didx) in dst.get("inputs", ()):
+        producer, src_idx = match.inputs[iid]
+        g.add_edge(g.node(producer.guid), new_nodes[did], src_idx, didx)
+    dst_outputs = [tuple(o) for o in dst.get("outputs", ())]
+    if len(dst_outputs) != len(src_outputs):
+        raise ValueError(f"rule {rule['name']}: src/dst output arity mismatch")
+    for (nid, oidx), cons in zip(dst_outputs, ext_consumers):
+        for (cguid, didx) in cons:
+            g.add_edge(new_nodes[nid], g.node(cguid), oidx, didx)
+
+    try:
+        g.infer_shapes()
+    except Exception:
+        return None  # rewrite not applicable at these shapes
+    return g
+
+
+@dataclasses.dataclass
+class DeclXfer:
+    """A JSON rule wearing the GraphXfer interface (find_matches/apply_all),
+    so unity_search treats hand-coded and declarative rules uniformly."""
+
+    rule: Dict
+
+    @property
+    def name(self) -> str:
+        return self.rule["name"]
+
+    def find_matches(self, graph: Graph) -> List[Match]:
+        return find_matches(self.rule, graph)
+
+    def apply_all(self, graph: Graph) -> List[Graph]:
+        out = []
+        for m in self.find_matches(graph):
+            g = apply_match(self.rule, graph, m)
+            if g is not None:
+                out.append(g)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# corpus: load / save / generate
+
+
+_RULES_CACHE: Dict[str, List[Dict]] = {}
+
+
+def load_rules(path: str, axis_sizes: Optional[Dict[str, int]] = None
+               ) -> List[DeclXfer]:
+    """Load a JSON rule corpus (substitution_loader.cc analog). Rules with
+    "requires_axis" are dropped when the mesh lacks that axis. Parsed files
+    are cached — sequence_unity_search asks for the corpus once per module
+    per λ probe, and the file is static at runtime."""
+    if path not in _RULES_CACHE:
+        with open(path) as f:
+            _RULES_CACHE[path] = json.load(f)
+    out = []
+    for r in _RULES_CACHE[path]:
+        ax = r.get("requires_axis")
+        if ax and (axis_sizes or {}).get(ax, 1) <= 1:
+            continue
+        out.append(DeclXfer(r))
+    return out
+
+
+def save_rules(path: str, rules: Sequence[Dict]) -> None:
+    with open(path, "w") as f:
+        json.dump(list(rules), f, indent=1)
+
+
+DEFAULT_RULES_PATH = os.path.join(os.path.dirname(__file__), "rules",
+                                  "default_rules.json")
+
+
+def default_decl_xfers(axis_sizes: Dict[str, int]) -> List[DeclXfer]:
+    if not os.path.exists(DEFAULT_RULES_PATH):
+        import warnings
+
+        warnings.warn(
+            "flexflow_tpu: search/rules/default_rules.json missing — the "
+            "substitution search runs WITHOUT the declarative corpus "
+            "(fusions, cancellations, conv/embedding parallelization); "
+            "regenerate with `python -m flexflow_tpu.search.xfer_engine`"
+        )
+        return []
+    return load_rules(DEFAULT_RULES_PATH, axis_sizes)
+
+
+def gen_default_rules() -> List[Dict]:
+    """Generate the shipped corpus from templates (the analog of the
+    reference's TASO-generated graph_subst_3_v2.json; ours is generated
+    from algebraic templates instantiated over ops x activations x axes)."""
+    rules: List[Dict] = []
+
+    # --- fusion: linear (no act) + unary act -> linear(act) -------------
+    for act in ("RELU", "GELU", "SIGMOID", "TANH", "SILU"):
+        rules.append({
+            "name": f"fuse_linear_{act.lower()}",
+            "src": {
+                "nodes": [
+                    {"id": "lin", "type": "LINEAR",
+                     "when": {"activation": "NONE"}},
+                    {"id": "act", "type": "ELEMENT_UNARY",
+                     "when": {"unary_kind": [act.lower()]}},
+                ],
+                "edges": [["lin", 0, "act", 0]],
+                "inputs": [["x", "lin", 0]],
+                "outputs": [["act", 0]],
+            },
+            "dst": {
+                "nodes": [
+                    {"id": "f", "type": "LINEAR", "reuse": "lin",
+                     "name": "{lin}",
+                     "attrs": {
+                         "out_dim": {"$attr": ["lin", "out_dim"]},
+                         "use_bias": {"$attr": ["lin", "use_bias"]},
+                         "activation": {"$enum": ["ActiMode", act]},
+                         "dtype": {"$attr": ["lin", "dtype"]},
+                     }},
+                ],
+                "inputs": [["x", "f", 0]],
+                "outputs": [["f", 0]],
+            },
+        })
+
+    # --- cancellations --------------------------------------------------
+    rules.append({
+        "name": "cancel_transpose_transpose",
+        "src": {
+            "nodes": [
+                {"id": "t1", "type": "TRANSPOSE"},
+                {"id": "t2", "type": "TRANSPOSE"},
+            ],
+            "edges": [["t1", 0, "t2", 0]],
+            "inputs": [["x", "t1", 0]],
+            "outputs": [["t2", 0]],
+        },
+        "where": [{"kind": "perms_inverse", "args": ["t1", "t2"]}],
+        "dst": {
+            "nodes": [
+                {"id": "n", "type": "NOOP", "reuse": "t2", "name": "{t2}",
+                 "attrs": {}},
+            ],
+            "inputs": [["x", "n", 0]],
+            "outputs": [["n", 0]],
+        },
+    })
+    rules.append({
+        "name": "collapse_reshape_reshape",
+        "src": {
+            "nodes": [
+                {"id": "r1", "type": "RESHAPE"},
+                {"id": "r2", "type": "RESHAPE"},
+            ],
+            "edges": [["r1", 0, "r2", 0]],
+            "inputs": [["x", "r1", 0]],
+            "outputs": [["r2", 0]],
+        },
+        "dst": {
+            "nodes": [
+                {"id": "r", "type": "RESHAPE", "reuse": "r2", "name": "{r2}",
+                 "attrs": {"shape": {"$list_attr": ["r2", "shape"]}}},
+            ],
+            "inputs": [["x", "r", 0]],
+            "outputs": [["r", 0]],
+        },
+    })
+    # NOTE: no cast-cast collapse — cast(cast(x, narrow), wide) is a
+    # deliberate truncation, so eliminating the intermediate cast would
+    # change model outputs (semantics-preserving rules only).
+
+    # --- TASO-style merge: two linears sharing an input -> wide + split -
+    rules.append({
+        "name": "merge_parallel_linears",
+        "src": {
+            "nodes": [
+                {"id": "a", "type": "LINEAR",
+                 "when": {"activation": "NONE",
+                          "attr_eq": ["use_bias", False], "out_ndim": 2}},
+                {"id": "b", "type": "LINEAR",
+                 "when": {"activation": "NONE",
+                          "attr_eq": ["use_bias", False], "out_ndim": 2}},
+            ],
+            "edges": [],
+            "inputs": [["x", "a", 0], ["x", "b", 0]],  # SHARED input
+            "outputs": [["a", 0], ["b", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["a", "b", "dtype"]}],
+        "dst": {
+            "nodes": [
+                {"id": "wide", "type": "LINEAR", "reuse": "a",
+                 "name": "{a}_{b}_merged",
+                 "attrs": {
+                     "out_dim": {"$sum": [{"$attr": ["a", "out_dim"]},
+                                          {"$attr": ["b", "out_dim"]}]},
+                     "use_bias": False,
+                     "dtype": {"$attr": ["a", "dtype"]},
+                 }},
+                {"id": "sp", "type": "SPLIT", "name": "{a}_{b}_split",
+                 "attrs": {
+                     "sizes": [{"$attr": ["a", "out_dim"]},
+                               {"$attr": ["b", "out_dim"]}],
+                     "axis": 1,
+                 }},
+            ],
+            "edges": [["wide", 0, "sp", 0]],
+            "inputs": [["x", "wide", 0]],
+            "outputs": [["sp", 0], ["sp", 1]],
+        },
+    })
+
+    # --- parallelization rules (explicit parallel-op insertions) --------
+    for axis in ("model", "seq", "expert"):
+        # conv2d output-channel TP + combine on the channel dim
+        rules.append({
+            "name": f"partition_conv2d_combine_{axis}",
+            "requires_axis": axis,
+            "src": {
+                "nodes": [{"id": "c", "type": "CONV2D",
+                           "when": {"no_weight_sharding": True}}],
+                "inputs": [["x", "c", 0]],
+                "outputs": [["c", 0]],
+            },
+            "dst": {
+                "nodes": [
+                    {"id": "c2", "type": "CONV2D", "reuse": "c", "name": "{c}",
+                     "attrs": {"$copy": "c"},
+                     "sharding": {
+                         "outputs": [[["data"], [axis], [], []]],
+                         "weights": {"kernel": [[axis], [], [], []],
+                                     "bias": [[axis]]},
+                     }},
+                    {"id": "comb", "type": "COMBINE", "name": "{c}_combine",
+                     "attrs": {"dim": 1, "axes": [axis]},
+                     "sharding": {"outputs": [[["data"], [], [], []]],
+                                  "weights": {}}},
+                ],
+                "edges": [["c2", 0, "comb", 0]],
+                "inputs": [["x", "c2", 0]],
+                "outputs": [["comb", 0]],
+            },
+        })
+        # embedding out-dim TP + combine on the last dim
+        rules.append({
+            "name": f"partition_embedding_combine_{axis}",
+            "requires_axis": axis,
+            "src": {
+                "nodes": [{"id": "e", "type": "EMBEDDING",
+                           "when": {"no_weight_sharding": True}}],
+                "inputs": [["x", "e", 0]],
+                "outputs": [["e", 0]],
+            },
+            "dst": {
+                "nodes": [
+                    {"id": "e2", "type": "EMBEDDING", "reuse": "e",
+                     "name": "{e}", "attrs": {"$copy": "e"},
+                     "sharding": {
+                         "outputs": [[["data"], [], [axis]]],
+                         "weights": {"kernel": [[], [axis]]},
+                     }},
+                    {"id": "comb", "type": "COMBINE", "name": "{e}_combine",
+                     "attrs": {"dim": 2, "axes": [axis]},
+                     "sharding": {"outputs": [[["data"], [], []]],
+                                  "weights": {}}},
+                ],
+                "edges": [["e2", 0, "comb", 0]],
+                "inputs": [["x", "e2", 0]],
+                "outputs": [["comb", 0]],
+            },
+        })
+
+    return rules
+
+
+if __name__ == "__main__":
+    os.makedirs(os.path.dirname(DEFAULT_RULES_PATH), exist_ok=True)
+    save_rules(DEFAULT_RULES_PATH, gen_default_rules())
+    print(f"wrote {len(gen_default_rules())} rules to {DEFAULT_RULES_PATH}")

@@ -247,9 +247,17 @@ def test_sequence_unity_matches_flat_on_deep_llama():
     hand = graph_cost(g, _filled(g, llama_tp_strategy(lcfg)), cost).time
     merged, strategy, found = sequence_unity_search(g, cost, budget=10)
     assert found <= 1.05 * hand, (found, hand)
-    # the merged graph must be a complete stitched PCG
+    # the merged graph must be a complete stitched PCG: at most the
+    # fusable activation unaries (folded into their producing linears by
+    # the fusion rules) may disappear
     assert len(merged.sinks()) == 1
-    assert len(merged) >= len(g) - 2
+    fusable = len([
+        n for n in g.nodes
+        if n.op_type == OpType.ELEMENT_UNARY
+        and getattr(n.attrs, "kind", None) in
+        ("relu", "gelu", "silu", "sigmoid", "tanh")
+    ])
+    assert len(merged) >= len(g) - 2 - fusable
 
 def test_memory_lambda_search_fits_budget():
     """graph.cc:2046-2131 analog. Inference on a big-weight MLP is the

@@ -1,0 +1,152 @@
+"""Declarative substitution engine tests (general pattern graphs + JSON
+corpus — reference substitution.h:40-110 + substitution_loader.cc analog)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import DataType, FFConfig, FFModel, LossType
+from flexflow_tpu.ffconst import ActiMode, OpType
+from flexflow_tpu.search.xfer_engine import (
+    DEFAULT_RULES_PATH,
+    DeclXfer,
+    default_decl_xfers,
+    gen_default_rules,
+    load_rules,
+)
+
+
+def _rule(name):
+    return DeclXfer(next(r for r in gen_default_rules() if r["name"] == name))
+
+
+def test_corpus_file_matches_generator(tmp_path):
+    """The shipped JSON equals gen_default_rules() (no stale artifact)."""
+    import json
+
+    shipped = json.load(open(DEFAULT_RULES_PATH))
+    assert shipped == gen_default_rules()
+
+
+def test_fuse_linear_act_decl():
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 32), DataType.FLOAT, name="input")
+    t = ff.dense(x, 64, name="d0")
+    t = ff.gelu(t, name="g0")
+    ff.softmax(ff.dense(t, 4, name="d1"), name="softmax")
+    ff.graph.infer_shapes()
+    cands = _rule("fuse_linear_gelu").apply_all(ff.graph)
+    assert len(cands) == 1
+    g = cands[0]
+    assert len(g) == len(ff.graph) - 1
+    d0 = [n for n in g.nodes if n.name == "d0"][0]
+    assert d0.attrs.activation == ActiMode.GELU
+
+
+def test_cancel_transpose_transpose_decl():
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 6, 8), DataType.FLOAT, name="input")
+    t = ff.transpose(x, (0, 2, 1), name="t1")
+    t = ff.transpose(t, (0, 2, 1), name="t2")
+    ff.mean(t, axes=[1, 2], name="mean")
+    ff.graph.infer_shapes()
+    cands = _rule("cancel_transpose_transpose").apply_all(ff.graph)
+    assert len(cands) == 1
+    g = cands[0]
+    assert not [n for n in g.nodes if n.op_type == OpType.TRANSPOSE]
+    # non-inverse perms must NOT match
+    ff2 = FFModel(FFConfig(batch_size=4))
+    x2 = ff2.create_tensor((4, 6, 8), DataType.FLOAT, name="input")
+    t = ff2.transpose(x2, (0, 2, 1), name="t1")
+    t = ff2.transpose(t, (1, 0, 2), name="t2")
+    ff2.mean(t, axes=[1, 2], name="mean")
+    ff2.graph.infer_shapes()
+    assert _rule("cancel_transpose_transpose").apply_all(ff2.graph) == []
+
+
+def test_merge_parallel_linears_multi_input_pattern():
+    """The TASO-style merge proves the engine handles multi-node patterns
+    with SHARED external inputs and multiple pattern outputs."""
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 32), DataType.FLOAT, name="input")
+    a = ff.dense(x, 16, use_bias=False, name="qa")
+    b = ff.dense(x, 48, use_bias=False, name="qb")
+    t = ff.concat([a, b], axis=1, name="cat")
+    ff.softmax(t, name="softmax")
+    ff.graph.infer_shapes()
+    cands = _rule("merge_parallel_linears").apply_all(ff.graph)
+    # symmetry breaking: (a,b) and (b,a) are the same rewrite — one match
+    assert len(cands) == 1
+    g = cands[0]
+    wide = [n for n in g.nodes if n.op_type == OpType.LINEAR]
+    assert len(wide) == 1 and wide[0].attrs.out_dim == 64
+    sp = [n for n in g.nodes if n.op_type == OpType.SPLIT]
+    assert len(sp) == 1
+    # the split outputs feed the concat in the original input order
+    g.infer_shapes()
+    cat = [n for n in g.nodes if n.name == "cat"][0]
+    assert cat.outputs[0].dims[1].size == 64
+
+
+def test_merge_does_not_match_different_producers():
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 32), DataType.FLOAT, name="input")
+    y = ff.relu(x, name="r")
+    a = ff.dense(x, 16, use_bias=False, name="qa")
+    b = ff.dense(y, 48, use_bias=False, name="qb")  # different input
+    ff.concat([a, b], axis=1, name="cat")
+    ff.graph.infer_shapes()
+    assert _rule("merge_parallel_linears").apply_all(ff.graph) == []
+
+
+def test_conv_partition_rule_applies_and_improves():
+    """The conv channel-TP rule rewrites into (sharded conv + explicit
+    Combine) whose modeled cost beats DP on big-channel convs — the conv
+    analog of the hand Linear TP builders. (The full search may reach the
+    same cost through ViewDP views; this pins the REWRITE path.)"""
+    from flexflow_tpu.search.cost_model import CostModel, graph_cost
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.space import default_dp_strategy
+    from flexflow_tpu.search.substitution import unity_search
+
+    # big-channel convs: the 2048x2048x3x3 weight (151MB) makes DP's
+    # full-weight gradient allreduce dominate, so channel-TP + combine wins
+    ff = FFModel(FFConfig(batch_size=2))
+    x = ff.create_tensor((2, 16, 16, 16), DataType.FLOAT, name="input")
+    t = ff.conv2d(x, 2048, 3, 3, 1, 1, 1, 1, name="c0")
+    t = ff.conv2d(t, 2048, 3, 3, 1, 1, 1, 1, name="c1")
+    ff.mean(t, axes=[1, 2], name="mean")
+    ff.graph.infer_shapes()
+    axis_sizes = {"data": 2, "model": 4}
+    cost = CostModel(TPUMachineModel.make("v5e", 8), axis_sizes)
+    dp = default_dp_strategy(ff.graph, axis_sizes)
+    dp_time = graph_cost(ff.graph, dp, cost).time
+
+    rule = _rule("partition_conv2d_combine_model")
+    cands = rule.apply_all(ff.graph)
+    assert len(cands) == 2  # one per conv
+    # compose: rewrite the second conv too (the search does this across
+    # best-first iterations)
+    g = rule.apply_all(cands[0])[0]
+    assert len([n for n in g.nodes if n.op_type == OpType.COMBINE]) == 2
+    conv = [n for n in g.nodes if n.op_type == OpType.CONV2D
+            and n.sharding is not None and n.sharding.weight_specs]
+    assert len(conv) == 2, "rewritten convs carry the channel-TP sharding"
+    strat = default_dp_strategy(g, axis_sizes)
+    strat.update({n.name: n.sharding for n in g.nodes if n.sharding})
+    assert graph_cost(g, strat, cost).time < dp_time
+
+    # and the full search (which consumes the corpus) at least matches DP
+    _, _, t_best = unity_search(ff.graph, cost, budget=8)
+    assert t_best < dp_time
+
+
+def test_load_rules_axis_filter(tmp_path):
+    rules = [r for r in gen_default_rules()]
+    import json
+
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps(rules))
+    no_model = load_rules(str(p), {"data": 8})
+    with_model = load_rules(str(p), {"data": 2, "model": 4})
+    assert len(with_model) > len(no_model)
+    assert all("seq" != r.rule.get("requires_axis") for r in no_model)
