@@ -1,0 +1,35 @@
+"""InceptionV3 on synthetic images (reference examples/cpp/InceptionV3):
+multi-branch concat blocks — the Unity search's substitution playground.
+
+Run:  python examples/python/inception_v3.py -b 4 -e 1 [--budget 8]
+"""
+
+import numpy as np
+
+from flexflow_tpu import (
+    FFConfig, FFModel, LossType, MetricsType, SGDOptimizer,
+)
+from flexflow_tpu.models.inception import build_inception_v3
+
+
+def main(argv=None):
+    import sys
+
+    cfg = FFConfig.from_args(argv if argv is not None else sys.argv[1:])
+    ff = FFModel(cfg)
+    size, classes = 75, 10  # small images keep the example CPU-friendly
+    build_inception_v3(ff, image_size=size, classes=classes)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    rs = np.random.RandomState(0)
+    n = max(cfg.batch_size * 2, 8)
+    x = rs.randn(n, 3, size, size).astype(np.float32)
+    y = rs.randint(0, classes, n).astype(np.int32)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,10 @@ from flexflow_tpu.models.bert import BertConfig, build_bert
 from flexflow_tpu.models.llama import LlamaConfig, build_llama, llama_tp_strategy
 from flexflow_tpu.models.mixtral import MixtralConfig, build_mixtral
 from flexflow_tpu.models.dlrm import build_dlrm
+from flexflow_tpu.models.inception import build_inception_v3
+from flexflow_tpu.models.resnext import build_resnext50
+from flexflow_tpu.models.candle_uno import build_candle_uno
+from flexflow_tpu.models.xdl import build_xdl
 
 __all__ = [
     "build_mlp",
@@ -27,4 +31,8 @@ __all__ = [
     "MixtralConfig",
     "build_mixtral",
     "build_dlrm",
+    "build_inception_v3",
+    "build_resnext50",
+    "build_candle_uno",
+    "build_xdl",
 ]

@@ -134,11 +134,12 @@ def llama_tp_strategy(cfg: LlamaConfig, seq_parallel: bool = False) -> Dict[str,
     return views
 
 
-def llama_pp_strategy(cfg: LlamaConfig, n_microbatches: int = 4
-                      ) -> Dict[str, ShardingView]:
+def llama_pp_strategy(cfg: LlamaConfig) -> Dict[str, ShardingView]:
     """Pipeline strategy for the use_pipeline=True builder: the stacked
     decoder weights shard their leading layer dim over `pipe` (stage s
-    holds its layer slice), activations stay batch-sharded over `data`."""
+    holds its layer slice), activations stay batch-sharded over `data`.
+    (`cfg` kept for signature symmetry with llama_tp_strategy; the
+    microbatch count lives in the built PipelineAttrs, not the view.)"""
     from flexflow_tpu.parallel.sharding import pipeline_pipe_view
 
     return {"decoder_pipeline": pipeline_pipe_view(3)}

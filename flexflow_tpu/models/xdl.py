@@ -1,0 +1,30 @@
+"""XDL builder (reference examples/cpp/XDL/xdl.cc): the ads CTR model —
+many small sparse embeddings concatenated straight into a dense stack (no
+DLRM-style bottom MLP / interaction). Embedding-table parallelism target."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flexflow_tpu.ffconst import ActiMode, DataType
+from flexflow_tpu.model import FFModel, Tensor
+
+
+def build_xdl(ff: FFModel, num_sparse: int = 16, vocab: int = 100000,
+              embed_dim: int = 16, dense_dim: int = 16,
+              mlp_dims: Sequence[int] = (512, 256, 128, 1),
+              batch_size: int = None) -> Tensor:
+    b = batch_size or ff.config.batch_size
+    parts = []
+    for i in range(num_sparse):
+        ids = ff.create_tensor((b, 1), DataType.INT32, name=f"sparse_{i}")
+        e = ff.embedding(ids, vocab, embed_dim, name=f"emb_{i}")
+        parts.append(ff.reshape(e, (b, embed_dim), name=f"emb_{i}_flat"))
+    dense_in = ff.create_tensor((b, dense_dim), DataType.FLOAT,
+                                name="dense_input")
+    parts.append(dense_in)
+    t = ff.concat(parts, axis=1, name="cat")
+    for i, d in enumerate(mlp_dims[:-1]):
+        t = ff.dense(t, d, ActiMode.RELU, name=f"mlp{i}")
+    t = ff.dense(t, mlp_dims[-1], ActiMode.SIGMOID, name="ctr")
+    return t

@@ -592,10 +592,13 @@ def _cache(attrs, inputs, params, ctx):
 # ops/attrs.py PipelineAttrs and parallel/pipeline.py)
 
 
-def _decoder_block(p, h, attrs):
+def _decoder_block(p, h, attrs, mesh=None):
     """One llama decoder block on per-layer params `p` (matches the
     unstacked builder: rms_norm -> GQA+RoPE attention -> rms_norm ->
-    SwiGLU, residuals around both halves)."""
+    SwiGLU, residuals around both halves). `mesh` must be None inside the
+    GPipe shard_map worker (already device-local) and ctx.mesh on the
+    fallback scan path (the flash dispatcher needs it to pick the
+    shard_map-wrapped kernel on multi-device meshes)."""
     dt = h.dtype
 
     def rms(x, scale):
@@ -612,7 +615,7 @@ def _decoder_block(p, h, attrs):
     q = apply_rope(q, attrs.rope_theta)
     k = apply_rope(k, attrs.rope_theta)
     o = fused_attention(q, k, v, causal=attrs.causal, scale=1.0 / (hd**0.5),
-                        mesh=None)
+                        mesh=mesh)
     h = h + jnp.einsum("bshd,hde->bse", o, p["wo"].astype(dt))
     m = rms(h, p["ln2"])
     g = jnp.einsum("bse,eh->bsh", m, p["gate"].astype(dt))
@@ -636,15 +639,16 @@ def _pipeline(attrs, inputs, params, ctx):
     ln1 = view.weight_specs.get("ln1") if view is not None else None
     pipe_view = bool(ln1 and ln1[0] and "pipe" in ln1[0])
 
-    def scan_layers(h, layer_params):
+    def scan_layers(h, layer_params, block_mesh=None):
         def body(carry, p):
-            return _decoder_block(p, carry, attrs), None
+            return _decoder_block(p, carry, attrs, mesh=block_mesh), None
 
         out, _ = lax.scan(body, h, layer_params)
         return out
 
+    micro = max(attrs.n_microbatches, 1)
     if (pipe_deg > 1 and pipe_view and attrs.layers % pipe_deg == 0
-            and x.shape[0] % attrs.n_microbatches == 0):
+            and x.shape[0] % micro == 0):
         from flexflow_tpu.parallel.pipeline import pipeline_apply
 
         per = attrs.layers // pipe_deg
@@ -652,10 +656,12 @@ def _pipeline(attrs, inputs, params, ctx):
             lambda a: a.reshape(pipe_deg, per, *a.shape[1:]), params
         )
         y = pipeline_apply(
-            lambda p, h: scan_layers(h, p),
+            # inside the shard_map worker everything is device-local:
+            # the block must NOT re-enter the mesh-aware flash dispatch
+            lambda p, h: scan_layers(h, p, block_mesh=None),
             stacked, x, mesh=mesh,
-            n_microbatches=attrs.n_microbatches, axis="pipe",
+            n_microbatches=micro, axis="pipe",
         )
         return [y]
     # no pipe axis: layer-stacked scan (one compiled block instead of L)
-    return [scan_layers(x, params)]
+    return [scan_layers(x, params, block_mesh=mesh)]

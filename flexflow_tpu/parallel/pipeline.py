@@ -57,10 +57,10 @@ def pipeline_apply(
     """
     p = _axis_size(mesh, axis)
     m = n_microbatches
-    if x.shape[0] % m != 0:
-        raise ValueError(f"batch {x.shape[0]} not divisible by {m} microbatches")
     if m < 1:
         raise ValueError("need at least one microbatch")
+    if x.shape[0] % m != 0:
+        raise ValueError(f"batch {x.shape[0]} not divisible by {m} microbatches")
 
     mb = x.reshape(m, x.shape[0] // m, *x.shape[1:])
     # PP x DP: keep the per-microbatch batch dim sharded over `data` so the

@@ -103,6 +103,13 @@ class CostModel:
                 ),
             )
         degree = max(degree, 1)
+        # a pipe-sharded PIPELINE composes DISJOINT axes: batch over data
+        # (output spec) x layers over pipe (weight spec) — the degrees
+        # multiply, where max() would undercount by the data factor
+        if (node.op_type == OpType.PIPELINE
+                and pipeline_compute_factor(node, view, self.axis_sizes) > 1.0):
+            out_deg = spec_degree(view.output_spec(0), self.axis_sizes)
+            degree = max(out_deg, 1) * self.axis_sizes.get("pipe", 1)
         factor = (1.0 + self.backward_factor) if training else 1.0
         t = self.machine.compute_time(flops * factor / degree, byts * factor / degree)
         return t * pipeline_compute_factor(node, view, self.axis_sizes)
@@ -179,7 +186,11 @@ class CostModel:
                 p = self.axis_sizes.get("pipe", 1)
                 m = max(getattr(node.attrs, "n_microbatches", 1), 1)
                 if p > 1:
-                    micro_bytes = ins[0].global_bytes() / m
+                    # each ppermute moves the per-DATA-SHARD microbatch
+                    out_deg = max(
+                        spec_degree(view.output_spec(0), self.axis_sizes), 1
+                    )
+                    micro_bytes = ins[0].global_bytes() / m / out_deg
                     per_hop = (micro_bytes / self.machine._axis_bw(2)
                                + self.machine.ici_latency)
                     return (m + p - 1) * per_hop

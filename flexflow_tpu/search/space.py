@@ -126,11 +126,15 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
         from flexflow_tpu.parallel.sharding import pipeline_pipe_view
 
         batch = node.outputs[0].dims[0].size if node.outputs else 0
+        micro = max(node.attrs.n_microbatches, 1)
         # only executable views: the lowering falls back to a plain scan
         # when layers don't divide into stages or the batch doesn't split
-        # into microbatches — pricing a bubble it won't pay would mislead
+        # into microbatches, and pipeline_apply replicates over data when
+        # the microbatch doesn't split across it — pricing compute/memory
+        # the execution won't deliver would mislead the search
         if (node.attrs.layers % axis_sizes["pipe"] == 0
-                and batch % max(node.attrs.n_microbatches, 1) == 0):
+                and batch % micro == 0
+                and (batch // micro) % axis_sizes.get("data", 1) == 0):
             views.append(pipeline_pipe_view(out_ndim))
     elif t == OpType.EXPERTS and (has_expert or has_model):
         ax = "expert" if has_expert else "model"

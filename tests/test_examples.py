@@ -33,6 +33,8 @@ def _run(script, *flags, timeout=420):
     ("bert_attribute_parallel.py", ("-b", "8", "--mesh", "data=2,model=4")),
     ("mixtral_moe.py", ("-b", "8", "--mesh", "data=2,expert=4")),
     ("resnet_torch_import.py", ("-b", "8",)),
+    ("inception_v3.py", ("-b", "4",)),
+    ("candle_uno.py", ("-b", "16",)),
 ])
 def test_example_runs(script, flags):
     out = _run(script, *flags)
