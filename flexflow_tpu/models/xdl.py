@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from typing import Sequence
 
-from flexflow_tpu.ffconst import ActiMode, DataType
+from flexflow_tpu.ffconst import ActiMode, AggrMode, DataType
 from flexflow_tpu.model import FFModel, Tensor
 
 
@@ -18,8 +18,10 @@ def build_xdl(ff: FFModel, num_sparse: int = 16, vocab: int = 100000,
     parts = []
     for i in range(num_sparse):
         ids = ff.create_tensor((b, 1), DataType.INT32, name=f"sparse_{i}")
-        e = ff.embedding(ids, vocab, embed_dim, name=f"emb_{i}")
-        parts.append(ff.reshape(e, (b, embed_dim), name=f"emb_{i}_flat"))
+        # SUM aggregation collapses the bag dim to (b, embed_dim) directly
+        # (same pattern as the DLRM builder — no reshape node needed)
+        parts.append(ff.embedding(ids, vocab, embed_dim, AggrMode.SUM,
+                                  name=f"emb_{i}"))
     dense_in = ff.create_tensor((b, dense_dim), DataType.FLOAT,
                                 name="dense_input")
     parts.append(dense_in)

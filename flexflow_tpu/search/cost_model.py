@@ -130,14 +130,22 @@ class CostModel:
             return d
 
         if node.op_type == OpType.REDUCTION and ins:
-            deg = axes_degree(getattr(node.attrs, "axes", ()) or ("model",))
-            return self.machine.all_reduce_time(ins[0].global_bytes(), deg)
+            axes = getattr(node.attrs, "axes", ()) or ("model",)
+            return self.machine.all_reduce_time(
+                ins[0].global_bytes(), axes_degree(axes), axes=tuple(axes)
+            )
         if node.op_type == OpType.COMBINE and ins:
-            deg = max(axes_degree(getattr(node.attrs, "axes", ()) or ("model",)), 2)
-            return self.machine.all_gather_time(ins[0].global_bytes(), deg)
+            axes = getattr(node.attrs, "axes", ()) or ("model",)
+            deg = max(axes_degree(axes), 2)
+            return self.machine.all_gather_time(
+                ins[0].global_bytes(), deg, axes=tuple(axes)
+            )
         if node.op_type == OpType.ALL_TO_ALL and ins:
-            deg = max(axes_degree(getattr(node.attrs, "axes", ())), 2)
-            return self.machine.all_to_all_time(ins[0].global_bytes(), deg)
+            axes = getattr(node.attrs, "axes", ())
+            deg = max(axes_degree(axes), 2)
+            return self.machine.all_to_all_time(
+                ins[0].global_bytes(), deg, axes=tuple(axes)
+            )
         if node.op_type == OpType.FUSED_PARALLEL and ins:
             # fused chain: pay each step's bandwidth but ONE latency term
             # (the reference fuses the chain into a single task,
@@ -145,17 +153,20 @@ class CostModel:
             total, lat = 0.0, 0.0
             nbytes = ins[0].global_bytes()
             for kind, _dim, axes in node.attrs.steps:
-                # same degrees as the unfused node branches above (axes or
-                # "model" default; combine/all_to_all floored at 2), so
-                # fusing never changes the priced degree of a step
-                deg = axes_degree(axes or ("model",))
+                # same degrees AND axis names as the unfused node branches
+                # above (axes or "model" default; combine/all_to_all floored
+                # at 2), so fusing never changes a step's priced cost
+                axes = tuple(axes or ("model",))
+                deg = axes_degree(axes)
                 if kind == "reduction":
-                    t = self.machine.all_reduce_time(nbytes, deg)
+                    t = self.machine.all_reduce_time(nbytes, deg, axes=axes)
                 elif kind in ("combine", "replicate"):
-                    t = self.machine.all_gather_time(nbytes, max(deg, 2))
+                    t = self.machine.all_gather_time(nbytes, max(deg, 2),
+                                                     axes=axes)
                     deg = max(deg, 2)
                 elif kind == "all_to_all":
-                    t = self.machine.all_to_all_time(nbytes, max(deg, 2))
+                    t = self.machine.all_to_all_time(nbytes, max(deg, 2),
+                                                     axes=axes)
                     deg = max(deg, 2)
                 else:  # repartition: local slice
                     t = 0.0
@@ -176,7 +187,7 @@ class CostModel:
                 deg = axes_degree(w1[0])
                 if deg > 1:
                     return 2.0 * self.machine.all_to_all_time(
-                        ins[0].global_bytes(), deg
+                        ins[0].global_bytes(), deg, axes=tuple(w1[0])
                     )
         # pipeline: each of the (M+P-1) schedule ticks ppermutes one
         # microbatch activation to the next stage (one ICI hop)
@@ -191,8 +202,10 @@ class CostModel:
                         spec_degree(view.output_spec(0), self.axis_sizes), 1
                     )
                     micro_bytes = ins[0].global_bytes() / m / out_deg
-                    per_hop = (micro_bytes / self.machine._axis_bw(2)
-                               + self.machine.ici_latency)
+                    per_hop = (
+                        micro_bytes / self.machine._axis_bw(2, ("pipe",))
+                        + self.machine.ici_latency
+                    )
                     return (m + p - 1) * per_hop
         # contraction-dim sharding => partial-sum all-reduce of the output
         if view is not None and node.outputs:
@@ -209,7 +222,8 @@ class CostModel:
                         deg *= self.axis_sizes.get(a, 1)
                     if deg > 1:
                         return self.machine.all_reduce_time(
-                            node.outputs[0].global_bytes(), deg
+                            node.outputs[0].global_bytes(), deg,
+                            axes=tuple(wspec[cdim]),
                         )
         return 0.0
 
@@ -237,10 +251,15 @@ class CostModel:
             # data×model mesh syncs over data*model chips, a col-TP weight
             # only over data
             sync_degree = 1
+            sync_axes = []
             for a, s in self.axis_sizes.items():
                 if a not in used:
                     sync_degree *= s
-            total += self.machine.all_reduce_time(nbytes / shard_degree, sync_degree)
+                    if s > 1:
+                        sync_axes.append(a)
+            total += self.machine.all_reduce_time(
+                nbytes / shard_degree, sync_degree, axes=tuple(sync_axes)
+            )
         return total
 
     def edge_xfer_time(self, shape, src_spec: Optional[Spec],
@@ -270,11 +289,12 @@ class CostModel:
         dst_deg = spec_degree(dst or None, self.axis_sizes)
         if src_deg == dst_deg == 1:
             return 0.0
+        axes = tuple({a for spec in (src, dst) for entry in spec for a in entry})
         parts = max(src_deg, dst_deg, 2)
         if src_deg > 1 and dst_deg > 1:
-            return self.machine.all_to_all_time(nbytes, parts)
+            return self.machine.all_to_all_time(nbytes, parts, axes=axes)
         if src_deg > 1 and dst_deg == 1:
-            return self.machine.all_gather_time(nbytes, src_deg)
+            return self.machine.all_gather_time(nbytes, src_deg, axes=axes)
         # partitioning replicated data is a local slice
         return 0.0
 

@@ -65,9 +65,13 @@ class TPUMachineModel:
     def from_file(path: str) -> "TPUMachineModel":
         """JSON machine description (reference --machine-model-file analog):
         {"chip": "v5p", "num_chips": 64, "mxu_efficiency": 0.55, ...} or a
-        fully custom chip: {"chip": {"name": ..., "bf16_flops": ...}, ...}"""
+        fully custom chip: {"chip": {"name": ..., "bf16_flops": ...}, ...}.
+        A "torus_shape"/"axis_map" entry selects the torus-topology model
+        (TorusMachineModel, the NetworkedMachineModel analog)."""
         with open(path) as f:
             d = json.load(f)
+        if "torus_shape" in d or "axis_map" in d:
+            return TorusMachineModel._from_dict(d)
         chip = d.pop("chip", "v5e")
         if isinstance(chip, dict):
             spec = TPUChipSpec(**chip)
@@ -83,10 +87,12 @@ class TPUMachineModel:
         t_mem = bytes_accessed / (self.chip.hbm_bw * self.hbm_efficiency)
         return max(t_flops, t_mem)
 
-    def _axis_bw(self, participants: int) -> float:
+    def _axis_bw(self, participants: int,
+                 axes: Optional[Tuple[str, ...]] = None) -> float:
         """Aggregate ICI bandwidth available to a collective over one mesh
         axis. A contiguous axis rides one torus dimension: 2 links (both
-        ring directions)."""
+        ring directions). `axes` (mesh axis names) is ignored here; the
+        torus model maps them onto torus dims for multi-ring bandwidth."""
         return 2 * self.chip.ici_link_bw * self.ici_efficiency
 
     def _crosses_dcn(self, participants: int) -> bool:
@@ -94,30 +100,37 @@ class TPUMachineModel:
             self.chips_per_slice is not None and participants > self.chips_per_slice
         )
 
-    def all_reduce_time(self, bytes_global: float, participants: int) -> float:
+    def all_reduce_time(self, bytes_global: float, participants: int,
+                 axes: Optional[Tuple[str, ...]] = None) -> float:
         if participants <= 1:
             return 0.0
         if self._crosses_dcn(participants):
             return bytes_global * 2 / self.dcn_bw + self.ici_latency * participants
         moved = 2 * bytes_global * (participants - 1) / participants
-        return moved / self._axis_bw(participants) + self.ici_latency * participants
+        return (moved / self._axis_bw(participants, axes)
+                + self.ici_latency * participants)
 
-    def all_gather_time(self, bytes_global: float, participants: int) -> float:
+    def all_gather_time(self, bytes_global: float, participants: int,
+                 axes: Optional[Tuple[str, ...]] = None) -> float:
         if participants <= 1:
             return 0.0
         moved = bytes_global * (participants - 1) / participants
-        bw = self.dcn_bw if self._crosses_dcn(participants) else self._axis_bw(participants)
+        bw = (self.dcn_bw if self._crosses_dcn(participants)
+              else self._axis_bw(participants, axes))
         return moved / bw + self.ici_latency * participants
 
-    def reduce_scatter_time(self, bytes_global: float, participants: int) -> float:
-        return self.all_gather_time(bytes_global, participants)
+    def reduce_scatter_time(self, bytes_global: float, participants: int,
+                            axes: Optional[Tuple[str, ...]] = None) -> float:
+        return self.all_gather_time(bytes_global, participants, axes)
 
-    def all_to_all_time(self, bytes_global: float, participants: int) -> float:
+    def all_to_all_time(self, bytes_global: float, participants: int,
+                 axes: Optional[Tuple[str, ...]] = None) -> float:
         if participants <= 1:
             return 0.0
         # each chip keeps 1/n, sends (n-1)/n of its shard
         moved = bytes_global * (participants - 1) / (participants * participants)
-        bw = self.dcn_bw if self._crosses_dcn(participants) else self._axis_bw(participants)
+        bw = (self.dcn_bw if self._crosses_dcn(participants)
+              else self._axis_bw(participants, axes))
         return moved / bw + self.ici_latency * participants
 
     def p2p_time(self, bytes_per_chip: float, hops: int = 1) -> float:
@@ -125,3 +138,173 @@ class TPUMachineModel:
 
     def memory_per_chip(self) -> float:
         return self.chip.hbm_bytes
+
+
+# ---------------------------------------------------------------------------
+# torus-topology model (NetworkedMachineModel / network.cc analog)
+
+
+@dataclasses.dataclass
+class TorusMachineModel(TPUMachineModel):
+    """Explicit ICI torus: chips live at coordinates in a 2D/3D torus and
+    every MESH axis is mapped onto the TORUS dims it spans. This fixes the
+    flat model's simplification that every axis gets one torus ring: an
+    axis folded over k torus dims drives 2k bidirectional links, and p2p
+    cost follows shortest-path torus routing (the reference prices routes
+    through an explicit switch graph + routing strategy, network.cc:47-264;
+    on TPU the topology is the torus itself).
+
+    axis_map: mesh axis name -> tuple of torus dim indices it spans, e.g.
+    v5p-64 as {"data": (0, 1), "model": (2,)} lays data over a 4x4 plane
+    (4 rings) and model along the third dim (2 rings).
+    """
+
+    torus_shape: Tuple[int, ...] = ()
+    axis_map: Dict[str, Tuple[int, ...]] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.torus_shape:
+            # default: fold num_chips into the chip's native torus rank
+            shape = []
+            n = self.num_chips
+            for _ in range(self.chip.torus_dims - 1):
+                d = 1
+                while n % 2 == 0 and d * d <= n:
+                    d *= 2
+                    n //= 2
+                shape.append(d)
+            shape.append(n)
+            self.torus_shape = tuple(s for s in shape if s > 1) or (self.num_chips,)
+        assert math.prod(self.torus_shape) == self.num_chips, (
+            f"torus {self.torus_shape} != {self.num_chips} chips"
+        )
+
+    # -- routing (network.cc ShortestPath analog on a torus) ------------
+
+    def coords(self, device: int) -> Tuple[int, ...]:
+        out = []
+        for s in reversed(self.torus_shape):
+            out.append(device % s)
+            device //= s
+        return tuple(reversed(out))
+
+    def hops(self, a: int, b: int) -> int:
+        """Shortest-path hop count with per-dim wraparound."""
+        total = 0
+        for da, db, s in zip(self.coords(a), self.coords(b), self.torus_shape):
+            d = abs(da - db)
+            total += min(d, s - d)
+        return total
+
+    def p2p_time(self, bytes_per_chip: float, hops: int = 1) -> float:
+        # serial store-and-forward over `hops` links (worst case; real ICI
+        # pipelines — ici_efficiency absorbs the difference)
+        return (bytes_per_chip / (self.chip.ici_link_bw * self.ici_efficiency)
+                + self.ici_latency * hops)
+
+    # -- axis-aware bandwidth -------------------------------------------
+
+    def _axis_links(self, axes: Optional[Tuple[str, ...]]) -> int:
+        """Bidirectional ring count available to a collective over `axes`:
+        2 per torus dim spanned. Unmapped/unknown axes keep the flat
+        model's single-ring assumption."""
+        if not axes:
+            return 2
+        dims = set()
+        for a in axes:
+            dims.update(self.axis_map.get(a, ()))
+        return 2 * len(dims) if dims else 2
+
+    def _axis_bw(self, participants: int,
+                 axes: Optional[Tuple[str, ...]] = None) -> float:
+        return (self._axis_links(axes) * self.chip.ici_link_bw
+                * self.ici_efficiency)
+
+    @staticmethod
+    def from_file(path: str) -> "TorusMachineModel":
+        """{"chip": "v5p", "num_chips": 64, "torus_shape": [4, 4, 4],
+            "axis_map": {"data": [0, 1], "model": [2]}, ...}"""
+        with open(path) as f:
+            return TorusMachineModel._from_dict(json.load(f))
+
+    @staticmethod
+    def _from_dict(d: Dict) -> "TorusMachineModel":
+        chip = d.pop("chip", "v5e")
+        spec = TPUChipSpec(**chip) if isinstance(chip, dict) else CHIPS[chip]
+        d["torus_shape"] = tuple(d.get("torus_shape", ()))
+        d["axis_map"] = {k: tuple(v) for k, v in d.get("axis_map", {}).items()}
+        return TorusMachineModel(spec, d.pop("num_chips", 8), **d)
+
+
+def logical_traffic_matrix(graph, strategy, cost) -> Dict[str, float]:
+    """Per-mesh-axis communicated bytes for one training step under
+    `strategy` (the reference's logical_traffic_demand, simulator.h:603):
+    weight-sync allreduces bill their sync axes, parallel-op collectives
+    bill their declared axes, reshard edges bill every axis whose degree
+    changes across the edge. A pure observability/product of the cost
+    model — useful for choosing the axis_map."""
+    from flexflow_tpu.ffconst import OpType, PARALLEL_OP_TYPES
+    from flexflow_tpu.search.cost_model import _in_shapes, spec_degree
+
+    out: Dict[str, float] = {}
+
+    def bill(axes, nbytes):
+        for a in axes:
+            out[a] = out.get(a, 0.0) + nbytes
+
+    for node in graph.topo_order():
+        view = strategy.get(node.name, node.sharding)
+        ins = _in_shapes(graph, node)
+        if node.op_type in (OpType.REDUCTION, OpType.COMBINE,
+                            OpType.ALL_TO_ALL) and ins:
+            axes = getattr(node.attrs, "axes", ()) or ("model",)
+            bill(axes, ins[0].global_bytes())
+            continue
+        if node.op_type in PARALLEL_OP_TYPES or node.attrs is None:
+            continue
+        ws = node.attrs.weights(*ins)
+        for name, decl in ws.items():
+            if not decl.trainable:
+                continue
+            used = set()
+            wspec = view.weight_specs.get(name) if view is not None else None
+            shard = 1
+            if wspec:
+                shard = spec_degree(wspec, cost.axis_sizes)
+                for axes in wspec:
+                    used.update(axes)
+            sync_axes = [a for a, s in cost.axis_sizes.items()
+                         if a not in used and s > 1]
+            if sync_axes:
+                bill(sync_axes, 2 * decl.shape.size_bytes() / shard)
+        for e in graph.out_edges(node):
+            dst = graph.node(e.dst)
+            dst_view = strategy.get(dst.name, dst.sharding)
+            src_spec = view.output_spec(e.src_idx) if view else None
+            dst_spec = None
+            if dst_view is not None:
+                dst_spec = dst_view.input_spec(e.dst_idx)
+                if dst_spec is None:
+                    dst_spec = dst_view.output_spec(0)
+            shape = node.outputs[e.src_idx]
+            ndim = len(shape.dims)
+
+            def axes_at(spec, i):
+                if spec is None or i >= len(spec):
+                    return ()
+                return tuple(spec[i])
+
+            src_deg = spec_degree(src_spec, cost.axis_sizes)
+            if src_deg <= 1:
+                # partitioning replicated data is a local slice — no bytes
+                # move (matches CostModel.edge_xfer_time)
+                continue
+            changed = set()
+            for i in range(ndim):
+                sa, da = axes_at(src_spec, i), axes_at(dst_spec, i)
+                if sa != da:
+                    changed.update(sa)
+                    changed.update(da)
+            if changed:
+                bill(changed, shape.global_bytes())
+    return out
