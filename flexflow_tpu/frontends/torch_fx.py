@@ -35,6 +35,33 @@ def _flatten_dims(ff: FFModel, x: Tensor, start: int, end: int,
     return ff.reshape(x, shape, name=name)
 
 
+def _rms_norm_class_name(mod) -> bool:
+    cls = type(mod).__name__
+    return cls.endswith("RMSNorm") or cls == "T5LayerNorm"
+
+
+def _is_rms_norm_module(mod) -> bool:
+    """RMSNorm-family detection by class name + shape of the module: a
+    single 1-D `weight` parameter and a variance epsilon. Covers
+    transformers' T5LayerNorm / LlamaRMSNorm / MistralRMSNorm / GemmaRMSNorm
+    and torch.nn.RMSNorm without importing any of them. Reads _parameters
+    directly — during fx tracing, attribute access on a module is patched
+    to return Proxies, and Proxy.__bool__ raises."""
+    if not _rms_norm_class_name(mod):
+        return False
+    params = getattr(mod, "_parameters", {})
+    w = params.get("weight")
+    return w is not None and getattr(w, "ndim", 0) == 1
+
+
+def _rms_eps(mod) -> float:
+    for attr in ("variance_epsilon", "eps"):
+        v = getattr(mod, attr, None)
+        if v is not None:  # 0.0 is a legitimate explicit eps
+            return float(v)
+    return 1e-6
+
+
 def _act(ff: FFModel, t: Tensor, mod) -> Tensor:
     import torch.nn as nn
 
@@ -56,8 +83,21 @@ class PyTorchModel:
     def __init__(self, model, seq_length: Optional[int] = None):
         import torch.fx
 
+        class _HFAwareTracer(torch.fx.Tracer):
+            """HF-aware coalescing (reference torch/model.py:2408-2495
+            special-cases T5LayerNorm / mt5): RMSNorm-family modules are
+            kept as LEAF nodes so they lower to one RMS_NORM op instead of
+            an exploded mean/rsqrt/mul subgraph whose weights can't be
+            mapped back."""
+
+            def is_leaf_module(self, m, qualname):
+                if _is_rms_norm_module(m):
+                    return True
+                return super().is_leaf_module(m, qualname)
+
         self.model = model
-        self.traced = torch.fx.symbolic_trace(model)
+        graph = _HFAwareTracer().trace(model)
+        self.traced = torch.fx.GraphModule(model, graph)
         # module path -> ALL ff node names it lowered to (a module called at
         # several sites becomes several FF layers; copy_weights fills each).
         # Note: the copies are not tied for training — updates diverge.
@@ -178,6 +218,9 @@ class PyTorchModel:
             return ff.identity(x, name=name)
         if isinstance(mod, (nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh, nn.SiLU, nn.ELU)):
             return _act(ff, x, mod)
+        if _is_rms_norm_module(mod):
+            return self._record(node.target,
+                                ff.rms_norm(x, eps=_rms_eps(mod), name=name))
         if isinstance(mod, nn.Sequential):
             t = x
             for child_name, sub in mod.named_children():
@@ -323,6 +366,13 @@ class PyTorchModel:
                                   "running_mean")
                     ff.set_weight(ff_name, mod.running_var.detach().numpy(),
                                   "running_var")
+                elif _is_rms_norm_module(mod):
+                    w = mod.weight.detach().numpy()
+                    # Gemma's RMSNorm scales by (1 + weight); our RMS_NORM
+                    # scales by the stored weight, so fold the +1 in
+                    if type(mod).__name__.startswith("Gemma"):
+                        w = w + 1.0
+                    ff.set_weight(ff_name, w, "scale")
 
     # ------------------------------------------------------------------
     # text IR (reference torch_to_file/file_to_ff, torch/model.py:2597,2540)
@@ -389,6 +439,8 @@ def _module_spec(mod) -> str:
         return f"Embedding:{mod.num_embeddings}:{mod.embedding_dim}"
     if isinstance(mod, nn.BatchNorm2d):
         return "BatchNorm2d"
+    if _is_rms_norm_module(mod):
+        return f"RMSNorm:{_rms_eps(mod)}"
     raise NotImplementedError(f"no text-IR spec for {type(mod).__name__}")
 
 
@@ -466,4 +518,6 @@ def _apply_spec(ff: FFModel, spec: str, x: Tensor, name: str) -> Tensor:
         return ff.embedding(x, int(parts[1]), int(parts[2]), name=name)
     if kind == "BatchNorm2d":
         return ff.batch_norm(x, relu=False, name=name)
+    if kind == "RMSNorm":
+        return ff.rms_norm(x, eps=float(parts[1]), name=name)
     raise NotImplementedError(f"text-IR spec {kind}")

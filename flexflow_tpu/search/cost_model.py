@@ -154,9 +154,14 @@ class CostModel:
             nbytes = ins[0].global_bytes()
             for kind, _dim, axes in node.attrs.steps:
                 # same degrees AND axis names as the unfused node branches
-                # above (axes or "model" default; combine/all_to_all floored
-                # at 2), so fusing never changes a step's priced cost
-                axes = tuple(axes or ("model",))
+                # above: reduction/combine default to ("model",) like the
+                # REDUCTION/COMBINE branches; all_to_all keeps its raw axes
+                # like the ALL_TO_ALL branch — so fusing never changes a
+                # step's priced cost
+                if kind == "all_to_all":
+                    axes = tuple(axes or ())
+                else:
+                    axes = tuple(axes or ("model",))
                 deg = axes_degree(axes)
                 if kind == "reduction":
                     t = self.machine.all_reduce_time(nbytes, deg, axes=axes)

@@ -276,3 +276,167 @@ def test_keras_model_checkpoint(tmp_path):
     model.fit(x, y, epochs=2, verbose=False, callbacks=[ck])
     import os
     assert os.path.exists(str(tmp_path / "ck_1"))
+
+
+def test_torch_fx_hf_rmsnorm_coalescing():
+    """HF-aware coalescing (reference torch/model.py:2408-2495): a
+    transformers T5LayerNorm traces as ONE RMS_NORM op (not an exploded
+    mean/rsqrt subgraph), its weight copies over, and numerics match
+    torch."""
+    torch = pytest.importorskip("torch")
+    from transformers.models.t5.modeling_t5 import T5LayerNorm
+
+    import torch.nn as nn
+
+    class Tiny(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 32, bias=False)
+            self.norm = T5LayerNorm(32, eps=1e-6)
+            self.head = nn.Linear(32, 4, bias=False)
+
+        def forward(self, x):
+            return self.head(self.norm(self.fc(x)))
+
+    tm = Tiny().eval()
+    with torch.no_grad():
+        tm.norm.weight.mul_(1.7)  # non-trivial scale to catch copy bugs
+
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel
+    from flexflow_tpu.ffconst import OpType
+
+    pm = PyTorchModel(tm)
+    ff = FFModel(FFConfig(batch_size=8))
+    xin = ff.create_tensor((8, 16), DataType.FLOAT, name="input")
+    (out,) = pm.torch_to_ff(ff, [xin])
+    rms_nodes = [n for n in ff.graph.nodes if n.op_type == OpType.RMS_NORM]
+    assert len(rms_nodes) == 1  # coalesced, not exploded
+    ff.compile(loss_type=LossType.IDENTITY)
+    pm.copy_weights(ff)
+
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x)).numpy()
+    got = ff.predict(x)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_torch_fx_rmsnorm_text_ir_roundtrip(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers.models.t5.modeling_t5 import T5LayerNorm
+
+    import torch.nn as nn
+
+    class Tiny(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8, bias=False)
+            self.norm = T5LayerNorm(8)
+
+        def forward(self, x):
+            return self.norm(self.fc(x))
+
+    from flexflow_tpu.frontends.torch_fx import PyTorchModel, file_to_ff
+    from flexflow_tpu.ffconst import OpType
+
+    pm = PyTorchModel(Tiny().eval())
+    p = tmp_path / "m.ff"
+    pm.torch_to_file(str(p))
+    ff = FFModel(FFConfig(batch_size=4))
+    xin = ff.create_tensor((4, 8), DataType.FLOAT, name="input")
+    file_to_ff(str(p), ff, [xin])
+    assert [n for n in ff.graph.nodes if n.op_type == OpType.RMS_NORM]
+
+
+def test_keras_exp_functional_import_and_weights():
+    """keras_exp analog (reference keras_exp/models/model.py): walk a REAL
+    tf.keras functional graph (branches + Add) and match its predictions
+    after weight copy."""
+    tf = pytest.importorskip("tensorflow")
+    from tensorflow import keras
+
+    from flexflow_tpu.frontends.keras_exp import KerasExpModel
+
+    inp = keras.Input((16,), name="in0")
+    a = keras.layers.Dense(32, activation="relu", name="d0")(inp)
+    b = keras.layers.Dense(32, name="d1")(inp)
+    z = keras.layers.Add(name="add")([a, b])
+    z = keras.layers.LayerNormalization(name="ln")(z)
+    out = keras.layers.Dense(4, activation="softmax", name="head")(z)
+    km = keras.Model(inp, out)
+
+    ke = KerasExpModel(km)
+    ff = FFModel(FFConfig(batch_size=8))
+    xin = ff.create_tensor((8, 16), DataType.FLOAT, name="input")
+    (o,) = ke.to_ff(ff, [xin])
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    ke.copy_weights(ff)
+
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    ref = km.predict(x, verbose=0)
+    got = ff.predict(x)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_exp_json_only_no_tf():
+    """The walker consumes a bare to_json() config — no tensorflow objects
+    involved (the zero-egress import path)."""
+    import json as _json
+
+    from flexflow_tpu.frontends.keras_exp import KerasExpModel
+    from flexflow_tpu.ffconst import OpType
+
+    cfg = {
+        "class_name": "Functional",
+        "config": {
+            "layers": [
+                {"class_name": "InputLayer", "name": "in0",
+                 "config": {"name": "in0"}, "inbound_nodes": []},
+                {"class_name": "Dense", "name": "fc",
+                 "config": {"name": "fc", "units": 8, "activation": "relu"},
+                 "inbound_nodes": [{"args": [{
+                     "class_name": "__keras_tensor__",
+                     "config": {"keras_history": ["in0", 0, 0]}}]}]},
+                {"class_name": "Dense", "name": "out",
+                 "config": {"name": "out", "units": 2,
+                            "activation": "softmax"},
+                 "inbound_nodes": [{"args": [{
+                     "class_name": "__keras_tensor__",
+                     "config": {"keras_history": ["fc", 0, 0]}}]}]},
+            ],
+            "input_layers": ["in0", 0, 0],
+            "output_layers": ["out", 0, 0],
+        },
+    }
+    ke = KerasExpModel(json_config=_json.dumps(cfg))
+    ff = FFModel(FFConfig(batch_size=4))
+    xin = ff.create_tensor((4, 16), DataType.FLOAT, name="input")
+    (o,) = ke.to_ff(ff, [xin])
+    assert len([n for n in ff.graph.nodes if n.op_type == OpType.LINEAR]) == 2
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    p = ff.predict(np.zeros((4, 16), np.float32))
+    assert p.shape == (4, 2)
+
+
+def test_keras_exp_sequential_without_input_layer():
+    """Keras 3 Sequentials often serialize with no InputLayer — the first
+    real layer must still be lowered (not aliased to the input)."""
+    tf = pytest.importorskip("tensorflow")
+    from tensorflow import keras
+
+    from flexflow_tpu.frontends.keras_exp import KerasExpModel
+    from flexflow_tpu.ffconst import OpType
+
+    km = keras.Sequential([keras.layers.Dense(8, activation="relu"),
+                           keras.layers.Dense(2)])
+    km.build((None, 16))
+    ke = KerasExpModel(km)
+    ff = FFModel(FFConfig(batch_size=4))
+    xin = ff.create_tensor((4, 16), DataType.FLOAT, name="input")
+    (o,) = ke.to_ff(ff, [xin])
+    assert len([n for n in ff.graph.nodes if n.op_type == OpType.LINEAR]) == 2
+    ff.compile(loss_type=LossType.IDENTITY)
+    ke.copy_weights(ff)
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+    np.testing.assert_allclose(ff.predict(x), km.predict(x, verbose=0),
+                               rtol=1e-4, atol=1e-5)
