@@ -59,6 +59,10 @@ class FFConfig:
     # simulator.cc:537); cache file avoids re-measuring across runs
     measure_costs: bool = False
     measure_cache_file: Optional[str] = None
+    # cost strategies with the native event-driven task-graph simulator
+    # (ffsim_simulate — Simulator::simulate_runtime analog) instead of the
+    # summed-table estimate; MCMC path only, needs libffsim
+    use_simulator: bool = False
     import_strategy_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
     export_strategy_computation_graph_file: Optional[str] = None
@@ -72,6 +76,14 @@ class FFConfig:
 
     # ---- execution ----
     profiling: bool = False
+    # capture a jax profiler trace of fit() into this dir (view with
+    # tensorboard / xprof — the -lg:prof analog, SURVEY.md §5.1)
+    profiler_trace_dir: Optional[str] = None
+    # jax transfer guard level during fit ("log" | "disallow"): surfaces
+    # accidental host<->device transfers in the step loop (the
+    # race-detection analog, SURVEY.md §5.2 — purity is by construction,
+    # transfers are the remaining foot-gun)
+    transfer_guard: Optional[str] = None
     # rematerialization: "attention" wraps attention ops in jax.checkpoint so
     # S×S probs are recomputed in backward instead of saved (HBM for FLOPs —
     # net-new vs the reference, which has no remat); "none" disables
@@ -149,6 +161,12 @@ class FFConfig:
                 # the reference sets parameter-parallel here too (noted as an
                 # upstream bug in SURVEY.md §2.3); we keep them independent
                 cfg.enable_attribute_parallel = True
+            elif a == "--simulator":
+                cfg.use_simulator = True
+            elif a == "--profiler-trace":
+                cfg.profiler_trace_dir = take()
+            elif a == "--transfer-guard":
+                cfg.transfer_guard = take()
             elif a == "--memory-search":
                 cfg.memory_search = True
             elif a == "--search-num-devices":

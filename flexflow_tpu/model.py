@@ -697,6 +697,27 @@ class FFModel:
         a Legion trace — here one jitted step call). Either pass numpy
         arrays (x, y) or `dataloaders` = [input loaders..., label loader]
         built via create_data_loader (prefetched host->device)."""
+        import contextlib
+
+        import jax
+
+        with contextlib.ExitStack() as stack:
+            if self.config.profiler_trace_dir:
+                # jax profiler capture of the whole fit (xprof/tensorboard
+                # viewable — the reference relies on Legion's -lg:prof)
+                stack.enter_context(
+                    jax.profiler.trace(self.config.profiler_trace_dir)
+                )
+            if self.config.transfer_guard:
+                # surface accidental host<->device transfers in the loop
+                stack.enter_context(
+                    jax.transfer_guard(self.config.transfer_guard)
+                )
+            return self._fit_impl(x, y, epochs, batch_size, verbose,
+                                  dataloaders, recompile_state)
+
+    def _fit_impl(self, x, y, epochs, batch_size, verbose, dataloaders,
+                  recompile_state):
         import jax
 
         from flexflow_tpu.runtime.dataloader import PrefetchLoader
@@ -710,7 +731,8 @@ class FFModel:
         # fold the fit-call counter in so repeated fit() calls (e.g. the
         # keras per-epoch loop) draw FRESH dropout/rng streams instead of
         # replaying the first call's masks
-        rng = jax.random.key(self._rng_seed + 1 + self._fit_calls)
+        with jax.transfer_guard("allow"):  # seed upload is deliberate
+            rng = jax.random.key(self._rng_seed + 1 + self._fit_calls)
         self._fit_calls += 1
         for epoch in range(epochs):
             self.current_metrics = PerfMetrics()
@@ -738,16 +760,20 @@ class FFModel:
                 self._step_count += 1
                 bsz = by.shape[0]
                 n_samples += bsz
-                scaled = {
-                    k: (v if k == "accuracy_correct" else v * bsz)
-                    for k, v in m.items()
-                    if k != "loss"
-                }
-                dev_sums = (
-                    scaled
-                    if dev_sums is None
-                    else jax.tree.map(lambda a, b: a + b, dev_sums, scaled)
-                )
+                # scaling by the python batch-size constant implicitly
+                # uploads a scalar — deliberate, so exempt from a
+                # configured transfer guard (which hunts DATA transfers)
+                with jax.transfer_guard("allow"):
+                    scaled = {
+                        k: (v if k == "accuracy_correct" else v * bsz)
+                        for k, v in m.items()
+                        if k != "loss"
+                    }
+                    dev_sums = (
+                        scaled
+                        if dev_sums is None
+                        else jax.tree.map(lambda a, b: a + b, dev_sums, scaled)
+                    )
                 if recompile_state is not None:
                     # reference recompile_on_condition (model.cc:2422)
                     from flexflow_tpu.runtime.recompile import (
@@ -773,7 +799,11 @@ class FFModel:
                     periodic_save(self.config.checkpoint_dir, self)
             self.current_metrics.train_all = n_samples
             if dev_sums is not None:
-                host = {k: float(v) for k, v in dev_sums.items()}  # one sync
+                # the ONE deliberate device->host sync per epoch — exempt
+                # from a configured transfer guard (which exists to catch
+                # transfers inside the step loop, not this one)
+                with jax.transfer_guard("allow"):
+                    host = {k: float(v) for k, v in dev_sums.items()}
                 self.current_metrics.train_correct = int(
                     round(host.get("accuracy_correct", 0.0))
                 )

@@ -95,6 +95,14 @@ def graph_optimize(graph: Graph, mesh, config) -> Tuple[Graph, Dict[str, Shardin
 
     cost = _cost_model(mesh, config)
     _maybe_measure(cost, graph, config)
+    if getattr(config, "use_simulator", False):
+        import warnings
+
+        warnings.warn(
+            "use_simulator only applies to the MCMC path (search_budget "
+            "<= 5); the Unity substitution search costs strategies with "
+            "the summed tables"
+        )
     if config.memory_search:
         # memory-aware path: λ binary search blending run time and per-chip
         # memory (graph.cc:2046-2131 analog)

@@ -64,7 +64,11 @@ def mcmc_optimize(
         if verbose:
             print(f"mcmc (native): best {best_cost * 1e3:.3f} ms")
         strategy = table.to_strategy(best_assign)
-        if polish:
+        # polish hill-climbs the summed-table objective; under use_simulate
+        # the anneal optimized the event-driven SIMULATED cost, and a flip
+        # that improves the sum can lengthen the simulated critical path —
+        # so the simulator's answer is returned unpolished
+        if polish and not use_simulate:
             from flexflow_tpu.search.dp import greedy_polish
 
             strategy, polished_cost = greedy_polish(
@@ -136,4 +140,5 @@ def mcmc_search(graph: Graph, mesh, config, cost=None) -> Dict[str, ShardingView
         alpha=config.search_alpha - 1.0 if config.search_alpha > 1 else 0.05,
         memory_limit=machine.memory_per_chip() if config.memory_search else None,
         verbose=config.profiling,
+        use_simulate=getattr(config, "use_simulator", False),
     )

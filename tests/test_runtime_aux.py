@@ -403,3 +403,43 @@ def test_orbax_checkpoint_sharded_roundtrip(tmp_path):
     restore_checkpoint(str(tmp_path / "ck"), ff2)
     np.testing.assert_allclose(ff1.predict(x), ff2.predict(x), rtol=1e-5,
                                atol=1e-6)
+
+
+def test_fit_with_transfer_guard_and_profiler(tmp_path):
+    """SURVEY §5.1/§5.2 hooks: a profiler trace is captured around fit()
+    and a 'disallow' transfer guard passes (no accidental implicit
+    transfers inside the step loop; the epoch-end metric sync is exempt)."""
+    x, y = data()
+    ff1 = FFModel(FFConfig(batch_size=16, transfer_guard="disallow",
+                           profiler_trace_dir=str(tmp_path / "trace")))
+    xi = ff1.create_tensor((16, 10), DataType.FLOAT, name="input")
+    t = ff1.dense(xi, 32, ActiMode.RELU, name="d0")
+    ff1.softmax(ff1.dense(t, 4, name="d1"), name="softmax")
+    ff1.compile(optimizer=AdamOptimizer(lr=0.01),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics=[MetricsType.ACCURACY])
+    m = ff1.fit(x, y, epochs=2, verbose=False)
+    assert m.train_all == 64
+    import os
+    found = []
+    for root, _, files in os.walk(tmp_path / "trace"):
+        found += files
+    assert found, "profiler trace files must exist"
+
+
+def test_mcmc_with_native_simulator_flag():
+    """--simulator: strategy costing through the native event-driven
+    task-graph scheduler (ffsim_simulate) instead of the summed tables."""
+    from flexflow_tpu import native
+
+    if not native.available():
+        pytest.skip("libffsim not built")
+    ff = FFModel(FFConfig(batch_size=8, num_devices=8,
+                          mesh_shape={"data": 2, "model": 4},
+                          search_budget=2, use_simulator=True))
+    xi = ff.create_tensor((8, 256), DataType.FLOAT, name="input")
+    t = ff.dense(xi, 512, name="d0")
+    ff.softmax(ff.dense(t, 4, name="d1"), name="softmax")
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    p = ff.predict(np.zeros((8, 256), np.float32))
+    assert p.shape == (8, 4)
