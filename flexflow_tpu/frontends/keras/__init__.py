@@ -35,6 +35,6 @@ __all__ = [
     "Activation",
 ]
 
-from flexflow_tpu.frontends.keras import callbacks, datasets  # noqa: E402
+from flexflow_tpu.frontends.keras import callbacks, datasets, optimizers  # noqa: E402
 
-__all__ += ["callbacks", "datasets"]
+__all__ += ["callbacks", "datasets", "optimizers"]

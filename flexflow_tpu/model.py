@@ -775,7 +775,9 @@ class FFModel:
                         else jax.tree.map(lambda a, b: a + b, dev_sums, scaled)
                     )
                 if recompile_state is not None:
-                    # reference recompile_on_condition (model.cc:2422)
+                    # reference recompile_on_condition (model.cc:2422);
+                    # trigger functions read device metrics — a deliberate
+                    # sync, exempt from a configured transfer guard
                     from flexflow_tpu.runtime.recompile import (
                         recompile_on_condition,
                     )
@@ -783,7 +785,11 @@ class FFModel:
                     recompile_state.last_metrics = m
                     self._params = (tr, ntr)
                     self._opt_state = opt_state
-                    if recompile_on_condition(self, recompile_state):
+                    with jax.transfer_guard("allow"):
+                        recompiled = recompile_on_condition(
+                            self, recompile_state
+                        )
+                    if recompiled:
                         step = self.executor.train_step()
                         tr, ntr = self._params
                         opt_state = self._opt_state
@@ -796,7 +802,9 @@ class FFModel:
 
                     self._params = (tr, ntr)
                     self._opt_state = opt_state
-                    periodic_save(self.config.checkpoint_dir, self)
+                    # checkpoint writes gather state to host by design
+                    with jax.transfer_guard("allow"):
+                        periodic_save(self.config.checkpoint_dir, self)
             self.current_metrics.train_all = n_samples
             if dev_sums is not None:
                 # the ONE deliberate device->host sync per epoch — exempt

@@ -105,3 +105,27 @@ def build_moe_classifier(ff: FFModel, input_dim: int, num_classes: int,
                name="moe")
     t = ff.dense(t, num_classes, name="head")
     return ff.softmax(t, name="softmax")
+
+
+def build_moe_spec_classifier(ff: FFModel, input_dim: int, num_classes: int,
+                              num_exp: int = 4, num_select: int = 2,
+                              hidden: int = 64,
+                              batch_size: int = None) -> Tensor:
+    """Speculative MoE head (reference AggregateSpec, aggregate_spec.cc):
+    every selected expert's output becomes its OWN row — (b·k, classes)
+    logits — and the loss sees each label k times (the reference's
+    repl_labels path, model.cc:2875, wired in the executor)."""
+    b = batch_size or ff.config.batch_size
+    x = ff.create_tensor((b, input_dim), DataType.FLOAT, name="input")
+    gate_preds = ff.dense(x, num_exp, name="spec_gate")
+    gate_sm = ff.softmax(gate_preds, name="spec_gate_sm")
+    topk_values, topk_assign = ff.top_k(gate_sm, num_select)
+    grouped = ff.group_by(x, topk_assign, num_exp, 2.0)
+    expert_outs = []
+    for i, g in enumerate(grouped):
+        h = ff.dense(g, hidden, ActiMode.RELU, name=f"spec_expert{i}")
+        expert_outs.append(h)
+    agg_inputs = [topk_values, topk_assign, topk_assign, gate_sm] + expert_outs
+    t = ff.aggregate_spec(agg_inputs, num_exp, name="agg_spec")
+    t = ff.dense(t, num_classes, name="spec_head")
+    return ff.softmax(t, name="softmax")

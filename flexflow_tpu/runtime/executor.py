@@ -92,6 +92,19 @@ class Executor:
             LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
             LossType.CATEGORICAL_CROSSENTROPY,
         )
+        # AggregateSpec (speculative MoE) emits one row per (sample, k)
+        # slot, so the loss must see each label k times — the reference's
+        # repl_labels (model.cc:2875). Detected from the batch-dim ratio
+        # sink/input when an AGGREGATE_SPEC node is in the graph.
+        self.label_repeats = 1
+        if any(n.op_type == OpType.AGGREGATE_SPEC for n in self.topo):
+            try:
+                in_b = self.input_nodes[0].outputs[0].dims[0].size
+                out_b = self.sink.outputs[0].dims[0].size
+                if in_b > 0 and out_b % in_b == 0 and out_b // in_b > 1:
+                    self.label_repeats = out_b // in_b
+            except (IndexError, AttributeError):
+                pass
         self._train_step = None
         self._eval_step = None
         self._forward = None
@@ -329,6 +342,22 @@ class Executor:
     # ------------------------------------------------------------------
     # compiled steps
 
+    def _maybe_repeat_labels(self, labels):
+        """AggregateSpec repl_labels (model.cc:2875): k logit rows per
+        sample need each label k times."""
+        if self.label_repeats > 1:
+            return jnp.repeat(labels, self.label_repeats, axis=0)
+        return labels
+
+    def _rescale_correct(self, step_metrics):
+        """Slot-average the correct count so it stays on the per-SAMPLE
+        scale fit()/eval() sum."""
+        if self.label_repeats > 1 and "accuracy_correct" in step_metrics:
+            step_metrics["accuracy_correct"] = (
+                step_metrics["accuracy_correct"] / self.label_repeats
+            )
+        return step_metrics
+
     @staticmethod
     def _merge_state(nontrainable, updates):
         if not updates:
@@ -347,6 +376,8 @@ class Executor:
         sink_is_sm = self.last_op_is_softmax and not fused
 
         def step(trainable, nontrainable, opt_state, rng, labels, *inputs):
+            labels = self._maybe_repeat_labels(labels)
+
             def loss_fn(tr):
                 logits, updates, aux = self.run_forward(
                     tr, nontrainable, inputs, training=True, rng=rng,
@@ -366,9 +397,9 @@ class Executor:
                     jax.lax.with_sharding_constraint, new_opt, opt_sh
                 )
             new_ntr = self._merge_state(nontrainable, updates)
-            step_metrics = compute_step_metrics(
+            step_metrics = self._rescale_correct(compute_step_metrics(
                 self.metrics, self.loss_type, logits, labels, sink_is_sm
-            )
+            ))
             step_metrics["loss"] = loss
             return new_tr, new_ntr, new_opt, step_metrics
 
@@ -384,14 +415,15 @@ class Executor:
         sink_is_sm = self.last_op_is_softmax and not fused
 
         def step(trainable, nontrainable, labels, *inputs):
+            labels = self._maybe_repeat_labels(labels)
             logits, _, _ = self.run_forward(
                 trainable, nontrainable, inputs, training=False,
                 rng=jax.random.key(0), skip_sink_softmax=fused,
             )
             loss = compute_loss(self.loss_type, logits, labels, sink_is_sm)
-            m = compute_step_metrics(
+            m = self._rescale_correct(compute_step_metrics(
                 self.metrics, self.loss_type, logits, labels, sink_is_sm
-            )
+            ))
             m["loss"] = loss
             return m
 

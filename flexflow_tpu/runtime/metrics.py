@@ -34,7 +34,10 @@ class PerfMetrics:
     def update(self, step_metrics: Dict[str, float], batch: int):
         self.train_all += batch
         if "accuracy_correct" in step_metrics:
-            self.train_correct += int(step_metrics["accuracy_correct"])
+            # round, don't truncate: AggregateSpec's slot-averaged counts
+            # are fractional (correct/(k slots)) and int() would bias the
+            # reported accuracy low by up to 1/k sample per batch
+            self.train_correct += round(float(step_metrics["accuracy_correct"]))
         for k in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
             if k in step_metrics:
                 setattr(self, k, getattr(self, k) + float(step_metrics[k]) * batch)

@@ -440,3 +440,26 @@ def test_keras_exp_sequential_without_input_layer():
     x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
     np.testing.assert_allclose(ff.predict(x), km.predict(x, verbose=0),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_keras_optimizers_module():
+    """reference flexflow.keras.optimizers analog: keras spellings map to
+    the runtime optimizers and train through Model.compile."""
+    from flexflow_tpu.frontends import keras as K
+
+    adam = K.optimizers.Adam(learning_rate=0.01, beta_1=0.8)
+    assert adam.lr == 0.01 and adam.beta1 == 0.8
+    sgd = K.optimizers.SGD(learning_rate=0.1, momentum=0.9, nesterov=True)
+    assert sgd.momentum == 0.9 and sgd.nesterov
+
+    m = K.Sequential(config=FFConfig(batch_size=16))
+    m.add_input((8,))
+    m.add(K.Dense(16, activation="relu"))
+    m.add(K.Dense(3, activation="softmax"))
+    m.compile(optimizer=adam, loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 8).astype(np.float32)
+    y = rs.randint(0, 3, 64).astype(np.int32)
+    h = m.fit(x, y, epochs=2, verbose=0)
+    assert h.history["loss"][-1] <= h.history["loss"][0]

@@ -302,3 +302,29 @@ def test_xdl_trains():
     m = ff.fit(sparse + [dense], y, epochs=2, verbose=False)
     assert m.train_all == 32
     assert np.isfinite(m.mse_loss)
+
+
+def test_moe_spec_classifier_repl_labels():
+    """AggregateSpec speculative head: (b*k) logits train against k-times
+    replicated labels (the reference repl_labels path, model.cc:2875) and
+    accuracy stays on the per-sample scale."""
+    from flexflow_tpu.models.mixtral import build_moe_spec_classifier
+
+    ff = FFModel(FFConfig(batch_size=16))
+    build_moe_spec_classifier(ff, input_dim=10, num_classes=4,
+                              num_select=2)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    assert ff.executor.label_repeats == 2
+    rs = np.random.RandomState(0)
+    centers = rs.randn(4, 10) * 3
+    y = rs.randint(0, 4, 128)
+    x = (centers[y] + rs.randn(128, 10)).astype(np.float32)
+    ff.fit(x, y.astype(np.int32), epochs=6, verbose=False)
+    m = ff.eval(x, y.astype(np.int32), verbose=False)
+    acc = m.train_correct / m.train_all
+    assert 0.0 <= acc <= 1.0
+    assert acc > 0.6  # the speculative head still learns the clusters
