@@ -680,10 +680,21 @@ class FFModel:
             out.append(jax.device_put(a, sh) if sh is not None else jax.device_put(a))
         return out
 
-    def create_data_loader(self, tensor: Tensor, full_array: np.ndarray,
+    def create_data_loader(self, tensor: Tensor, full_array,
                            batch_size: Optional[int] = None,
                            shuffle: bool = False, seed: int = 0):
-        """Reference SingleDataLoader analog (flexflow_cffi.py:2433)."""
+        """Reference SingleDataLoader analog (flexflow_cffi.py:2433).
+        Pass a numpy array for the in-memory loader, or a .npy file PATH
+        for the native mmap + background-gather loader (the reference's
+        C++ dataloader analog, native/ffloader.cc)."""
+        import os
+
+        if isinstance(full_array, (str, os.PathLike)):
+            from flexflow_tpu.runtime.dataloader import FileDataLoader
+
+            return FileDataLoader(self, tensor, os.fspath(full_array),
+                                  batch_size=batch_size, shuffle=shuffle,
+                                  seed=seed)
         from flexflow_tpu.runtime.dataloader import SingleDataLoader
 
         return SingleDataLoader(self, tensor, full_array, batch_size=batch_size,

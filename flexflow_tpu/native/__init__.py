@@ -23,21 +23,22 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
-    src = os.path.abspath(_SRC)
+def _build_so(src: str, lib_path: str, extra_flags=()) -> bool:
+    """Compile `src` to `lib_path` if stale; atomic tmp+replace so a
+    concurrent process never dlopens a partially written .so."""
+    src = os.path.abspath(src)
     if not os.path.exists(src):
         return False
-    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src):
+    if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(src):
         return True
-    # compile to a temp path and atomically swap in, so a concurrent
-    # process never dlopens a partially written .so
-    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-o", tmp, src],
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", *extra_flags,
+             "-o", tmp, src],
             check=True, capture_output=True, timeout=120,
         )
-        os.replace(tmp, _LIB_PATH)
+        os.replace(tmp, lib_path)
         return True
     except (OSError, subprocess.SubprocessError):
         return False
@@ -47,6 +48,10 @@ def _build() -> bool:
                 os.remove(tmp)
             except OSError:
                 pass
+
+
+def _build() -> bool:
+    return _build_so(_SRC, _LIB_PATH)
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -145,3 +150,46 @@ class NativeSimGraph:
             1 if use_simulate else 0, arr, ctypes.byref(best_cost),
         )
         return list(arr), best_cost.value, accepted
+
+
+# ---------------------------------------------------------------------------
+# native data loader (native/ffloader.cc — flexflow_dataloader.cc analog)
+
+_LOADER_SRC = os.path.join(_PKG_DIR, "..", "..", "native", "ffloader.cc")
+_LOADER_LIB_PATH = os.path.join(_PKG_DIR, "libffloader.so")
+_loader_lib: Optional[ctypes.CDLL] = None
+_loader_tried = False
+
+
+def _build_loader() -> bool:
+    return _build_so(_LOADER_SRC, _LOADER_LIB_PATH, extra_flags=("-pthread",))
+
+
+def get_loader_lib() -> Optional[ctypes.CDLL]:
+    global _loader_lib, _loader_tried
+    if _loader_lib is not None or _loader_tried:
+        return _loader_lib
+    _loader_tried = True
+    if os.environ.get("FLEXFLOW_NATIVE", "1") == "0":
+        return None
+    if _build_loader():
+        try:
+            lib = ctypes.CDLL(_LOADER_LIB_PATH)
+            lib.ffl_open.restype = ctypes.c_void_p
+            lib.ffl_open.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                     ctypes.c_long, ctypes.c_long]
+            lib.ffl_config.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_int, ctypes.c_long]
+            lib.ffl_reset.argtypes = [ctypes.c_void_p]
+            lib.ffl_next.restype = ctypes.c_int
+            lib.ffl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_long]
+            lib.ffl_close.argtypes = [ctypes.c_void_p]
+            _loader_lib = lib
+        except OSError:
+            _loader_lib = None
+    return _loader_lib
+
+
+def loader_available() -> bool:
+    return get_loader_lib() is not None

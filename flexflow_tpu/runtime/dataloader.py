@@ -83,3 +83,89 @@ class PrefetchLoader:
 
     def __len__(self) -> int:
         return min(ld.num_batches for ld in self.loaders)
+
+
+class FileDataLoader:
+    """Memory-mapped .npy dataset with a NATIVE background gather thread
+    (native/ffloader.cc) — the analog of the reference's C++
+    SingleDataLoader (flexflow_dataloader.cc:24-232: zero-copy staging +
+    per-iteration index-launch copies). The mmap'd page cache is the
+    staging buffer; a C++ worker gathers shuffled rows into a ring of
+    contiguous batch buffers OFF the GIL while the train step runs.
+    Exposes the SingleDataLoader surface so PrefetchLoader composes."""
+
+    def __init__(self, ffmodel, input_tensor, path: str,
+                 batch_size: Optional[int] = None, shuffle: bool = False,
+                 seed: int = 0):
+        from flexflow_tpu import native
+
+        lib = native.get_loader_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native ffloader unavailable (no compiler?) — use "
+                "SingleDataLoader with an in-memory array instead"
+            )
+        self._lib = lib
+        # parse the npy header in Python (public per-version readers);
+        # C side gets (offset, sample_bytes)
+        with open(path, "rb") as f:
+            version = np.lib.format.read_magic(f)
+            reader = (np.lib.format.read_array_header_1_0 if version == (1, 0)
+                      else np.lib.format.read_array_header_2_0)
+            shape, fortran, dtype = reader(f)
+            offset = f.tell()
+        if fortran:
+            raise ValueError("fortran-order npy files are not supported")
+        self.dtype = dtype
+        self.sample_shape = tuple(shape[1:])
+        self._n = int(shape[0])
+        sample_bytes = int(dtype.itemsize * np.prod(self.sample_shape or (1,)))
+        self._h = lib.ffl_open(path.encode(), sample_bytes, self._n, offset)
+        if not self._h:
+            raise OSError(f"ffl_open failed for {path!r}")
+        self.ffmodel = ffmodel
+        self.tensor = input_tensor
+        self.batch_size = batch_size or ffmodel.config.batch_size
+        self._sample_bytes = sample_bytes
+        self._configured_batch = None
+        self._shuffle = shuffle
+        self._seed = seed
+        self._produced = 0
+
+    @property
+    def num_samples(self) -> int:
+        return self._n
+
+    @property
+    def num_batches(self) -> int:
+        return self._n // self.batch_size
+
+    def reset(self):
+        if self._configured_batch != self.batch_size:
+            self._lib.ffl_config(self._h, self.batch_size,
+                                 1 if self._shuffle else 0, self._seed)
+            self._configured_batch = self.batch_size
+        self._lib.ffl_reset(self._h)
+        self._produced = 0
+
+    def next_batch(self) -> np.ndarray:
+        if self._configured_batch is None:
+            self.reset()
+        out = np.empty((self.batch_size, *self.sample_shape), self.dtype)
+        # ffl_next's argtype is c_void_p, so the raw address suffices
+        ok = self._lib.ffl_next(self._h, out.ctypes.data, self._produced)
+        if not ok:
+            raise StopIteration
+        self._produced += 1
+        return out
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.ffl_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

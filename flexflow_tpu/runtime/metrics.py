@@ -23,7 +23,9 @@ class PerfMetrics:
     """Host-side accumulator (reference PerfMetrics struct)."""
 
     train_all: int = 0
-    train_correct: int = 0
+    # float: fractional slot-averaged counts accumulate exactly (see
+    # update()); readers treat it as a count and may round for display
+    train_correct: float = 0
     cce_loss: float = 0.0
     sparse_cce_loss: float = 0.0
     mse_loss: float = 0.0
@@ -34,10 +36,10 @@ class PerfMetrics:
     def update(self, step_metrics: Dict[str, float], batch: int):
         self.train_all += batch
         if "accuracy_correct" in step_metrics:
-            # round, don't truncate: AggregateSpec's slot-averaged counts
-            # are fractional (correct/(k slots)) and int() would bias the
-            # reported accuracy low by up to 1/k sample per batch
-            self.train_correct += round(float(step_metrics["accuracy_correct"]))
+            # accumulate the FLOAT count: AggregateSpec's slot-averaged
+            # counts are fractional (correct/(k slots)); rounding per
+            # batch would accumulate half-even drift — round once at read
+            self.train_correct += float(step_metrics["accuracy_correct"])
         for k in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
             if k in step_metrics:
                 setattr(self, k, getattr(self, k) + float(step_metrics[k]) * batch)
