@@ -3,8 +3,9 @@
 
 Run (single chip):   python examples/python/llama_train.py -b 8 -e 1
 Run (8-dev search):  python examples/python/llama_train.py --budget 10 --devices 8
-The search (--budget) discovers the strategy; without it the hand TP
-strategy is used when the mesh has a model axis.
+Pipeline parallel:   ... --pipeline --mesh data=2,pipe=4
+The search (--budget) discovers the strategy; without it the hand TP (or
+PP, with --pipeline) strategy is used when the mesh has the matching axis.
 """
 
 import numpy as np
@@ -13,21 +14,33 @@ from flexflow_tpu import (
     AdamOptimizer, FFConfig, FFModel, LossType, MetricsType,
 )
 from flexflow_tpu.models.llama import (
-    LlamaConfig, build_llama, llama_tp_strategy,
+    LlamaConfig, build_llama, llama_pp_strategy, llama_tp_strategy,
 )
 
 
 def main(argv=None):
     import sys
 
-    cfg = FFConfig.from_args(argv if argv is not None else sys.argv[1:])
+    args = list(argv if argv is not None else sys.argv[1:])
+    use_pipeline = "--pipeline" in args
+    if use_pipeline:
+        args.remove("--pipeline")
+    cfg = FFConfig.from_args(args)
     lcfg = LlamaConfig.tiny(vocab=2048)
+    if use_pipeline:
+        # 4 layers so a pipe=4 mesh runs a real GPipe schedule
+        lcfg = LlamaConfig(vocab_size=2048, dim=64, layers=4, heads=4,
+                           kv_heads=2, hidden=128, rope_theta=10000.0)
     seq = 256
     ff = FFModel(cfg)
-    build_llama(ff, lcfg, batch_size=cfg.batch_size, seq_len=seq)
+    build_llama(ff, lcfg, batch_size=cfg.batch_size, seq_len=seq,
+                use_pipeline=use_pipeline)
     strategy = None
-    if cfg.search_budget == 0 and cfg.mesh_shape and cfg.mesh_shape.get("model", 1) > 1:
-        strategy = llama_tp_strategy(lcfg)
+    if cfg.search_budget == 0 and cfg.mesh_shape:
+        if use_pipeline and cfg.mesh_shape.get("pipe", 1) > 1:
+            strategy = llama_pp_strategy(lcfg)
+        elif cfg.mesh_shape.get("model", 1) > 1:
+            strategy = llama_tp_strategy(lcfg)
     ff.compile(
         optimizer=AdamOptimizer(lr=1e-3),
         loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,

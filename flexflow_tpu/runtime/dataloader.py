@@ -149,7 +149,9 @@ class FileDataLoader:
         self._produced = 0
 
     def next_batch(self) -> np.ndarray:
-        if self._configured_batch is None:
+        if self._configured_batch != self.batch_size:
+            # batch_size mutated since the C side was configured — the
+            # worker would overflow the smaller output buffer otherwise
             self.reset()
         out = np.empty((self.batch_size, *self.sample_shape), self.dtype)
         # ffl_next's argtype is c_void_p, so the raw address suffices

@@ -48,15 +48,26 @@ struct Loader {
     std::condition_variable cv_ready, cv_empty;
     std::thread worker;
     std::atomic<bool> stop{false};
-    bool epoch_running = false;
 
     ~Loader() { shutdown(); }
 
-    void shutdown() {
-        stop.store(true);
+    void stop_worker() {
+        {
+            // take mu before setting stop + notifying: without it the
+            // worker can evaluate its wait predicate (stop=false), lose
+            // the notify, and sleep forever -> join() deadlocks
+            std::lock_guard<std::mutex> lk(mu);
+            stop.store(true);
+        }
         cv_empty.notify_all();
         cv_ready.notify_all();
         if (worker.joinable()) worker.join();
+        stop.store(false);
+    }
+
+    void shutdown() {
+        stop_worker();
+        stop.store(true);  // no restart after shutdown
         if (base) munmap(const_cast<uint8_t*>(base), map_bytes);
         if (fd >= 0) close(fd);
         base = nullptr;
@@ -93,10 +104,7 @@ struct Loader {
 
     void start_epoch() {
         // join the previous epoch's worker, reset the ring, reshuffle
-        stop.store(true);
-        cv_empty.notify_all();
-        if (worker.joinable()) worker.join();
-        stop.store(false);
+        stop_worker();
         ready = {};
         empty = {};
         for (int i = 0; i < kRing; ++i) empty.push(i);
@@ -106,7 +114,6 @@ struct Loader {
                 std::swap(order[i], order[size_t(d(rng))]);
             }
         }
-        epoch_running = true;
         worker = std::thread([this] { fill_loop(); });
     }
 
@@ -170,10 +177,7 @@ void ffl_config(void* h, int batch, int shuffle, long seed) {
     auto* l = static_cast<Loader*>(h);
     // a worker from a previous epoch may still be writing into bufs —
     // stop and join it BEFORE reallocating the ring or changing batch
-    l->stop.store(true);
-    l->cv_empty.notify_all();
-    if (l->worker.joinable()) l->worker.join();
-    l->stop.store(false);
+    l->stop_worker();
     l->batch = batch;
     l->shuffle = shuffle != 0;
     l->rng.seed(uint64_t(seed));
@@ -182,10 +186,6 @@ void ffl_config(void* h, int batch, int shuffle, long seed) {
 }
 
 void ffl_reset(void* h) { static_cast<Loader*>(h)->start_epoch(); }
-
-long ffl_num_batches(void* h) {
-    return static_cast<Loader*>(h)->num_batches();
-}
 
 int ffl_next(void* h, void* out, long produced) {
     return static_cast<Loader*>(h)->next(static_cast<uint8_t*>(out), produced);

@@ -328,3 +328,35 @@ def test_moe_spec_classifier_repl_labels():
     acc = m.train_correct / m.train_all
     assert 0.0 <= acc <= 1.0
     assert acc > 0.6  # the speculative head still learns the clusters
+
+
+def test_llama_long_context_ring_attention():
+    """Long-context capability: ring attention trains at seq=1024 on a
+    seq-sharded mesh where full S^2 attention would materialize 4M-entry
+    score matrices per head; numerics still match full attention."""
+    lcfg = LlamaConfig(vocab_size=256, dim=32, layers=1, heads=4,
+                       kv_heads=2, hidden=64, rope_theta=10000.0)
+    seq = 1024
+    x, _ = lm_data(lcfg.vocab_size, 2, seq)
+
+    ff_full = FFModel(FFConfig(batch_size=2, seed=5))
+    build_llama(ff_full, lcfg, seq_len=seq, dtype=DataType.FLOAT)
+    ff_full.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    out_full = ff_full.predict(x)
+
+    ff_ring = FFModel(
+        FFConfig(batch_size=2, seed=5, mesh_shape={"data": 2, "seq": 4})
+    )
+    build_llama(ff_ring, lcfg, seq_len=seq, dtype=DataType.FLOAT,
+                use_ring_attention=True)
+    ff_ring.compile(
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=llama_tp_strategy(lcfg, seq_parallel=True),
+    )
+    out_ring = ff_ring.predict(x)
+    np.testing.assert_allclose(out_full, out_ring, rtol=2e-3, atol=2e-5)
+
+    # and it trains
+    y = np.roll(x, -1, 1).astype(np.int32)
+    m = ff_ring.fit(x, y, epochs=1, verbose=False)
+    assert m.train_all == 2
