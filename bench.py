@@ -9,6 +9,7 @@ JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -25,6 +26,8 @@ def _log(msg: str) -> None:
 def _llama_cfg():
     from flexflow_tpu.models.llama import LlamaConfig
 
+    if os.environ.get("FLEXFLOW_BENCH_SMOKE"):
+        return LlamaConfig.tiny()
     # ~200M params: fits one v5e chip with fp32 master weights + Adam state
     return LlamaConfig(vocab_size=32000, dim=1024, layers=12, heads=16,
                        kv_heads=8, hidden=2816)
@@ -41,7 +44,9 @@ def _sync(out):
     return float(np.asarray(out))
 
 
-def _time_steps(step_fn, *, iters=ITERS, warmup=WARMUP):
+def _time_steps(step_fn, *, iters=None, warmup=None):
+    iters = ITERS if iters is None else iters      # read at call time so
+    warmup = WARMUP if warmup is None else warmup  # --smoke overrides apply
     _log("warmup/compile start")
     for _ in range(warmup):
         out = step_fn()
@@ -254,8 +259,16 @@ def bench_naive(x, y) -> float:
 
 
 def _run_side(side: str) -> float:
+    plat = os.environ.get("FLEXFLOW_BENCH_PLATFORM")
+    if plat:
+        # must happen before the first backend touch: site customizations
+        # can force-register a TPU plugin that ignores JAX_PLATFORMS env
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     rs = np.random.RandomState(0)
-    x = rs.randint(0, 32000, (BATCH, SEQ)).astype(np.int32)
+    vocab = _llama_cfg().vocab_size
+    x = rs.randint(0, vocab, (BATCH, SEQ)).astype(np.int32)
     y = np.roll(x, -1, axis=1).astype(np.int32)
     return bench_framework(x, y) if side == "framework" else bench_naive(x, y)
 
@@ -276,15 +289,39 @@ def _spawn_side(side: str) -> float:
 
 
 def main():
+    global BATCH, SEQ, WARMUP, ITERS
+    if "--smoke" in sys.argv:
+        # tiny plumbing check (CPU-capable): exercises both subprocess
+        # sides end to end without the real model size
+        sys.argv.remove("--smoke")
+        os.environ["FLEXFLOW_BENCH_SMOKE"] = "1"
+    if "--platform" in sys.argv:
+        i = sys.argv.index("--platform")
+        if i + 1 >= len(sys.argv):
+            sys.exit("usage: bench.py [--smoke] [--platform cpu|tpu]")
+        os.environ["FLEXFLOW_BENCH_PLATFORM"] = sys.argv[i + 1]
+        del sys.argv[i:i + 2]
+    if os.environ.get("FLEXFLOW_BENCH_SMOKE"):
+        BATCH, SEQ, WARMUP, ITERS = 2, 128, 1, 2
     if len(sys.argv) > 2 and sys.argv[1] == "--side":
         tps = _run_side(sys.argv[2])
         print(json.dumps({"tokens_per_sec": tps}))
         return
+    plat = os.environ.get("FLEXFLOW_BENCH_PLATFORM")
+    if plat:
+        # the parent touches jax too (_peak_flops) — configure it the same
+        # way as the children before any backend init
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     fw = _spawn_side("framework")
     nv = _spawn_side("naive")
     mfu = fw * _flops_per_token(_llama_cfg(), SEQ) / _peak_flops()
+    name = ("llama_smoke_train_tokens_per_sec"
+            if os.environ.get("FLEXFLOW_BENCH_SMOKE")
+            else "llama_200m_train_tokens_per_sec")
     print(json.dumps({
-        "metric": "llama_200m_train_tokens_per_sec",
+        "metric": name,
         "value": round(fw, 1),
         "unit": "tokens/s",
         "vs_baseline": round(fw / nv, 4),

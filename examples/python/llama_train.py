@@ -28,9 +28,10 @@ def main(argv=None):
     cfg = FFConfig.from_args(args)
     lcfg = LlamaConfig.tiny(vocab=2048)
     if use_pipeline:
-        # 4 layers so a pipe=4 mesh runs a real GPipe schedule
-        lcfg = LlamaConfig(vocab_size=2048, dim=64, layers=4, heads=4,
-                           kv_heads=2, hidden=128, rope_theta=10000.0)
+        import dataclasses
+
+        # tiny but 4 layers, so a pipe=4 mesh runs a real GPipe schedule
+        lcfg = dataclasses.replace(LlamaConfig.tiny(vocab=2048), layers=4)
     seq = 256
     ff = FFModel(cfg)
     build_llama(ff, lcfg, batch_size=cfg.batch_size, seq_len=seq,

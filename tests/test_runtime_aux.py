@@ -483,3 +483,39 @@ def test_native_file_dataloader(tmp_path):
     assert m.train_all == 128
     ev = ff.eval(x, y, verbose=False)
     assert ev.train_correct / ev.train_all > 0.8
+
+
+def test_elastic_resume_across_mesh_sizes(tmp_path):
+    """Elastic recovery (SURVEY §5.3 — absent in the reference, net-new):
+    a job checkpointed on an 8-chip data x model mesh resumes on a 4-chip
+    data-only mesh (slice shrink after failure) with identical predictions
+    and continued training."""
+    from flexflow_tpu.runtime.checkpoint import restore_checkpoint, save_checkpoint
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama, llama_tp_strategy
+
+    lcfg = LlamaConfig.tiny()
+    x = (np.random.RandomState(0)
+         .randint(0, lcfg.vocab_size, (8, 32)).astype(np.int32))
+    y = np.roll(x, -1, 1)
+
+    ff8 = FFModel(FFConfig(batch_size=8, seed=1, num_devices=8,
+                           mesh_shape={"data": 2, "model": 4}))
+    build_llama(ff8, lcfg, seq_len=32, dtype=DataType.FLOAT)
+    ff8.compile(optimizer=AdamOptimizer(lr=1e-3),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                strategy=llama_tp_strategy(lcfg))
+    ff8.fit(x, y, epochs=1, verbose=False)
+    save_checkpoint(str(tmp_path / "ck"), ff8)
+    ref = ff8.predict(x)
+
+    # "failed" slice: resume on 4 chips, pure DP
+    ff4 = FFModel(FFConfig(batch_size=8, seed=99, num_devices=4,
+                           mesh_shape={"data": 4}))
+    build_llama(ff4, lcfg, seq_len=32, dtype=DataType.FLOAT)
+    ff4.compile(optimizer=AdamOptimizer(lr=1e-3),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    meta = restore_checkpoint(str(tmp_path / "ck"), ff4)
+    assert ff4._step_count == ff8._step_count
+    np.testing.assert_allclose(np.asarray(ff4.predict(x)), np.asarray(ref),
+                               rtol=2e-3, atol=2e-5)
+    ff4.fit(x, y, epochs=1, verbose=False)  # keeps training on the new mesh
