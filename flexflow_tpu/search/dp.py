@@ -40,7 +40,9 @@ class ViewDP:
 
     def optimize(self, graph: Graph) -> Dict[str, ShardingView]:
         strategy = self._solve(graph, {})
-        # fill uncovered nodes with DP defaults
+        # fill uncovered nodes with DP defaults (attached rewrite views are
+        # preserved through _candidates, which makes every such node
+        # searchable with its own view as a candidate)
         base = space.default_dp_strategy(graph, self.cost.axis_sizes)
         base.update(strategy)
         return base
@@ -63,6 +65,12 @@ class ViewDP:
                 param_parallel=self.cost.param_parallel,
                 attr_parallel=self.cost.attr_parallel,
             )
+            # the node's attached view (substitution-carried) is always a
+            # candidate, first so it is the solver's starting point — a
+            # rewrite-carried view the enumeration can't express (e.g. TP
+            # over a seq/expert axis) must not be silently reset to DP
+            if n.sharding is not None and n.sharding not in views:
+                views = [n.sharding] + views
             if len(views) > 1:
                 out[n.name] = views
         return out

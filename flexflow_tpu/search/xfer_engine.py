@@ -551,6 +551,39 @@ def gen_default_rules() -> List[Dict]:
     })
 
     # --- parallelization rules (explicit parallel-op insertions) --------
+    # linear column/row TP per mesh axis (the hand-coded builders in
+    # substitution.py cover only "model"; these give the search the same
+    # moves on seq/expert axes of exotic meshes)
+    for axis in ("seq", "expert"):
+        rules.append({
+            "name": f"partition_linear_combine_{axis}",
+            "requires_axis": axis,
+            "src": {
+                "nodes": [{"id": "l", "type": "LINEAR",
+                           "when": {"no_weight_sharding": True,
+                                    "attr_eq": ["use_bias", False],
+                                    "out_ndim": 2}}],
+                "inputs": [["x", "l", 0]],
+                "outputs": [["l", 0]],
+            },
+            "dst": {
+                "nodes": [
+                    {"id": "l2", "type": "LINEAR", "reuse": "l",
+                     "name": "{l}", "attrs": {"$copy": "l"},
+                     "sharding": {
+                         "outputs": [[["data"], [axis]]],
+                         "weights": {"kernel": [[], [axis]]},
+                     }},
+                    {"id": "comb", "type": "COMBINE", "name": "{l}_combine",
+                     "attrs": {"dim": 1, "axes": [axis]},
+                     "sharding": {"outputs": [[["data"], []]],
+                                  "weights": {}}},
+                ],
+                "edges": [["l2", 0, "comb", 0]],
+                "inputs": [["x", "l2", 0]],
+                "outputs": [["comb", 0]],
+            },
+        })
     for axis in ("model", "seq", "expert"):
         # conv2d output-channel TP + combine on the channel dim
         rules.append({

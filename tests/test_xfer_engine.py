@@ -150,3 +150,30 @@ def test_load_rules_axis_filter(tmp_path):
     with_model = load_rules(str(p), {"data": 2, "model": 4})
     assert len(with_model) > len(no_model)
     assert all("seq" != r.rule.get("requires_axis") for r in no_model)
+
+
+def test_seq_axis_linear_tp_rule_on_modelless_mesh():
+    """On a {data, seq} mesh (no model axis) the corpus still offers
+    linear TP over `seq` — the search beats DP using it."""
+    from flexflow_tpu.search.cost_model import CostModel, graph_cost
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.space import default_dp_strategy
+    from flexflow_tpu.search.substitution import unity_search
+
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 8192), DataType.FLOAT, name="input")
+    t = ff.dense(x, 8192, use_bias=False, name="d0")
+    t = ff.dense(t, 8192, use_bias=False, name="d1")
+    ff.softmax(t, name="softmax")
+    ff.graph.infer_shapes()
+    axis_sizes = {"data": 2, "seq": 4}
+    cost = CostModel(TPUMachineModel.make("v5e", 8), axis_sizes)
+    dp_time = graph_cost(
+        ff.graph, default_dp_strategy(ff.graph, axis_sizes), cost
+    ).time
+    g, strategy, t_best = unity_search(ff.graph, cost, budget=8)
+    assert t_best < dp_time
+    used = {a for v in strategy.values()
+            for spec in list(v.output_specs) + list(v.weight_specs.values())
+            if spec for axes in spec for a in axes}
+    assert "seq" in used
