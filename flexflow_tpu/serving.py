@@ -232,3 +232,92 @@ def serve_onnx(path: str, config=None, batch_sizes: Sequence[int] = (1, 8),
     onnx_model.apply(ff, inputs)
     ff.compile(loss_type=LossType.IDENTITY)
     return serve(ff, batch_sizes=batch_sizes, **kw), ff
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint (the triton wire-protocol analog; KServe-v2-shaped JSON)
+
+
+_DTYPE_TO_V2 = {"float32": "FP32", "float64": "FP64", "int32": "INT32",
+                "int64": "INT64", "bool": "BOOL", "float16": "FP16"}
+_V2_TO_DTYPE = {v: k for k, v in _DTYPE_TO_V2.items()}
+
+
+def http_serve(server: Server, port: int = 8000, model_name: str = "model"):
+    """Expose a Server over HTTP with the KServe v2 JSON surface the
+    reference's triton backend speaks (triton/README.md):
+
+      GET  /v2/health/ready                 -> 200
+      GET  /v2/models/<name>               -> metadata
+      POST /v2/models/<name>/infer         -> {"inputs": [{"name","shape",
+                                               "datatype","data"}...]}
+
+    Returns the ThreadingHTTPServer (serve_forever on a thread; call
+    .shutdown() to stop). Stdlib-only — no server framework in the image.
+    """
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, payload: dict):
+            body = _json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/v2/health/ready":
+                ready = getattr(server, "_running", True)
+                self._send(200 if ready else 503, {"ready": bool(ready)})
+            elif self.path == f"/v2/models/{model_name}":
+                self._send(200, {
+                    "name": model_name,
+                    "platform": "flexflow_tpu",
+                    "requests_served": server.requests_served,
+                })
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path != f"/v2/models/{model_name}/infer":
+                self._send(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = _json.loads(self.rfile.read(n))
+                arrays = []
+                for spec in req["inputs"]:
+                    dt = _V2_TO_DTYPE.get(spec.get("datatype", "FP32"),
+                                          "float32")
+                    arrays.append(
+                        np.asarray(spec["data"], dtype=dt)
+                        .reshape(spec["shape"])
+                    )
+            except Exception as e:
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            try:
+                out = np.asarray(server.predict(*arrays))
+                self._send(200, {
+                    "model_name": model_name,
+                    "outputs": [{
+                        "name": "output0",
+                        "shape": list(out.shape),
+                        "datatype": _DTYPE_TO_V2.get(str(out.dtype), "FP32"),
+                        "data": out.reshape(-1).tolist(),
+                    }],
+                })
+            except Exception as e:
+                # inference failures are SERVER errors (5xx — retryable),
+                # unlike the request-decode 400s above
+                self._send(503, {"error": f"{type(e).__name__}: {e}"})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

@@ -66,3 +66,69 @@ def test_serve_oversized_request_chunks():
                                    atol=1e-5)
     finally:
         server.stop()
+
+
+def test_http_endpoint_kserve_v2():
+    """HTTP wire protocol (triton analog): health, metadata, and a JSON
+    infer round-trip through the dynamic batcher."""
+    import json
+    import urllib.request
+
+    from flexflow_tpu.serving import http_serve, serve
+
+    ff = _compiled_model()
+    server = serve(ff, batch_sizes=(1, 4), warmup=False)
+    httpd = http_serve(server, port=0, model_name="mlp")  # ephemeral port
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with urllib.request.urlopen(f"{base}/v2/health/ready") as r:
+            assert json.load(r)["ready"]
+        with urllib.request.urlopen(f"{base}/v2/models/mlp") as r:
+            assert json.load(r)["platform"] == "flexflow_tpu"
+        x = np.random.RandomState(0).randn(2, 16).astype(np.float32)
+        req = json.dumps({"inputs": [{
+            "name": "input", "shape": [2, 16], "datatype": "FP32",
+            "data": x.reshape(-1).tolist(),
+        }]}).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/v2/models/mlp/infer", data=req,
+                headers={"Content-Type": "application/json"})) as r:
+            out = json.load(r)["outputs"][0]
+        got = np.asarray(out["data"]).reshape(out["shape"])
+        ref = np.asarray(server.predict(x))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        # bad request -> 400 with an error body, not a crash
+        bad = json.dumps({"inputs": [{"shape": [1], "data": "x"}]}).encode()
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/v2/models/mlp/infer", data=bad))
+            assert False, "expected HTTPError"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        httpd.shutdown()
+        server.stop()
+
+
+def test_http_ready_degrades_after_stop():
+    """Readiness probe reports the Server's real state (503 once stopped)."""
+    import json
+    import urllib.request
+
+    from flexflow_tpu.serving import http_serve, serve
+
+    ff = _compiled_model()
+    server = serve(ff, batch_sizes=(1,), warmup=False)
+    httpd = http_serve(server, port=0, model_name="m")
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with urllib.request.urlopen(f"{base}/v2/health/ready") as r:
+            assert json.load(r)["ready"]
+        server.stop()
+        try:
+            urllib.request.urlopen(f"{base}/v2/health/ready")
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+    finally:
+        httpd.shutdown()
