@@ -33,16 +33,23 @@ def _in_shapes(graph, node):
     return ins
 
 
+def is_pipe_sharded(node: Node, view: Optional[ShardingView]) -> bool:
+    """True when a PIPELINE composite's assigned view pipe-shards the
+    stacked weights (probe shared by the cost/traffic models — the view
+    shape's source of truth is parallel.sharding.pipeline_pipe_view)."""
+    if node.op_type != OpType.PIPELINE or view is None:
+        return False
+    ln1 = view.weight_specs.get("ln1")
+    return bool(ln1 and ln1[0] and "pipe" in ln1[0])
+
+
 def pipeline_compute_factor(node: Node, view: Optional[ShardingView],
                             axis_sizes: Dict[str, int]) -> float:
     """GPipe bubble multiplier for a pipe-sharded PIPELINE composite:
     (M+P-1)/M — every stage idles for P-1 of the M+P-1 schedule ticks.
     1.0 for anything else. Shared by the analytic and measured cost models
     so measured cache hits pay the bubble too."""
-    if node.op_type != OpType.PIPELINE or view is None:
-        return 1.0
-    ln1 = view.weight_specs.get("ln1")
-    if not (ln1 and ln1[0] and "pipe" in ln1[0]):
+    if not is_pipe_sharded(node, view):
         return 1.0
     p = axis_sizes.get("pipe", 1)
     m = max(getattr(node.attrs, "n_microbatches", 1), 1)
@@ -196,22 +203,20 @@ class CostModel:
                     )
         # pipeline: each of the (M+P-1) schedule ticks ppermutes one
         # microbatch activation to the next stage (one ICI hop)
-        if node.op_type == OpType.PIPELINE and view is not None and ins:
-            ln1 = view.weight_specs.get("ln1")
-            if ln1 and ln1[0] and "pipe" in ln1[0]:
-                p = self.axis_sizes.get("pipe", 1)
-                m = max(getattr(node.attrs, "n_microbatches", 1), 1)
-                if p > 1:
-                    # each ppermute moves the per-DATA-SHARD microbatch
-                    out_deg = max(
-                        spec_degree(view.output_spec(0), self.axis_sizes), 1
-                    )
-                    micro_bytes = ins[0].global_bytes() / m / out_deg
-                    per_hop = (
-                        micro_bytes / self.machine._axis_bw(2, ("pipe",))
-                        + self.machine.ici_latency
-                    )
-                    return (m + p - 1) * per_hop
+        if is_pipe_sharded(node, view) and ins:
+            p = self.axis_sizes.get("pipe", 1)
+            m = max(getattr(node.attrs, "n_microbatches", 1), 1)
+            if p > 1:
+                # each ppermute moves the per-DATA-SHARD microbatch
+                out_deg = max(
+                    spec_degree(view.output_spec(0), self.axis_sizes), 1
+                )
+                micro_bytes = ins[0].global_bytes() / m / out_deg
+                per_hop = (
+                    micro_bytes / self.machine._axis_bw(2, ("pipe",))
+                    + self.machine.ici_latency
+                )
+                return (m + p - 1) * per_hop
         # contraction-dim sharding => partial-sum all-reduce of the output
         if view is not None and node.outputs:
             contraction_specs = {

@@ -244,7 +244,11 @@ def logical_traffic_matrix(graph, strategy, cost) -> Dict[str, float]:
     changes across the edge. A pure observability/product of the cost
     model — useful for choosing the axis_map."""
     from flexflow_tpu.ffconst import OpType, PARALLEL_OP_TYPES
-    from flexflow_tpu.search.cost_model import _in_shapes, spec_degree
+    from flexflow_tpu.search.cost_model import (
+        _in_shapes,
+        is_pipe_sharded,
+        spec_degree,
+    )
 
     out: Dict[str, float] = {}
 
@@ -262,6 +266,12 @@ def logical_traffic_matrix(graph, strategy, cost) -> Dict[str, float]:
             continue
         if node.op_type in PARALLEL_OP_TYPES or node.attrs is None:
             continue
+        if is_pipe_sharded(node, view) and ins:
+            # (M+P-1) microbatch hops ride the pipe axis
+            m = max(getattr(node.attrs, "n_microbatches", 1), 1)
+            p = cost.axis_sizes.get("pipe", 1)
+            if p > 1:
+                bill(("pipe",), (m + p - 1) * ins[0].global_bytes() / m)
         ws = node.attrs.weights(*ins)
         for name, decl in ws.items():
             if not decl.trainable:

@@ -20,6 +20,7 @@ ONNX frontend (the triton onnx_parser.cc analog) and serves it.
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 import time
@@ -255,21 +256,30 @@ def http_serve(server: Server, port: int = 8000, model_name: str = "model"):
     Returns the ThreadingHTTPServer (serve_forever on a thread; call
     .shutdown() to stop). Stdlib-only — no server framework in the image.
     """
-    import json as _json
-    import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    # model input order for by-name binding (KServe clients may list
+    # tensors in any order; names win over positions when they match)
+    input_names = [
+        n.name for n in server.instance.ff.executor.input_nodes
+    ]
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
 
         def _send(self, code: int, payload: dict):
-            body = _json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            body = json.dumps(payload).encode()
+            try:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except OSError:
+                # client went away mid-response; nothing to salvage (a
+                # second status line would corrupt the stream)
+                self.close_connection = True
 
         def do_GET(self):
             if self.path == "/v2/health/ready":
@@ -290,13 +300,22 @@ def http_serve(server: Server, port: int = 8000, model_name: str = "model"):
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
-                req = _json.loads(self.rfile.read(n))
+                req = json.loads(self.rfile.read(n))
+                specs = req["inputs"]
+                names = [s.get("name") for s in specs]
+                if (len(specs) == len(input_names) and all(names)
+                        and set(names) == set(input_names)):
+                    # standards path: bind tensors by name
+                    specs = sorted(
+                        specs, key=lambda s: input_names.index(s["name"])
+                    )
                 arrays = []
-                for spec in req["inputs"]:
-                    dt = _V2_TO_DTYPE.get(spec.get("datatype", "FP32"),
-                                          "float32")
+                for spec in specs:
+                    v2dt = spec.get("datatype", "FP32")
+                    if v2dt not in _V2_TO_DTYPE:
+                        raise ValueError(f"unsupported datatype {v2dt!r}")
                     arrays.append(
-                        np.asarray(spec["data"], dtype=dt)
+                        np.asarray(spec["data"], dtype=_V2_TO_DTYPE[v2dt])
                         .reshape(spec["shape"])
                     )
             except Exception as e:
@@ -304,19 +323,20 @@ def http_serve(server: Server, port: int = 8000, model_name: str = "model"):
                 return
             try:
                 out = np.asarray(server.predict(*arrays))
-                self._send(200, {
-                    "model_name": model_name,
-                    "outputs": [{
-                        "name": "output0",
-                        "shape": list(out.shape),
-                        "datatype": _DTYPE_TO_V2.get(str(out.dtype), "FP32"),
-                        "data": out.reshape(-1).tolist(),
-                    }],
-                })
             except Exception as e:
                 # inference failures are SERVER errors (5xx — retryable),
                 # unlike the request-decode 400s above
                 self._send(503, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(200, {
+                "model_name": model_name,
+                "outputs": [{
+                    "name": "output0",
+                    "shape": list(out.shape),
+                    "datatype": _DTYPE_TO_V2.get(str(out.dtype), "FP32"),
+                    "data": out.reshape(-1).tolist(),
+                }],
+            })
 
     httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python3
+"""Render the declarative substitution corpus as graphviz dot (the
+reference's tools/substitutions_to_dot analog).
+
+Usage:
+  python tools/rules_to_dot.py [rules.json] > rules.dot
+  dot -Tsvg rules.dot -o rules.svg
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from flexflow_tpu.search.xfer_engine import DEFAULT_RULES_PATH  # noqa: E402
+
+
+def rule_to_dot(rule, out):
+    name = rule["name"]
+    out.append(f'  subgraph "cluster_{name}" {{')
+    out.append(f'    label="{name}";')
+    for half, sub in (("src", rule["src"]), ("dst", rule["dst"])):
+        color = "lightblue" if half == "src" else "lightgreen"
+        for n in sub["nodes"]:
+            nid = f"{name}_{half}_{n['id']}"
+            out.append(
+                f'    "{nid}" [label="{n["id"]}: {n.get("type", "*")}", '
+                f'style=filled, fillcolor={color}];'
+            )
+        for (s, si, d, di) in sub.get("edges", ()):
+            out.append(
+                f'    "{name}_{half}_{s}" -> "{name}_{half}_{d}" '
+                f'[label="{si}->{di}"];'
+            )
+        for (iid, did, didx) in sub.get("inputs", ()):
+            ext = f"{name}_{half}_in_{iid}"
+            out.append(f'    "{ext}" [label="{iid}", shape=plaintext];')
+            out.append(f'    "{ext}" -> "{name}_{half}_{did}" '
+                       f'[style=dashed, label="{didx}"];')
+    out.append("  }")
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_RULES_PATH
+    with open(path) as f:
+        rules = json.load(f)
+    out = ["digraph substitutions {", "  rankdir=LR;"]
+    for r in rules:
+        rule_to_dot(r, out)
+    out.append("}")
+    print("\n".join(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Render an exported strategy JSON over a model's PCG as graphviz dot
+(the reference's --compgraph/--include-costs-dot-graph flow as a
+standalone tool).
+
+Usage:
+  python tools/strategy_to_dot.py llama-tiny strategy.json > g.dot
+  python tools/strategy_to_dot.py mlp > g.dot          # DP default views
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build(model_name):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flexflow_tpu import FFConfig, FFModel
+
+    ff = FFModel(FFConfig(batch_size=8, num_devices=1))
+    if model_name == "mlp":
+        from flexflow_tpu.models.mlp import build_mlp
+
+        build_mlp(ff, 64, [128], 10, batch_size=8)
+    elif model_name == "llama-tiny":
+        from flexflow_tpu.models.llama import LlamaConfig, build_llama
+
+        build_llama(ff, LlamaConfig.tiny(), batch_size=8, seq_len=32)
+    else:
+        sys.exit(f"unknown model {model_name!r} (mlp | llama-tiny)")
+    ff.graph.infer_shapes()
+    return ff
+
+
+def main():
+    if len(sys.argv) < 2:
+        sys.exit(__doc__)
+    ff = build(sys.argv[1])
+    if len(sys.argv) > 2:
+        from flexflow_tpu.parallel.sharding import view_from_json
+
+        with open(sys.argv[2]) as f:
+            views = {k: view_from_json(v) for k, v in json.load(f).items()}
+        for n in ff.graph.nodes:
+            if n.name in views:
+                n.sharding = views[n.name]
+    print(ff.graph.to_dot())
+
+
+if __name__ == "__main__":
+    main()
