@@ -594,21 +594,7 @@ class FFModel:
         )
 
         if cfg.export_strategy_file:
-            # reference --export-strategy (model.cc:3604)
-            import json as _json
-
-            from flexflow_tpu.parallel.sharding import view_to_json
-
-            with open(cfg.export_strategy_file, "w") as f:
-                _json.dump(
-                    {
-                        n.name: view_to_json(n.sharding)
-                        for n in self.graph.nodes
-                        if n.sharding is not None
-                    },
-                    f,
-                    indent=1,
-                )
+            self.export_strategy_file(cfg.export_strategy_file)
         if cfg.export_strategy_computation_graph_file:
             # reference --compgraph dot export (model.cc:3664); with
             # --include-costs-dot-graph each node is annotated with its
@@ -679,6 +665,24 @@ class FFModel:
                 continue
             out.append(jax.device_put(a, sh) if sh is not None else jax.device_put(a))
         return out
+
+    def export_strategy_file(self, path: str) -> None:
+        """Write the compiled strategy as JSON (reference --export-strategy,
+        model.cc:3604); also exposed through the C API."""
+        import json as _json
+
+        from flexflow_tpu.parallel.sharding import view_to_json
+
+        with open(path, "w") as f:
+            _json.dump(
+                {
+                    n.name: view_to_json(n.sharding)
+                    for n in self.graph.nodes
+                    if n.sharding is not None
+                },
+                f,
+                indent=1,
+            )
 
     def create_data_loader(self, tensor: Tensor, full_array,
                            batch_size: Optional[int] = None,

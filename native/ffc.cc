@@ -492,3 +492,91 @@ double ffc_model_last_accuracy(ffc_model_t handle) {
 }
 
 }  // extern "C"
+
+extern "C" {
+
+int ffc_model_save_checkpoint(ffc_model_t handle, const char *path) {
+  // runtime/checkpoint.py save_checkpoint(path, ffmodel)
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *mod = PyImport_ImportModule("flexflow_tpu.runtime.checkpoint");
+  if (!mod) { set_error_from_python(); return -1; }
+  PyObject *res = PyObject_CallMethod(mod, "save_checkpoint", "sO", path,
+                                      st->model);
+  Py_DECREF(mod);
+  if (!res) { set_error_from_python(); return -1; }
+  Py_DECREF(res);
+  return 0;
+}
+
+int ffc_model_restore_checkpoint(ffc_model_t handle, const char *path) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *mod = PyImport_ImportModule("flexflow_tpu.runtime.checkpoint");
+  if (!mod) { set_error_from_python(); return -1; }
+  PyObject *res = PyObject_CallMethod(mod, "restore_checkpoint", "sO", path,
+                                      st->model);
+  Py_DECREF(mod);
+  if (!res) { set_error_from_python(); return -1; }
+  Py_DECREF(res);
+  return 0;
+}
+
+int ffc_model_export_strategy(ffc_model_t handle, const char *path) {
+  // FFModel.export_strategy_file (the --export-strategy flow)
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *res = PyObject_CallMethod(st->model, "export_strategy_file", "s",
+                                      path);
+  if (!res) { set_error_from_python(); return -1; }
+  Py_DECREF(res);
+  return 0;
+}
+
+double ffc_model_eval(ffc_model_t handle, const float *x, const int32_t *y,
+                      int64_t n, int64_t x_row_elems) {
+  // returns eval accuracy in [0,1], or -1 on error
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *xa = np_from_buffer(x, n * x_row_elems, "float32", n, x_row_elems);
+  if (!xa) return -1.0;
+  if (st->input_dims.size() > 2) {
+    // same >2-D reshape as fit/predict (conv inputs arrive flattened)
+    PyObject *shape = PyTuple_New(st->input_dims.size());
+    PyTuple_SetItem(shape, 0, PyLong_FromLongLong(n));
+    for (size_t i = 1; i < st->input_dims.size(); i++) {
+      PyTuple_SetItem(shape, i, PyLong_FromLongLong(st->input_dims[i]));
+    }
+    PyObject *xr = PyObject_CallMethod(xa, "reshape", "(O)", shape);
+    Py_DECREF(shape);
+    Py_DECREF(xa);
+    if (!xr) { set_error_from_python(); return -1.0; }
+    xa = xr;
+  }
+  PyObject *ya = np_from_buffer(y, n, "int32", n, 1);
+  if (!ya) { Py_DECREF(xa); return -1.0; }
+  PyObject *args = PyTuple_Pack(2, xa, ya);
+  PyObject *kwargs = Py_BuildValue("{s:O}", "verbose", Py_False);
+  PyObject *metrics = call_method(st->model, "eval", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(xa);
+  Py_DECREF(ya);
+  if (!metrics) return -1.0;
+  PyObject *c = PyObject_GetAttrString(metrics, "train_correct");
+  PyObject *a = PyObject_GetAttrString(metrics, "train_all");
+  double res = -1.0;
+  if (c && a) {
+    // train_correct may be a float (slot-averaged counts)
+    PyObject *cf = PyNumber_Float(c);
+    double all = (double)PyLong_AsLongLong(a);
+    if (cf && all > 0) res = PyFloat_AsDouble(cf) / all;
+    Py_XDECREF(cf);
+  }
+  Py_XDECREF(c);
+  Py_XDECREF(a);
+  Py_DECREF(metrics);
+  return res;
+}
+
+}  // extern "C" (checkpoint/strategy/eval additions)

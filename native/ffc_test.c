@@ -4,6 +4,7 @@
  * primary consumer). */
 
 #include <stdio.h>
+#include <string.h>
 #include <stdlib.h>
 
 #include "flexflow_tpu_c.h"
@@ -66,6 +67,42 @@ int main(void) {
   if (s < 0.99f || s > 1.01f) {
     fprintf(stderr, "bad prob row sum %.4f\n", s);
     return 1;
+  }
+
+  /* eval + strategy export + checkpoint round trip */
+  double eacc = ffc_model_eval(model, xd, yd, n, 16);
+  if (eacc < 0.9) {
+    fprintf(stderr, "eval accuracy: %.3f (%s)\n", eacc, ffc_last_error());
+    return 1;
+  }
+  if (ffc_model_export_strategy(model, "/tmp/ffc_strategy.json") != 0) {
+    fprintf(stderr, "export_strategy: %s\n", ffc_last_error());
+    return 1;
+  }
+  if (ffc_model_save_checkpoint(model, "/tmp/ffc_ckpt") != 0) {
+    fprintf(stderr, "save_checkpoint: %s\n", ffc_last_error());
+    return 1;
+  }
+  /* perturb the weights (more training) so restore must actually write
+   * state back — a no-op restore would change predictions */
+  float before[4];
+  memcpy(before, probs, sizeof(before));
+  ffc_model_fit(model, xd, yd, n, 16, 4);
+  if (ffc_model_restore_checkpoint(model, "/tmp/ffc_ckpt") != 0) {
+    fprintf(stderr, "restore_checkpoint: %s\n", ffc_last_error());
+    return 1;
+  }
+  if (ffc_model_predict(model, xd, 32, 16, probs, 4) != 0) {
+    fprintf(stderr, "predict after restore: %s\n", ffc_last_error());
+    return 1;
+  }
+  for (int i = 0; i < 4; i++) {
+    float d = probs[i] - before[i];
+    if (d < -1e-4f || d > 1e-4f) {
+      fprintf(stderr, "restore did not bring weights back (%d: %.6f vs %.6f)\n",
+              i, probs[i], before[i]);
+      return 1;
+    }
   }
   printf("C_API_OK\n");
 

@@ -103,6 +103,19 @@ int ffc_model_predict(ffc_model_t model, const float *x, int64_t n,
 /* training accuracy of the last fit() epoch in [0,1]; -1 when unknown */
 double ffc_model_last_accuracy(ffc_model_t model);
 
+/* training checkpoint (runtime/checkpoint.py): save/restore full train
+ * state (params + optimizer + step counter) at `path`; 0 on success */
+int ffc_model_save_checkpoint(ffc_model_t model, const char *path);
+int ffc_model_restore_checkpoint(ffc_model_t model, const char *path);
+
+/* write the compiled strategy as JSON (the --export-strategy flow) */
+int ffc_model_export_strategy(ffc_model_t model, const char *path);
+
+/* eval accuracy over (x, y); in [0,1], or -1 on error */
+double ffc_model_eval(ffc_model_t model, const float *x, const int32_t *y,
+                      int64_t n, int64_t x_row_elems);
+
+
 #ifdef __cplusplus
 }
 #endif
