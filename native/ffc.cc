@@ -393,6 +393,24 @@ int ffc_model_compile(ffc_model_t handle, ffc_loss_t loss, float lr) {
   return 0;
 }
 
+
+// reshape a flat (n, row_elems) buffer to the model's first input tensor
+// dims (n, d1, d2, ...) when the input is >2-D; consumes `xa` on failure
+static PyObject *reshape_to_input_dims(ModelState *st, PyObject *xa,
+                                       int64_t n) {
+  if (st->input_dims.size() <= 2) return xa;
+  PyObject *shape = PyTuple_New(st->input_dims.size());
+  PyTuple_SetItem(shape, 0, PyLong_FromLongLong(n));
+  for (size_t i = 1; i < st->input_dims.size(); i++) {
+    PyTuple_SetItem(shape, i, PyLong_FromLongLong(st->input_dims[i]));
+  }
+  PyObject *xr = PyObject_CallMethod(xa, "reshape", "(O)", shape);
+  Py_DECREF(shape);
+  Py_DECREF(xa);
+  if (!xr) set_error_from_python();
+  return xr;
+}
+
 int64_t ffc_model_fit(ffc_model_t handle, const float *x, const int32_t *y,
                       int64_t n, int64_t x_row_elems, int epochs) {
   g_error.clear();
@@ -400,18 +418,8 @@ int64_t ffc_model_fit(ffc_model_t handle, const float *x, const int32_t *y,
   PyObject *xa = np_from_buffer(x, n * x_row_elems, "float32", n, x_row_elems);
   if (!xa) return -1;
   // reshape x to the first input tensor's trailing dims
-  if (st->input_dims.size() > 2) {
-    PyObject *shape = PyTuple_New(st->input_dims.size());
-    PyTuple_SetItem(shape, 0, PyLong_FromLongLong(n));
-    for (size_t i = 1; i < st->input_dims.size(); i++) {
-      PyTuple_SetItem(shape, i, PyLong_FromLongLong(st->input_dims[i]));
-    }
-    PyObject *xr = PyObject_CallMethod(xa, "reshape", "(O)", shape);
-    Py_DECREF(shape);
-    Py_DECREF(xa);
-    if (!xr) { set_error_from_python(); return -1; }
-    xa = xr;
-  }
+  xa = reshape_to_input_dims(st, xa, n);
+  if (!xa) return -1;
   PyObject *ya = np_from_buffer(y, n, "int32", n, 1);
   if (!ya) { Py_DECREF(xa); return -1; }
   PyObject *args = PyTuple_Pack(2, xa, ya);
@@ -437,18 +445,8 @@ int ffc_model_predict(ffc_model_t handle, const float *x, int64_t n,
   auto *st = reinterpret_cast<ModelState *>(handle);
   PyObject *xa = np_from_buffer(x, n * x_row_elems, "float32", n, x_row_elems);
   if (!xa) return -1;
-  if (st->input_dims.size() > 2) {
-    PyObject *shape = PyTuple_New(st->input_dims.size());
-    PyTuple_SetItem(shape, 0, PyLong_FromLongLong(n));
-    for (size_t i = 1; i < st->input_dims.size(); i++) {
-      PyTuple_SetItem(shape, i, PyLong_FromLongLong(st->input_dims[i]));
-    }
-    PyObject *xr = PyObject_CallMethod(xa, "reshape", "(O)", shape);
-    Py_DECREF(shape);
-    Py_DECREF(xa);
-    if (!xr) { set_error_from_python(); return -1; }
-    xa = xr;
-  }
+  xa = reshape_to_input_dims(st, xa, n);
+  if (!xa) return -1;
   PyObject *args = PyTuple_Pack(1, xa);
   PyObject *empty = PyDict_New();
   PyObject *pred = call_method(st->model, "predict", args, empty);
@@ -540,19 +538,8 @@ double ffc_model_eval(ffc_model_t handle, const float *x, const int32_t *y,
   auto *st = reinterpret_cast<ModelState *>(handle);
   PyObject *xa = np_from_buffer(x, n * x_row_elems, "float32", n, x_row_elems);
   if (!xa) return -1.0;
-  if (st->input_dims.size() > 2) {
-    // same >2-D reshape as fit/predict (conv inputs arrive flattened)
-    PyObject *shape = PyTuple_New(st->input_dims.size());
-    PyTuple_SetItem(shape, 0, PyLong_FromLongLong(n));
-    for (size_t i = 1; i < st->input_dims.size(); i++) {
-      PyTuple_SetItem(shape, i, PyLong_FromLongLong(st->input_dims[i]));
-    }
-    PyObject *xr = PyObject_CallMethod(xa, "reshape", "(O)", shape);
-    Py_DECREF(shape);
-    Py_DECREF(xa);
-    if (!xr) { set_error_from_python(); return -1.0; }
-    xa = xr;
-  }
+  xa = reshape_to_input_dims(st, xa, n);
+  if (!xa) return -1.0;
   PyObject *ya = np_from_buffer(y, n, "int32", n, 1);
   if (!ya) { Py_DECREF(xa); return -1.0; }
   PyObject *args = PyTuple_Pack(2, xa, ya);
@@ -571,6 +558,7 @@ double ffc_model_eval(ffc_model_t handle, const float *x, const int32_t *y,
     PyObject *cf = PyNumber_Float(c);
     double all = (double)PyLong_AsLongLong(a);
     if (cf && all > 0) res = PyFloat_AsDouble(cf) / all;
+    else g_error = "eval saw zero full batches (n < batch_size?)";
     Py_XDECREF(cf);
   }
   Py_XDECREF(c);

@@ -87,7 +87,10 @@ int main(void) {
    * state back — a no-op restore would change predictions */
   float before[4];
   memcpy(before, probs, sizeof(before));
-  ffc_model_fit(model, xd, yd, n, 16, 4);
+  if (ffc_model_fit(model, xd, yd, n, 16, 4) < 0) {
+    fprintf(stderr, "perturb fit: %s\n", ffc_last_error());
+    return 1;  /* an unchecked no-op here would make the round trip vacuous */
+  }
   if (ffc_model_restore_checkpoint(model, "/tmp/ffc_ckpt") != 0) {
     fprintf(stderr, "restore_checkpoint: %s\n", ffc_last_error());
     return 1;

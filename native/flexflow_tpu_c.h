@@ -111,7 +111,9 @@ int ffc_model_restore_checkpoint(ffc_model_t model, const char *path);
 /* write the compiled strategy as JSON (the --export-strategy flow) */
 int ffc_model_export_strategy(ffc_model_t model, const char *path);
 
-/* eval accuracy over (x, y); in [0,1], or -1 on error */
+/* eval accuracy over (x, y) in [0,1]; evaluates floor(n/batch_size)
+ * full batches (a trailing partial batch is skipped); -1 on error or
+ * when n < batch_size (ffc_last_error explains) */
 double ffc_model_eval(ffc_model_t model, const float *x, const int32_t *y,
                       int64_t n, int64_t x_row_elems);
 

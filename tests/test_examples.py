@@ -35,6 +35,7 @@ def _run(script, *flags, timeout=420):
     ("resnet_torch_import.py", ("-b", "8",)),
     ("inception_v3.py", ("-b", "4",)),
     ("candle_uno.py", ("-b", "16",)),
+    ("dlrm_train.py", ("-b", "32",)),
 ])
 def test_example_runs(script, flags):
     out = _run(script, *flags)
