@@ -557,8 +557,13 @@ double ffc_model_eval(ffc_model_t handle, const float *x, const int32_t *y,
     // train_correct may be a float (slot-averaged counts)
     PyObject *cf = PyNumber_Float(c);
     double all = (double)PyLong_AsLongLong(a);
-    if (cf && all > 0) res = PyFloat_AsDouble(cf) / all;
-    else g_error = "eval saw zero full batches (n < batch_size?)";
+    if (PyErr_Occurred() || !cf) {
+      set_error_from_python();  // conversion failure, not a batch problem
+    } else if (all > 0) {
+      res = PyFloat_AsDouble(cf) / all;
+    } else {
+      g_error = "eval saw zero full batches (n < batch_size?)";
+    }
     Py_XDECREF(cf);
   }
   Py_XDECREF(c);

@@ -1,0 +1,71 @@
+"""Randomized end-to-end robustness: random layer stacks must survive
+search + compile + train on the 8-device CPU mesh with finite loss.
+(The reference's integration suite runs ~40 fixed example scripts,
+multi_gpu_tests.sh; this adds a seeded randomized net on top.)"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    AdamOptimizer,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+)
+
+
+def _random_model(ff, rs, in_dim, n_classes):
+    x = ff.create_tensor((ff.config.batch_size, in_dim), DataType.FLOAT,
+                         name="input")
+    t = x
+    width = in_dim
+    n_layers = rs.randint(2, 6)
+    for i in range(n_layers):
+        kind = rs.choice(["dense", "dense_act", "norm", "dropout",
+                          "branch", "residual"])
+        if kind == "dense":
+            width = int(rs.choice([32, 64, 128]))
+            t = ff.dense(t, width, use_bias=bool(rs.randint(2)),
+                         name=f"d{i}")
+        elif kind == "dense_act":
+            width = int(rs.choice([32, 64, 128]))
+            t = ff.dense(t, width, name=f"d{i}")
+            t = [ff.relu, ff.gelu, ff.silu][rs.randint(3)](t, name=f"a{i}")
+        elif kind == "norm":
+            t = ff.layer_norm(t, axes=(-1,), name=f"ln{i}")
+        elif kind == "dropout":
+            t = ff.dropout(t, 0.1, name=f"dr{i}")
+        elif kind == "branch":
+            # split into two dense branches and concat
+            a = ff.dense(t, 32, name=f"ba{i}")
+            b = ff.dense(t, 32, name=f"bb{i}")
+            t = ff.concat([a, b], axis=1, name=f"cat{i}")
+            width = 64
+        elif kind == "residual":
+            a = ff.dense(t, width, name=f"ra{i}")
+            t = ff.add(t, a, name=f"res{i}")
+    t = ff.dense(t, n_classes, name="head")
+    return ff.softmax(t, name="softmax")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_graph_search_compile_train(seed):
+    rs = np.random.RandomState(seed)
+    in_dim, n_classes = 48, 4
+    cfg = FFConfig(batch_size=16, seed=seed, num_devices=8,
+                   mesh_shape={"data": 2, "model": 4},
+                   search_budget=int(rs.choice([0, 3, 8])))
+    ff = FFModel(cfg)
+    _random_model(ff, rs, in_dim, n_classes)
+    ff.compile(optimizer=AdamOptimizer(lr=1e-3),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY])
+    x = rs.randn(32, in_dim).astype(np.float32)
+    y = rs.randint(0, n_classes, 32).astype(np.int32)
+    m = ff.fit(x, y, epochs=1, verbose=False)
+    assert np.isfinite(m.sparse_cce_loss)
+    p = ff.predict(x[:16])
+    assert p.shape == (16, n_classes)
+    assert np.isfinite(np.asarray(p)).all()
