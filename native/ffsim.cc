@@ -98,9 +98,11 @@ double ffsim_eval(const SimGraph* g, const int* a, double overlap,
 
 // Event-driven two-channel list scheduling (reference simulate_runtime):
 // compute tasks serialize on the compute channel, comm tasks (edge xfers +
-// node collectives) on the ICI channel; a node starts when its inputs'
-// xfers complete. Returns the makespan plus the serialized gradient syncs
-// (they overlap the backward wave on real HW; modeled as a tail here).
+// node collectives + weight-gradient syncs) on the ICI channel; a node
+// starts when its inputs' xfers complete. Gradient syncs are scheduled on
+// the comm channel as their producing node finishes — overlapping later
+// compute exactly as XLA overlaps allreduce with the remaining backward
+// wave — rather than summed as a serial tail.
 double ffsim_simulate(const SimGraph* g, const int* a) {
   std::vector<int> indeg(g->n_nodes, 0);
   for (const Edge& e : g->edges) indeg[e.dst]++;
@@ -110,7 +112,7 @@ double ffsim_simulate(const SimGraph* g, const int* a) {
   std::priority_queue<Item, std::vector<Item>, std::greater<Item>> q;
   for (int i = 0; i < g->n_nodes; ++i)
     if (indeg[i] == 0) q.push({0.0, i});
-  double compute_free = 0.0, comm_free = 0.0, sync_total = 0.0;
+  double compute_free = 0.0, comm_free = 0.0;
   double makespan = 0.0;
   while (!q.empty()) {
     auto [t, u] = q.top();
@@ -124,7 +126,6 @@ double ffsim_simulate(const SimGraph* g, const int* a) {
       end = cstart + g->comm[u][k];
       comm_free = end;
     }
-    sync_total += g->sync[u][k];
     makespan = std::max(makespan, end);
     for (int ei : g->out_edges[u]) {
       const Edge& e = g->edges[ei];
@@ -138,8 +139,17 @@ double ffsim_simulate(const SimGraph* g, const int* a) {
       ready[e.dst] = std::max(ready[e.dst], arrive);
       if (--indeg[e.dst] == 0) q.push({ready[e.dst], e.dst});
     }
+    if (g->sync[u][k] > 0.0) {
+      // grad allreduce: async on the comm channel, scheduled AFTER the
+      // node's outgoing xfers — blocking activation transfers keep
+      // priority, the allreduce fills the gaps (XLA's async collectives)
+      double sstart = std::max(end, comm_free);
+      double send = sstart + g->sync[u][k];
+      comm_free = send;
+      makespan = std::max(makespan, send);
+    }
   }
-  return makespan + sync_total;
+  return makespan;
 }
 
 // Simulated-annealing search (reference mcmc_optimize): propose "random

@@ -336,3 +336,29 @@ def test_logical_traffic_matrix_llama_tp():
     )
     assert tm_dp["model"] == tm_dp["data"]  # same sync bytes on each axis
     assert tm_dp["data"] > tm.get("data", 0)  # DP syncs FULL weights
+
+
+def test_native_simulator_overlaps_grad_sync():
+    """The event simulator schedules gradient allreduces on the comm
+    channel as each node finishes — overlapping later compute like XLA's
+    async collectives — instead of paying them as a serial tail. So for a
+    compute-heavy chain with syncs, simulate < summed-eval(overlap=0),
+    but never below the pure compute bound."""
+    from flexflow_tpu import native
+
+    if not native.available():
+        pytest.skip("libffsim not built")
+    g = native.NativeSimGraph(4)
+    # chain of 4 nodes: 10ms compute each, 6ms grad sync each, no xfers
+    for i in range(4):
+        g.set_node(i, [10.0], [0.0], [6.0], [1.0])
+    for i in range(3):
+        g.add_edge(i, i + 1, [[0.0]])
+    assign = [0, 0, 0, 0]
+    summed, _ = g.eval(assign, overlap=0.0)
+    sim = g.simulate(assign)
+    assert summed == pytest.approx(64.0)   # 40 compute + 24 sync
+    assert sim < summed                    # syncs overlap later compute
+    assert sim >= 40.0                     # compute channel is the floor
+    # first 3 syncs hide under the remaining compute; the last one tails
+    assert sim == pytest.approx(46.0)
