@@ -857,6 +857,48 @@ class FFModel:
             print(f"eval: {pm.report(self._metrics)}")
         return pm
 
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+        """Autoregressive generation with a KV cache (net-new vs the
+        reference, which has no decode path): one prefill pass writes the
+        prompt's K/V into per-layer caches, then single-token steps extend
+        them. temperature=0 is greedy; >0 samples. Returns
+        [batch, max_new_tokens] int32 tokens."""
+        import jax
+        import jax.numpy as jnp
+
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        ex = self.executor
+        prompt_ids = np.asarray(prompt_ids, np.int32)
+        b, s = prompt_ids.shape
+        caches = ex.init_kv_cache(b, s + max_new_tokens)
+        step = ex.decode_fn()
+        tr, ntr = self._params
+        rng = jax.random.key(seed)
+
+        def pick(probs, rng):
+            # sink softmax already normalized; sample or argmax the LAST
+            # position
+            p = probs[:, -1, :]
+            if temperature <= 0.0:
+                return jnp.argmax(p, axis=-1).astype(jnp.int32)
+            logits = jnp.log(jnp.maximum(p, 1e-30)) / temperature
+            return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+        probs, caches = step(tr, ntr, caches, 0, jnp.asarray(prompt_ids))
+        rng, sub = jax.random.split(rng)
+        tok = pick(probs, sub)
+        out = [tok]
+        pos = s
+        for _ in range(max_new_tokens - 1):
+            probs, caches = step(tr, ntr, caches, pos, tok[:, None])
+            rng, sub = jax.random.split(rng)
+            tok = pick(probs, sub)
+            out.append(tok)
+            pos += 1
+        return np.stack([np.asarray(t) for t in out], axis=1)
+
     def serve(self, batch_sizes=(1, 8), max_delay_ms: float = 2.0,
               warmup: bool = True):
         """Start a serving endpoint over this compiled model (the

@@ -137,8 +137,11 @@ def apply_rope(x, theta: float, pos_offset=0):
 
 
 def _dot_product_attention(q, k, v, causal: bool, scale: float,
-                           dropout_rate: float = 0.0, dropout_rng=None):
-    """q: (B,S,H,D), k/v: (B,T,Hkv,D) -> (B,S,H,D). fp32 softmax accumulate."""
+                           dropout_rate: float = 0.0, dropout_rng=None,
+                           mask=None):
+    """q: (B,S,H,D), k/v: (B,T,Hkv,D) -> (B,S,H,D). fp32 softmax accumulate.
+    `mask` (S, T) overrides the causal triangle (KV-cache decode passes the
+    absolute-position mask)."""
     B, S, H, D = q.shape
     T, Hkv = k.shape[1], k.shape[2]
     if Hkv != H:
@@ -147,8 +150,9 @@ def _dot_product_attention(q, k, v, causal: bool, scale: float,
         v = jnp.repeat(v, rep, axis=2)
     logits = jnp.einsum("bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
-    if causal:
+    if mask is None and causal:
         mask = jnp.tril(jnp.ones((S, T), dtype=bool))
+    if mask is not None:
         logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     if dropout_rate > 0.0 and dropout_rng is not None:
@@ -238,15 +242,40 @@ def _mha(attrs, inputs, params, ctx):
         q = q + params["bq"].astype(dt)
         k = k + params["bk"].astype(dt)
         v = v + params["bv"].astype(dt)
-    if attrs.rope:
-        q = apply_rope(q, attrs.rope_theta)
-        k = apply_rope(k, attrs.rope_theta)
-    drop_rng = ctx.rng if (ctx.training and attrs.dropout > 0.0) else None
-    out = fused_attention(
-        q, k, v, causal=attrs.causal, scale=1.0 / (hd**0.5),
-        dropout=attrs.dropout if ctx.training else 0.0, dropout_rng=drop_rng,
-        mesh=ctx.mesh,
-    )
+    if ctx.kv_cache is not None:
+        # autoregressive decode/prefill: rope at absolute positions, append
+        # k/v into the cache, attend over everything written so far via the
+        # SHARED fp32-accumulating attention (mask = causal over absolute
+        # positions; slots past the write head are masked out)
+        pos = ctx.cache_position
+        if attrs.rope:
+            q = apply_rope(q, attrs.rope_theta, pos_offset=pos)
+            k = apply_rope(k, attrs.rope_theta, pos_offset=pos)
+        kc = lax.dynamic_update_slice(
+            ctx.kv_cache["k"], k.astype(ctx.kv_cache["k"].dtype), (0, pos, 0, 0)
+        )
+        vc = lax.dynamic_update_slice(
+            ctx.kv_cache["v"], v.astype(ctx.kv_cache["v"].dtype), (0, pos, 0, 0)
+        )
+        ctx.cache_updates["k"] = kc
+        ctx.cache_updates["v"] = vc
+        qpos = pos + jnp.arange(q.shape[1])          # absolute q positions
+        kpos = jnp.arange(kc.shape[1])               # cache slots
+        mask = kpos[None, :] <= qpos[:, None]
+        out = _dot_product_attention(
+            q, kc.astype(dt), vc.astype(dt), causal=False,
+            scale=1.0 / (hd**0.5), mask=mask,
+        )
+    else:
+        if attrs.rope:
+            q = apply_rope(q, attrs.rope_theta)
+            k = apply_rope(k, attrs.rope_theta)
+        drop_rng = ctx.rng if (ctx.training and attrs.dropout > 0.0) else None
+        out = fused_attention(
+            q, k, v, causal=attrs.causal, scale=1.0 / (hd**0.5),
+            dropout=attrs.dropout if ctx.training else 0.0,
+            dropout_rng=drop_rng, mesh=ctx.mesh,
+        )
     y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
     if attrs.use_bias:
         y = y + params["bo"].astype(dt)

@@ -28,6 +28,13 @@ class LowerCtx:
     # the node's assigned ShardingView (composites like PIPELINE dispatch
     # on it: a pipe-sharded view selects the GPipe schedule)
     sharding: Optional[object] = None
+    # autoregressive decoding (net-new vs the reference): when kv_cache is
+    # set ({"k","v"} buffers for THIS attention node) the MHA lowering
+    # attends over the cache at cache_position and writes the updated
+    # buffers into cache_updates
+    kv_cache: Optional[dict] = None
+    cache_position: Optional[object] = None
+    cache_updates: Dict[str, object] = dataclasses.field(default_factory=dict)
     # lowering writes non-trainable state updates here (BatchNorm running
     # stats, Cache buffers): key = weight name within the op
     state_updates: Dict[str, object] = dataclasses.field(default_factory=dict)

@@ -108,6 +108,7 @@ class Executor:
         self._train_step = None
         self._eval_step = None
         self._forward = None
+        self._decode_fn = None
 
     # ------------------------------------------------------------------
     # parameter creation
@@ -274,10 +275,13 @@ class Executor:
         return out
 
     def run_forward(self, trainable, nontrainable, inputs: Sequence, *,
-                    training: bool, rng, skip_sink_softmax: bool = False):
+                    training: bool, rng, skip_sink_softmax: bool = False,
+                    kv_caches=None, cache_position=None, cache_out=None):
         """Topo-order lowering. Returns (sink output, state_updates, aux_loss).
         With `skip_sink_softmax` the final Softmax node passes its input
-        (raw logits) through — used when the loss fuses the softmax."""
+        (raw logits) through — used when the loss fuses the softmax.
+        `kv_caches`/`cache_position` switch attention nodes into
+        autoregressive cache mode; updated buffers land in `cache_out`."""
         values: Dict[Tuple[int, int], Any] = {}
         if len(inputs) != len(self.input_nodes):
             raise ValueError(
@@ -304,6 +308,9 @@ class Executor:
                 seq_length=self.seq_length,
                 node_guid=n.guid,
                 sharding=n.sharding,
+                kv_cache=(kv_caches.get(key) if kv_caches is not None
+                          else None),
+                cache_position=cache_position,
             )
             if (
                 skip_sink_softmax
@@ -337,6 +344,8 @@ class Executor:
                     aux_loss = aux_loss + aux
                 if ctx.state_updates:
                     state_updates[key] = dict(ctx.state_updates)
+            if ctx.cache_updates and cache_out is not None:
+                cache_out[key] = dict(ctx.cache_updates)
         return values[(self.sink.guid, 0)], state_updates, aux_loss
 
     # ------------------------------------------------------------------
@@ -429,6 +438,50 @@ class Executor:
 
         self._eval_step = jax.jit(step)
         return self._eval_step
+
+    def init_kv_cache(self, batch: int, max_len: int, dtype=None):
+        """Per-attention-node K/V buffers for autoregressive decoding
+        (net-new vs the reference, which has no generation path). Buffer
+        dtype follows each attention's activation dtype unless given."""
+        caches = {}
+        for n in self.topo:
+            if n.op_type != OpType.MULTIHEAD_ATTENTION:
+                continue
+            hd = n.attrs.kdim
+            kv = n.attrs.num_kv
+            dt = dtype
+            if dt is None:
+                ins = self.graph.input_shapes(n)
+                dt = ins[0].dtype.jnp_dtype if ins else jnp.bfloat16
+            shape = (batch, max_len, kv, hd)
+            caches[node_key(n)] = {
+                "k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)
+            }
+        if not caches:
+            raise ValueError(
+                "generate() needs MULTIHEAD_ATTENTION nodes (ring/Ulysses "
+                "and PIPELINE composites have no decode path)"
+            )
+        return caches
+
+    def decode_fn(self):
+        """jitted (params, caches, pos, ids) -> (probs, new_caches): one
+        prefill or decode step through the cached-attention lowering.
+        Compiled once per input seq length (prompt prefill + S=1 steps)."""
+        if self._decode_fn is not None:
+            return self._decode_fn
+
+        def step(trainable, nontrainable, caches, pos, *inputs):
+            cache_out = {}
+            out, _, _ = self.run_forward(
+                trainable, nontrainable, inputs, training=False,
+                rng=jax.random.key(0), kv_caches=caches,
+                cache_position=pos, cache_out=cache_out,
+            )
+            return out, cache_out
+
+        self._decode_fn = jax.jit(step)
+        return self._decode_fn
 
     def forward_fn(self):
         """Inference forward (predict)."""

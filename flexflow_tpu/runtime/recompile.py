@@ -35,6 +35,7 @@ class RecompileState:
             ex._train_step = None
             ex._eval_step = None
             ex._forward = None
+            ex._decode_fn = None
 
 
 def recompile_on_condition(ffmodel, state: RecompileState) -> bool:
