@@ -284,6 +284,7 @@ def test_torch_fx_hf_rmsnorm_coalescing():
     mean/rsqrt subgraph), its weight copies over, and numerics match
     torch."""
     torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
     from transformers.models.t5.modeling_t5 import T5LayerNorm
 
     import torch.nn as nn
@@ -323,6 +324,7 @@ def test_torch_fx_hf_rmsnorm_coalescing():
 
 def test_torch_fx_rmsnorm_text_ir_roundtrip(tmp_path):
     torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
     from transformers.models.t5.modeling_t5 import T5LayerNorm
 
     import torch.nn as nn
