@@ -86,9 +86,15 @@ def _node_pred_out_ndim(n: Node, ndim: int) -> bool:
     return bool(n.outputs) and n.outputs[0].ndim == ndim
 
 
+def _node_pred_activation_in(n: Node, names: Sequence[str]) -> bool:
+    act = getattr(n.attrs, "activation", None)
+    return act is not None and act.name in names
+
+
 NODE_PREDICATES: Dict[str, Callable[[Node, Any], bool]] = {
     "no_weight_sharding": _node_pred_no_weight_sharding,
     "activation": _node_pred_activation,
+    "activation_in": _node_pred_activation_in,
     "attr_eq": _node_pred_attr_eq,
     "unary_kind": _node_pred_unary_kind,
     "out_ndim": _node_pred_out_ndim,
@@ -110,9 +116,38 @@ def _where_attrs_equal(nodes: Dict[str, Node], args: Sequence) -> bool:
     return all(v == vals[0] for v in vals)
 
 
+def _where_concat_undoes_split(nodes: Dict[str, Node], args: Sequence) -> bool:
+    """concat(split(x)) == x when axes agree, the split has exactly the
+    arity the pattern consumes (args[2]) — a wider split with extra parts
+    must not cancel — and parts arrive in order (pattern edges pin it)."""
+    sp, cat = nodes[args[0]], nodes[args[1]]
+    if len(sp.attrs.sizes) != args[2]:
+        return False
+    return getattr(sp.attrs, "axis", None) == getattr(cat.attrs, "axis", None)
+
+
+def _where_split_undoes_concat(nodes: Dict[str, Node], args: Sequence) -> bool:
+    """split(concat(a, b)) == (a, b) iff the split sizes reproduce the
+    concatenated operand sizes along the same axis."""
+    cat, sp = nodes[args[0]], nodes[args[1]]
+    ax = getattr(cat.attrs, "axis", None)
+    if ax != getattr(sp.attrs, "axis", None) or not cat.in_shapes:
+        return False
+    in_sizes = tuple(s.dims[ax].size for s in cat.in_shapes)
+    return in_sizes == tuple(sp.attrs.sizes)
+
+
+def _where_cast_identity(nodes: Dict[str, Node], args: Sequence) -> bool:
+    n = nodes[args[0]]
+    return bool(n.in_shapes) and n.in_shapes[0].dtype == n.attrs.dtype
+
+
 WHERE_PREDICATES: Dict[str, Callable[[Dict[str, Node], Any], bool]] = {
     "perms_inverse": _where_perms_inverse,
     "attrs_equal": _where_attrs_equal,
+    "concat_undoes_split": _where_concat_undoes_split,
+    "split_undoes_concat": _where_split_undoes_concat,
+    "cast_identity": _where_cast_identity,
 }
 
 
@@ -166,21 +201,34 @@ def find_matches(rule: Dict, graph: Graph) -> List[Match]:
     order = [s["id"] for s in specs]
     matches: List[Match] = []
 
-    # symmetry breaking: pattern nodes with identical specs and no internal
-    # edge ordering them are interchangeable — without this, a symmetric
-    # 2-root pattern (merge_parallel_linears) matches every pair twice and
-    # both mirrored rewrites get fully evaluated by the search
+    # symmetry breaking: pattern nodes with identical specs AND identical
+    # pattern roles (same edge/input/output signature) are interchangeable —
+    # without this, a symmetric 2-root pattern (merge_parallel_linears,
+    # cse_*) matches every pair twice and both mirrored rewrites get fully
+    # evaluated by the search. Role equality matters: in gated_mlp the gate
+    # and up linears share a spec but feed DIFFERENT pattern nodes, so
+    # pruning by guid order there would drop valid matches.
     spec_key = {
         s["id"]: json.dumps({k: v for k, v in s.items() if k != "id"},
                             sort_keys=True, default=str)
         for s in specs
     }
-    linked = {(e[0], e[2]) for e in pedges} | {(e[2], e[0]) for e in pedges}
+
+    def role_sig(pid: str) -> str:
+        outs = sorted((si, did, di) for (sid, si, did, di) in pedges if sid == pid)
+        ins = sorted((sid, si, di) for (sid, si, did, di) in pedges if did == pid)
+        ext = sorted((iid, didx) for (iid, did, didx) in pinputs if did == pid)
+        pouts = sorted(oidx for (nid, oidx) in poutputs if nid == pid)
+        return json.dumps([outs, ins, ext, pouts])
+
     sym_prev: Dict[str, str] = {}
     for i, s in enumerate(specs):
-        for p in specs[:i]:
+        # nearest symmetric predecessor, so 3+ interchangeable nodes chain
+        # a<b<c into a total order (first-predecessor chaining would leave
+        # b and c mutually unordered and admit mirrored matches)
+        for p in reversed(specs[:i]):
             if (spec_key[p["id"]] == spec_key[s["id"]]
-                    and (p["id"], s["id"]) not in linked):
+                    and role_sig(p["id"]) == role_sig(s["id"])):
                 sym_prev[s["id"]] = p["id"]
                 break
 
@@ -427,10 +475,299 @@ def default_decl_xfers(axis_sizes: Dict[str, int]) -> List[DeclXfer]:
     return load_rules(DEFAULT_RULES_PATH, axis_sizes)
 
 
+def _bspec(ndim: int, last: Sequence[str] = ()) -> list:
+    """JSON output spec: dim0 on `data`, middle dims replicated, last dim
+    on `last` — the canonical activation sharding of a DP×TP view."""
+    return [["data"]] + [[] for _ in range(ndim - 2)] + [list(last)]
+
+
+def _nd_suffix(ndim: int) -> str:
+    return "" if ndim == 2 else f"_{ndim}d"
+
+
+def _rule_linear_col_tp(axis: str, ndim: int) -> Dict:
+    """Linear -> column-TP linear + Combine over `axis` (the declarative
+    create_partition_linear_combine, substitution.cc:1809, per mesh axis
+    and activation rank)."""
+    return {
+        "name": f"partition_linear_combine_{axis}{_nd_suffix(ndim)}",
+        "requires_axis": axis,
+        "src": {
+            "nodes": [{"id": "l", "type": "LINEAR",
+                       "when": {"no_weight_sharding": True,
+                                "attr_eq": ["use_bias", False],
+                                "out_ndim": ndim}}],
+            "inputs": [["x", "l", 0]],
+            "outputs": [["l", 0]],
+        },
+        "dst": {
+            "nodes": [
+                {"id": "l2", "type": "LINEAR", "reuse": "l",
+                 "name": "{l}", "attrs": {"$copy": "l"},
+                 "sharding": {
+                     "outputs": [_bspec(ndim, [axis])],
+                     "weights": {"kernel": [[], [axis]]},
+                 }},
+                {"id": "comb", "type": "COMBINE", "name": "{l}_combine",
+                 "attrs": {"dim": ndim - 1, "axes": [axis]},
+                 "sharding": {"outputs": [_bspec(ndim)], "weights": {}}},
+            ],
+            "edges": [["l2", 0, "comb", 0]],
+            "inputs": [["x", "l2", 0]],
+            "outputs": [["comb", 0]],
+        },
+    }
+
+
+def _rule_linear_row_tp(axis: str, ndim: int) -> Dict:
+    """Linear -> row-TP: kernel sharded on in_dim, partial sums resolved by
+    an explicit Reduction (create_replicate_linear_combine,
+    substitution.cc:1756). Activation must be NONE — it doesn't commute
+    with the partial-sum reduction."""
+    return {
+        "name": f"replicate_linear_reduce_{axis}{_nd_suffix(ndim)}",
+        "requires_axis": axis,
+        "src": {
+            "nodes": [{"id": "l", "type": "LINEAR",
+                       "when": {"no_weight_sharding": True,
+                                "activation": "NONE",
+                                "attr_eq": ["use_bias", False],
+                                "out_ndim": ndim}}],
+            "inputs": [["x", "l", 0]],
+            "outputs": [["l", 0]],
+        },
+        "dst": {
+            "nodes": [
+                {"id": "l2", "type": "LINEAR", "reuse": "l",
+                 "name": "{l}", "attrs": {"$copy": "l"},
+                 "sharding": {"outputs": [],
+                              "weights": {"kernel": [[axis], []]}}},
+                {"id": "red", "type": "REDUCTION", "name": "{l}_reduce",
+                 "attrs": {"axes": [axis]},
+                 "sharding": {"outputs": [_bspec(ndim)], "weights": {}}},
+            ],
+            "edges": [["l2", 0, "red", 0]],
+            "inputs": [["x", "l2", 0]],
+            "outputs": [["red", 0]],
+        },
+    }
+
+
+def _rule_megatron_mlp(axis: str, ndim: int, fused: bool) -> Dict:
+    """The 2-matmul TP chain rewrite (Megatron MLP): column-TP first linear,
+    activation computed shard-local, row-TP second linear, ONE Reduction at
+    the end — the single rewrite that jumps the resharding-cost barrier a
+    per-node view search must climb in two uphill moves. `fused` matches the
+    post-fusion form (activation folded into the first linear by the
+    fuse_linear_* rules), the unfused form matches the explicit
+    linear->unary->linear chain."""
+    lin_when = {"no_weight_sharding": True, "activation": "NONE",
+                "attr_eq": ["use_bias", False], "out_ndim": ndim}
+    up_when = (
+        {"no_weight_sharding": True,
+         "activation_in": ["RELU", "GELU", "SILU", "SIGMOID", "TANH"],
+         "attr_eq": ["use_bias", False], "out_ndim": ndim}
+        if fused else dict(lin_when)
+    )
+    col = {"outputs": [_bspec(ndim, [axis])],
+           "weights": {"kernel": [[], [axis]]}}
+    src_nodes = [{"id": "up", "type": "LINEAR", "when": up_when}]
+    src_edges = []
+    dst_nodes = [{"id": "up2", "type": "LINEAR", "reuse": "up",
+                  "name": "{up}", "attrs": {"$copy": "up"}, "sharding": col}]
+    dst_edges = []
+    mid, dmid = "up", "up2"
+    if not fused:
+        src_nodes.append({"id": "act", "type": "ELEMENT_UNARY",
+                          "when": {"unary_kind": ["relu", "gelu", "silu",
+                                                  "sigmoid", "tanh"]}})
+        src_edges.append(["up", 0, "act", 0])
+        dst_nodes.append({"id": "act2", "type": "ELEMENT_UNARY",
+                          "reuse": "act", "name": "{act}",
+                          "attrs": {"$copy": "act"},
+                          "sharding": {"outputs": [_bspec(ndim, [axis])],
+                                       "weights": {}}})
+        dst_edges.append(["up2", 0, "act2", 0])
+        mid, dmid = "act", "act2"
+    src_nodes.append({"id": "down", "type": "LINEAR", "when": lin_when})
+    src_edges.append([mid, 0, "down", 0])
+    dst_nodes += [
+        {"id": "down2", "type": "LINEAR", "reuse": "down", "name": "{down}",
+         "attrs": {"$copy": "down"},
+         "sharding": {"outputs": [], "weights": {"kernel": [[axis], []]}}},
+        {"id": "red", "type": "REDUCTION", "name": "{down}_reduce",
+         "attrs": {"axes": [axis]},
+         "sharding": {"outputs": [_bspec(ndim)], "weights": {}}},
+    ]
+    dst_edges += [[dmid, 0, "down2", 0], ["down2", 0, "red", 0]]
+    return {
+        "name": (f"megatron_mlp{'_fused' if fused else ''}_{axis}"
+                 f"{_nd_suffix(ndim)}"),
+        "requires_axis": axis,
+        "src": {"nodes": src_nodes, "edges": src_edges,
+                "inputs": [["x", "up", 0]], "outputs": [["down", 0]]},
+        "dst": {"nodes": dst_nodes, "edges": dst_edges,
+                "inputs": [["x", "up2", 0]], "outputs": [["red", 0]]},
+    }
+
+
+def _rule_gated_mlp(axis: str, ndim: int) -> Dict:
+    """The gated-FFN TP chain (Llama/Mixtral dense block): gate and up
+    linears column-TP off the SAME input, silu and the gating multiply
+    shard-local, down linear row-TP, one Reduction — discovers the whole
+    llama_tp_strategy FFN assignment in a single rewrite."""
+    lw = {"no_weight_sharding": True, "activation": "NONE",
+          "attr_eq": ["use_bias", False], "out_ndim": ndim}
+    col = {"outputs": [_bspec(ndim, [axis])],
+           "weights": {"kernel": [[], [axis]]}}
+    eltw = {"outputs": [_bspec(ndim, [axis])], "weights": {}}
+    return {
+        "name": f"gated_mlp_{axis}{_nd_suffix(ndim)}",
+        "requires_axis": axis,
+        "src": {
+            "nodes": [
+                {"id": "gate", "type": "LINEAR", "when": lw},
+                {"id": "up", "type": "LINEAR", "when": lw},
+                {"id": "act", "type": "ELEMENT_UNARY",
+                 "when": {"unary_kind": ["silu", "gelu", "sigmoid"]}},
+                {"id": "mul", "type": "ELEMENT_BINARY",
+                 "when": {"attr_eq": ["kind", "multiply"]}},
+                {"id": "down", "type": "LINEAR", "when": lw},
+            ],
+            "edges": [["gate", 0, "act", 0], ["act", 0, "mul", 0],
+                      ["up", 0, "mul", 1], ["mul", 0, "down", 0]],
+            "inputs": [["x", "gate", 0], ["x", "up", 0]],
+            "outputs": [["down", 0]],
+        },
+        "dst": {
+            "nodes": [
+                {"id": "gate2", "type": "LINEAR", "reuse": "gate",
+                 "name": "{gate}", "attrs": {"$copy": "gate"}, "sharding": col},
+                {"id": "up2", "type": "LINEAR", "reuse": "up",
+                 "name": "{up}", "attrs": {"$copy": "up"}, "sharding": col},
+                {"id": "act2", "type": "ELEMENT_UNARY", "reuse": "act",
+                 "name": "{act}", "attrs": {"$copy": "act"}, "sharding": eltw},
+                {"id": "mul2", "type": "ELEMENT_BINARY", "reuse": "mul",
+                 "name": "{mul}", "attrs": {"$copy": "mul"}, "sharding": eltw},
+                {"id": "down2", "type": "LINEAR", "reuse": "down",
+                 "name": "{down}", "attrs": {"$copy": "down"},
+                 "sharding": {"outputs": [],
+                              "weights": {"kernel": [[axis], []]}}},
+                {"id": "red", "type": "REDUCTION", "name": "{down}_reduce",
+                 "attrs": {"axes": [axis]},
+                 "sharding": {"outputs": [_bspec(ndim)], "weights": {}}},
+            ],
+            "edges": [["gate2", 0, "act2", 0], ["act2", 0, "mul2", 0],
+                      ["up2", 0, "mul2", 1], ["mul2", 0, "down2", 0],
+                      ["down2", 0, "red", 0]],
+            "inputs": [["x", "gate2", 0], ["x", "up2", 0]],
+            "outputs": [["red", 0]],
+        },
+    }
+
+
+def _rule_merge_linears(n: int) -> Dict:
+    """TASO-style merge: n bias-free linears off the SAME input fuse into
+    one wide linear + split (exact given the concatenated-weight mapping).
+    n=2 is the classic pair merge; n=3 is the QKV shape."""
+    ids = ["a", "b", "c", "d"][:n]
+    when = {"activation": "NONE", "attr_eq": ["use_bias", False],
+            "out_ndim": 2}
+    stem = "_".join("{%s}" % i for i in ids)
+    return {
+        "name": "merge_parallel_linears" + ("" if n == 2 else f"_{n}"),
+        "src": {
+            "nodes": [{"id": i, "type": "LINEAR", "when": dict(when)}
+                      for i in ids],
+            "edges": [],
+            "inputs": [["x", i, 0] for i in ids],  # SHARED input
+            "outputs": [[i, 0] for i in ids],
+        },
+        "where": [{"kind": "attrs_equal", "args": ids + ["dtype"]}],
+        "dst": {
+            "nodes": [
+                {"id": "wide", "type": "LINEAR", "reuse": ids[0],
+                 "name": f"{stem}_merged",
+                 "attrs": {
+                     "out_dim": {"$sum": [{"$attr": [i, "out_dim"]}
+                                          for i in ids]},
+                     "use_bias": False,
+                     "dtype": {"$attr": [ids[0], "dtype"]},
+                 }},
+                {"id": "sp", "type": "SPLIT", "name": f"{stem}_split",
+                 "attrs": {
+                     "sizes": [{"$attr": [i, "out_dim"]} for i in ids],
+                     "axis": 1,
+                 }},
+            ],
+            "edges": [["wide", 0, "sp", 0]],
+            "inputs": [["x", "wide", 0]],
+            "outputs": [["sp", k] for k in range(n)],
+        },
+    }
+
+
+def _rule_cse(op_type: str, fields: Sequence[str]) -> Dict:
+    """Common-subexpression elimination for STATELESS ops only: two
+    same-attrs nodes consuming the same producer output collapse to one.
+    Never generated for ops with weights (two equal-attrs linears compute
+    different functions)."""
+    return {
+        "name": f"cse_{op_type.lower()}",
+        "src": {
+            "nodes": [{"id": "a", "type": op_type},
+                      {"id": "b", "type": op_type}],
+            "edges": [],
+            "inputs": [["x", "a", 0], ["x", "b", 0]],
+            "outputs": [["a", 0], ["b", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["a", "b", f]}
+                  for f in fields],
+        "dst": {
+            "nodes": [{"id": "n", "type": op_type, "reuse": "a",
+                       "name": "{a}", "attrs": {"$copy": "a"}}],
+            "inputs": [["x", "n", 0]],
+            "outputs": [["n", 0], ["n", 0]],
+        },
+    }
+
+
+def _rule_commute(first: str, second: str, name: str) -> Dict:
+    """Swap an elementwise unary with a layout op (TASO-style commutation:
+    unary(layout(x)) == layout(unary(x))). Opens fusion/cancellation
+    matches that the original order hides."""
+    return {
+        "name": name,
+        "src": {
+            "nodes": [{"id": "p", "type": first},
+                      {"id": "q", "type": second}],
+            "edges": [["p", 0, "q", 0]],
+            "inputs": [["x", "p", 0]],
+            "outputs": [["q", 0]],
+        },
+        "dst": {
+            "nodes": [
+                {"id": "q2", "type": second, "reuse": "q", "name": "{q}",
+                 "attrs": {"$copy": "q"}},
+                {"id": "p2", "type": first, "reuse": "p", "name": "{p}",
+                 "attrs": {"$copy": "p"}},
+            ],
+            "edges": [["q2", 0, "p2", 0]],
+            "inputs": [["x", "q2", 0]],
+            "outputs": [["p2", 0]],
+        },
+    }
+
+
 def gen_default_rules() -> List[Dict]:
     """Generate the shipped corpus from templates (the analog of the
     reference's TASO-generated graph_subst_3_v2.json; ours is generated
-    from algebraic templates instantiated over ops x activations x axes)."""
+    from algebraic templates instantiated over ops x activations x axes x
+    activation ranks). The reference corpus needs 640 entries because every
+    rule is pinned to a concrete parallel DEGREE (substitution_loader.cc
+    deserializes degree constants); named mesh axes make degree a property
+    of the mesh, so one rule here covers every degree of that axis and the
+    corpus stays inspectable."""
     rules: List[Dict] = []
 
     # --- fusion: linear (no act) + unary act -> linear(act) -------------
@@ -510,80 +847,17 @@ def gen_default_rules() -> List[Dict]:
     # deliberate truncation, so eliminating the intermediate cast would
     # change model outputs (semantics-preserving rules only).
 
-    # --- TASO-style merge: two linears sharing an input -> wide + split -
-    rules.append({
-        "name": "merge_parallel_linears",
-        "src": {
-            "nodes": [
-                {"id": "a", "type": "LINEAR",
-                 "when": {"activation": "NONE",
-                          "attr_eq": ["use_bias", False], "out_ndim": 2}},
-                {"id": "b", "type": "LINEAR",
-                 "when": {"activation": "NONE",
-                          "attr_eq": ["use_bias", False], "out_ndim": 2}},
-            ],
-            "edges": [],
-            "inputs": [["x", "a", 0], ["x", "b", 0]],  # SHARED input
-            "outputs": [["a", 0], ["b", 0]],
-        },
-        "where": [{"kind": "attrs_equal", "args": ["a", "b", "dtype"]}],
-        "dst": {
-            "nodes": [
-                {"id": "wide", "type": "LINEAR", "reuse": "a",
-                 "name": "{a}_{b}_merged",
-                 "attrs": {
-                     "out_dim": {"$sum": [{"$attr": ["a", "out_dim"]},
-                                          {"$attr": ["b", "out_dim"]}]},
-                     "use_bias": False,
-                     "dtype": {"$attr": ["a", "dtype"]},
-                 }},
-                {"id": "sp", "type": "SPLIT", "name": "{a}_{b}_split",
-                 "attrs": {
-                     "sizes": [{"$attr": ["a", "out_dim"]},
-                               {"$attr": ["b", "out_dim"]}],
-                     "axis": 1,
-                 }},
-            ],
-            "edges": [["wide", 0, "sp", 0]],
-            "inputs": [["x", "wide", 0]],
-            "outputs": [["sp", 0], ["sp", 1]],
-        },
-    })
+    # --- TASO-style merge: n linears sharing an input -> wide + split ---
+    rules.append(_rule_merge_linears(2))
 
     # --- parallelization rules (explicit parallel-op insertions) --------
-    # linear column/row TP per mesh axis (the hand-coded builders in
-    # substitution.py cover only "model"; these give the search the same
-    # moves on seq/expert axes of exotic meshes)
+    # linear column/row TP per mesh axis and activation rank (the
+    # hand-coded builders in substitution.py cover only "model"; these give
+    # the search the same moves on seq/expert axes of exotic meshes)
     for axis in ("seq", "expert"):
-        rules.append({
-            "name": f"partition_linear_combine_{axis}",
-            "requires_axis": axis,
-            "src": {
-                "nodes": [{"id": "l", "type": "LINEAR",
-                           "when": {"no_weight_sharding": True,
-                                    "attr_eq": ["use_bias", False],
-                                    "out_ndim": 2}}],
-                "inputs": [["x", "l", 0]],
-                "outputs": [["l", 0]],
-            },
-            "dst": {
-                "nodes": [
-                    {"id": "l2", "type": "LINEAR", "reuse": "l",
-                     "name": "{l}", "attrs": {"$copy": "l"},
-                     "sharding": {
-                         "outputs": [[["data"], [axis]]],
-                         "weights": {"kernel": [[], [axis]]},
-                     }},
-                    {"id": "comb", "type": "COMBINE", "name": "{l}_combine",
-                     "attrs": {"dim": 1, "axes": [axis]},
-                     "sharding": {"outputs": [[["data"], []]],
-                                  "weights": {}}},
-                ],
-                "edges": [["l2", 0, "comb", 0]],
-                "inputs": [["x", "l2", 0]],
-                "outputs": [["comb", 0]],
-            },
-        })
+        for ndim in (2, 3):
+            rules.append(_rule_linear_col_tp(axis, ndim))
+            rules.append(_rule_linear_row_tp(axis, ndim))
     for axis in ("model", "seq", "expert"):
         # conv2d output-channel TP + combine on the channel dim
         rules.append({
@@ -642,6 +916,151 @@ def gen_default_rules() -> List[Dict]:
                 "outputs": [["comb", 0]],
             },
         })
+
+    # --- TP chain rules: the one-move Megatron/Llama rewrites -----------
+    for axis in ("model", "seq", "expert"):
+        for ndim in (2, 3):
+            rules.append(_rule_megatron_mlp(axis, ndim, fused=False))
+            rules.append(_rule_megatron_mlp(axis, ndim, fused=True))
+            rules.append(_rule_gated_mlp(axis, ndim))
+
+    # --- fusion: conv2d (no act) + unary act -> conv2d(act) -------------
+    for act in ("RELU", "GELU", "SIGMOID", "TANH", "SILU"):
+        rules.append({
+            "name": f"fuse_conv2d_{act.lower()}",
+            "src": {
+                "nodes": [
+                    {"id": "c", "type": "CONV2D",
+                     "when": {"activation": "NONE"}},
+                    {"id": "act", "type": "ELEMENT_UNARY",
+                     "when": {"unary_kind": [act.lower()]}},
+                ],
+                "edges": [["c", 0, "act", 0]],
+                "inputs": [["x", "c", 0]],
+                "outputs": [["act", 0]],
+            },
+            "dst": {
+                "nodes": [
+                    {"id": "f", "type": "CONV2D", "reuse": "c",
+                     "name": "{c}",
+                     "attrs": {
+                         "out_channels": {"$attr": ["c", "out_channels"]},
+                         "kernel": {"$list_attr": ["c", "kernel"]},
+                         "stride": {"$list_attr": ["c", "stride"]},
+                         "padding": {"$list_attr": ["c", "padding"]},
+                         "groups": {"$attr": ["c", "groups"]},
+                         "use_bias": {"$attr": ["c", "use_bias"]},
+                         "activation": {"$enum": ["ActiMode", act]},
+                     }},
+                ],
+                "inputs": [["x", "f", 0]],
+                "outputs": [["f", 0]],
+            },
+        })
+
+    # --- cancellations ---------------------------------------------------
+    rules.append({
+        "name": "cancel_split_concat",
+        "src": {
+            "nodes": [{"id": "sp", "type": "SPLIT"},
+                      {"id": "cat", "type": "CONCAT"}],
+            "edges": [["sp", 0, "cat", 0], ["sp", 1, "cat", 1]],
+            "inputs": [["x", "sp", 0]],
+            "outputs": [["cat", 0]],
+        },
+        "where": [{"kind": "concat_undoes_split", "args": ["sp", "cat", 2]}],
+        "dst": {
+            "nodes": [{"id": "n", "type": "NOOP", "reuse": "cat",
+                       "name": "{cat}", "attrs": {}}],
+            "inputs": [["x", "n", 0]],
+            "outputs": [["n", 0]],
+        },
+    })
+    rules.append({
+        "name": "cancel_concat_split",
+        "src": {
+            "nodes": [{"id": "cat", "type": "CONCAT"},
+                      {"id": "sp", "type": "SPLIT"}],
+            "edges": [["cat", 0, "sp", 0]],
+            "inputs": [["a", "cat", 0], ["b", "cat", 1]],
+            "outputs": [["sp", 0], ["sp", 1]],
+        },
+        "where": [{"kind": "split_undoes_concat", "args": ["cat", "sp"]}],
+        "dst": {
+            "nodes": [
+                {"id": "n1", "type": "NOOP", "reuse": "sp",
+                 "name": "{sp}_a", "attrs": {}},
+                {"id": "n2", "type": "NOOP", "name": "{sp}_b", "attrs": {}},
+            ],
+            "inputs": [["a", "n1", 0], ["b", "n2", 0]],
+            "outputs": [["n1", 0], ["n2", 0]],
+        },
+    })
+    rules.append({
+        "name": "drop_dropout_zero",
+        "src": {
+            "nodes": [{"id": "d", "type": "DROPOUT",
+                       "when": {"attr_eq": ["rate", 0.0]}}],
+            "inputs": [["x", "d", 0]],
+            "outputs": [["d", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "n", "type": "NOOP", "reuse": "d",
+                       "name": "{d}", "attrs": {}}],
+            "inputs": [["x", "n", 0]],
+            "outputs": [["n", 0]],
+        },
+    })
+    rules.append({
+        "name": "drop_identity_unary",
+        "src": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["identity"]}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "n", "type": "NOOP", "reuse": "u",
+                       "name": "{u}", "attrs": {}}],
+            "inputs": [["x", "n", 0]],
+            "outputs": [["n", 0]],
+        },
+    })
+    rules.append({
+        "name": "drop_identity_cast",
+        "src": {
+            "nodes": [{"id": "c", "type": "CAST"}],
+            "inputs": [["x", "c", 0]],
+            "outputs": [["c", 0]],
+        },
+        "where": [{"kind": "cast_identity", "args": ["c"]}],
+        "dst": {
+            "nodes": [{"id": "n", "type": "NOOP", "reuse": "c",
+                       "name": "{c}", "attrs": {}}],
+            "inputs": [["x", "n", 0]],
+            "outputs": [["n", 0]],
+        },
+    })
+
+    # --- CSE for stateless ops -------------------------------------------
+    rules.append(_rule_cse("ELEMENT_UNARY", ["kind", "scalar"]))
+    rules.append(_rule_cse("TRANSPOSE", ["perm"]))
+    rules.append(_rule_cse("RESHAPE", ["shape"]))
+    rules.append(_rule_cse("SOFTMAX", ["axis"]))
+    rules.append(_rule_cse("CAST", ["dtype"]))
+
+    # --- commutation: move elementwise unaries across layout ops ---------
+    rules.append(_rule_commute("TRANSPOSE", "ELEMENT_UNARY",
+                               "commute_unary_before_transpose"))
+    rules.append(_rule_commute("ELEMENT_UNARY", "TRANSPOSE",
+                               "commute_transpose_before_unary"))
+    rules.append(_rule_commute("RESHAPE", "ELEMENT_UNARY",
+                               "commute_unary_before_reshape"))
+    rules.append(_rule_commute("ELEMENT_UNARY", "RESHAPE",
+                               "commute_reshape_before_unary"))
+
+    # --- 3-way merge (QKV-style: three linears off one input) ------------
+    rules.append(_rule_merge_linears(3))
 
     return rules
 

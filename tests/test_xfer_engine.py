@@ -177,3 +177,182 @@ def test_seq_axis_linear_tp_rule_on_modelless_mesh():
             for spec in list(v.output_specs) + list(v.weight_specs.values())
             if spec for axes in spec for a in axes}
     assert "seq" in used
+
+
+# ---------------------------------------------------------------------------
+# round-2 corpus expansion: chain rules, cancellations, CSE, commutation
+
+
+def test_gated_mlp_rule_rewrites_llama_ffn():
+    """The 5-node gated-FFN chain rule puts the whole Llama FFN TP
+    assignment (col gate/up, local silu/mul, row down + Reduction) into ONE
+    rewrite, and its modeled cost beats DP."""
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+    from flexflow_tpu.search.cost_model import CostModel, graph_cost
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.space import default_dp_strategy
+
+    ff = FFModel(FFConfig(batch_size=8))
+    build_llama(ff, LlamaConfig(vocab_size=512, dim=512, layers=1, heads=4,
+                                kv_heads=4, hidden=2048),
+                batch_size=8, seq_len=64)
+    ff.graph.infer_shapes()
+    rule = _rule("gated_mlp_model_3d")
+    cands = rule.apply_all(ff.graph)
+    assert len(cands) == 1, "exactly one FFN chain in a 1-layer llama"
+    g = cands[0]
+    red = [n for n in g.nodes if n.op_type == OpType.REDUCTION]
+    assert len(red) == 1
+    sharded = [n for n in g.nodes
+               if n.sharding is not None and n.sharding.weight_specs]
+    assert len(sharded) == 3, "gate/up/down all carry TP weight shardings"
+
+    axis_sizes = {"data": 2, "model": 4}
+    cost = CostModel(TPUMachineModel.make("v5e", 8), axis_sizes)
+    dp = default_dp_strategy(ff.graph, axis_sizes)
+    dp_time = graph_cost(ff.graph, dp, cost).time
+    strat = default_dp_strategy(g, axis_sizes)
+    strat.update({n.name: n.sharding for n in g.nodes if n.sharding})
+    assert graph_cost(g, strat, cost).time < dp_time
+
+
+def test_megatron_mlp_chain_rule():
+    """linear->gelu->linear rewrites to col-TP + local act + row-TP +
+    Reduction in one move (unfused), and after activation fusion the
+    2-node fused variant matches the same chain."""
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 4096), DataType.FLOAT, name="input")
+    t = ff.dense(x, 16384, use_bias=False, name="up")
+    t = ff.gelu(t, name="act")
+    t = ff.dense(t, 4096, use_bias=False, name="down")
+    ff.softmax(t, name="sm")
+    ff.graph.infer_shapes()
+    cands = _rule("megatron_mlp_model").apply_all(ff.graph)
+    assert len(cands) == 1
+    g = cands[0]
+    assert [n for n in g.nodes if n.op_type == OpType.REDUCTION]
+    down = [n for n in g.nodes if n.name == "down"][0]
+    assert down.sharding.weight_specs["kernel"] == (("model",), ())
+
+    # fused form: fold gelu into `up` first, then the 2-node variant fires
+    fused = _rule("fuse_linear_gelu").apply_all(ff.graph)[0]
+    cands2 = _rule("megatron_mlp_fused_model").apply_all(fused)
+    assert len(cands2) == 1
+    g2 = cands2[0]
+    assert [n for n in g2.nodes if n.op_type == OpType.REDUCTION]
+    up2 = [n for n in g2.nodes if n.name == "up"][0]
+    assert up2.attrs.activation == ActiMode.GELU
+    assert up2.sharding.weight_specs["kernel"] == ((), ("model",))
+
+
+def test_cancel_split_concat():
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 32), DataType.FLOAT, name="input")
+    a, b = ff.split(x, [16, 16], axis=1, name="sp")
+    t = ff.concat([a, b], axis=1, name="cat")
+    ff.softmax(t, name="sm")
+    ff.graph.infer_shapes()
+    cands = _rule("cancel_split_concat").apply_all(ff.graph)
+    assert len(cands) == 1
+    g = cands[0]
+    assert not [n for n in g.nodes if n.op_type in (OpType.SPLIT, OpType.CONCAT)]
+
+    # swapped order (parts concatenated reversed) must NOT cancel
+    ff2 = FFModel(FFConfig(batch_size=4))
+    x2 = ff2.create_tensor((4, 32), DataType.FLOAT, name="input")
+    a2, b2 = ff2.split(x2, [16, 16], axis=1, name="sp")
+    ff2.softmax(ff2.concat([b2, a2], axis=1, name="cat"), name="sm")
+    ff2.graph.infer_shapes()
+    assert _rule("cancel_split_concat").apply_all(ff2.graph) == []
+
+
+def test_cancel_concat_split():
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 8), DataType.FLOAT, name="ia")
+    y = ff.create_tensor((4, 24), DataType.FLOAT, name="ib")
+    t = ff.concat([x, y], axis=1, name="cat")
+    a, b = ff.split(t, [8, 24], axis=1, name="sp")
+    ff.softmax(a, name="sa")
+    ff.softmax(b, name="sb")
+    ff.graph.infer_shapes()
+    cands = _rule("cancel_concat_split").apply_all(ff.graph)
+    assert len(cands) == 1
+    g = cands[0]
+    assert not [n for n in g.nodes if n.op_type in (OpType.SPLIT, OpType.CONCAT)]
+    g.infer_shapes()
+
+    # mismatched split sizes must NOT cancel
+    ff2 = FFModel(FFConfig(batch_size=4))
+    x2 = ff2.create_tensor((4, 8), DataType.FLOAT, name="ia")
+    y2 = ff2.create_tensor((4, 24), DataType.FLOAT, name="ib")
+    t2 = ff2.concat([x2, y2], axis=1, name="cat")
+    a2, b2 = ff2.split(t2, [16, 16], axis=1, name="sp")
+    ff2.softmax(a2, name="sa")
+    ff2.softmax(b2, name="sb")
+    ff2.graph.infer_shapes()
+    assert _rule("cancel_concat_split").apply_all(ff2.graph) == []
+
+
+def test_cse_element_unary():
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 16), DataType.FLOAT, name="input")
+    a = ff.gelu(x, name="g1")
+    b = ff.gelu(x, name="g2")
+    ff.concat([a, b], axis=1, name="cat")
+    ff.graph.infer_shapes()
+    cands = _rule("cse_element_unary").apply_all(ff.graph)
+    assert len(cands) == 1  # symmetry-broken: one match, not two
+    g = cands[0]
+    unary = [n for n in g.nodes if n.op_type == OpType.ELEMENT_UNARY]
+    assert len(unary) == 1
+    g.infer_shapes()
+    cat = [n for n in g.nodes if n.name == "cat"][0]
+    assert cat.outputs[0].dims[1].size == 32
+
+    # different kinds must not merge
+    ff2 = FFModel(FFConfig(batch_size=4))
+    x2 = ff2.create_tensor((4, 16), DataType.FLOAT, name="input")
+    ff2.concat([ff2.gelu(x2, name="g1"), ff2.relu(x2, name="r1")],
+               axis=1, name="cat")
+    ff2.graph.infer_shapes()
+    assert _rule("cse_element_unary").apply_all(ff2.graph) == []
+
+
+def test_commute_unary_transpose():
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 6, 8), DataType.FLOAT, name="input")
+    t = ff.transpose(x, (0, 2, 1), name="t")
+    t = ff.relu(t, name="r")
+    ff.mean(t, axes=[1, 2], name="m")
+    ff.graph.infer_shapes()
+    cands = _rule("commute_unary_before_transpose").apply_all(ff.graph)
+    assert len(cands) == 1
+    g = cands[0]
+    g.infer_shapes()
+    r = [n for n in g.nodes if n.name == "r"][0]
+    tr = [n for n in g.nodes if n.name == "t"][0]
+    # relu now consumes the input directly; transpose consumes relu
+    assert [e.src for e in g.in_edges(tr)] == [r.guid]
+    assert r.outputs[0].dims[1].size == 6  # pre-transpose shape
+    # and the inverse rule restores the original order
+    back = _rule("commute_transpose_before_unary").apply_all(g)
+    assert len(back) == 1
+
+
+def test_merge_parallel_linears_3way():
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 64), DataType.FLOAT, name="input")
+    q = ff.dense(x, 64, use_bias=False, name="q")
+    k = ff.dense(x, 32, use_bias=False, name="k")
+    v = ff.dense(x, 32, use_bias=False, name="v")
+    ff.concat([q, k, v], axis=1, name="cat")
+    ff.graph.infer_shapes()
+    cands = _rule("merge_parallel_linears_3").apply_all(ff.graph)
+    # total symmetry order a<b<c: exactly one match, no mirrored duplicates
+    assert len(cands) == 1
+    g = cands[0]
+    wide = [n for n in g.nodes if n.op_type == OpType.LINEAR]
+    assert len(wide) == 1 and wide[0].attrs.out_dim == 128
+    sp = [n for n in g.nodes if n.op_type == OpType.SPLIT]
+    assert len(sp) == 1 and tuple(sp[0].attrs.sizes) == (64, 32, 32)
+    g.infer_shapes()
