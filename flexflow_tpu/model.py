@@ -908,6 +908,15 @@ class FFModel:
         return _serve(self, batch_sizes=batch_sizes, max_delay_ms=max_delay_ms,
                       warmup=warmup)
 
+    def serve_generation(self, slots: int = 4, max_len: int = 512,
+                         eos_id=None, seed: int = 0):
+        """Continuous-batching autoregressive generation endpoint (KV-cache
+        decode with per-slot positions — flexflow_tpu.serving)."""
+        from flexflow_tpu.serving import serve_generation as _sg
+
+        return _sg(self, slots=slots, max_len=max_len, eos_id=eos_id,
+                   seed=seed)
+
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],
                 batch_size: Optional[int] = None) -> np.ndarray:
         xs = [x] if isinstance(x, np.ndarray) else list(x)

@@ -120,16 +120,19 @@ def _batch_matmul(attrs, inputs, params, ctx):
 
 def apply_rope(x, theta: float, pos_offset=0):
     """Rotary position embedding, half-split (rotate_half) convention.
-    x: (B, S, H, D)."""
+    x: (B, S, H, D). `pos_offset` is a scalar, or a (B,) vector of per-row
+    offsets (continuous-batching decode: every slot sits at its own
+    absolute position)."""
     B, S, H, D = x.shape
     if D % 2 != 0:
         raise ValueError(f"RoPE requires an even head dim, got {D}")
     d2 = D // 2
     freqs = theta ** (-jnp.arange(0, d2, dtype=jnp.float32) / d2)
-    pos = jnp.arange(S, dtype=jnp.float32) + pos_offset
-    ang = pos[:, None] * freqs[None, :]  # (S, d2)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    off = jnp.asarray(pos_offset, jnp.float32).reshape(-1, 1)  # (B|1, 1)
+    pos = jnp.arange(S, dtype=jnp.float32)[None, :] + off      # (B|1, S)
+    ang = pos[:, :, None] * freqs[None, None, :]  # (B|1, S, d2)
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :d2], xf[..., d2:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
@@ -140,8 +143,8 @@ def _dot_product_attention(q, k, v, causal: bool, scale: float,
                            dropout_rate: float = 0.0, dropout_rng=None,
                            mask=None):
     """q: (B,S,H,D), k/v: (B,T,Hkv,D) -> (B,S,H,D). fp32 softmax accumulate.
-    `mask` (S, T) overrides the causal triangle (KV-cache decode passes the
-    absolute-position mask)."""
+    `mask` (S, T) or per-row (B, S, T) overrides the causal triangle
+    (KV-cache decode passes the absolute-position mask)."""
     B, S, H, D = q.shape
     T, Hkv = k.shape[1], k.shape[2]
     if Hkv != H:
@@ -153,7 +156,8 @@ def _dot_product_attention(q, k, v, causal: bool, scale: float,
     if mask is None and causal:
         mask = jnp.tril(jnp.ones((S, T), dtype=bool))
     if mask is not None:
-        logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
+        m = mask[None, None] if mask.ndim == 2 else mask[:, None]
+        logits = jnp.where(m, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     if dropout_rate > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
@@ -248,20 +252,42 @@ def _mha(attrs, inputs, params, ctx):
         # SHARED fp32-accumulating attention (mask = causal over absolute
         # positions; slots past the write head are masked out)
         pos = ctx.cache_position
+        pos_v = jnp.asarray(pos)
         if attrs.rope:
             q = apply_rope(q, attrs.rope_theta, pos_offset=pos)
             k = apply_rope(k, attrs.rope_theta, pos_offset=pos)
-        kc = lax.dynamic_update_slice(
-            ctx.kv_cache["k"], k.astype(ctx.kv_cache["k"].dtype), (0, pos, 0, 0)
-        )
-        vc = lax.dynamic_update_slice(
-            ctx.kv_cache["v"], v.astype(ctx.kv_cache["v"].dtype), (0, pos, 0, 0)
-        )
+        if pos_v.ndim == 0:
+            # one shared position (generate(): whole batch in lockstep)
+            kc = lax.dynamic_update_slice(
+                ctx.kv_cache["k"], k.astype(ctx.kv_cache["k"].dtype),
+                (0, pos, 0, 0)
+            )
+            vc = lax.dynamic_update_slice(
+                ctx.kv_cache["v"], v.astype(ctx.kv_cache["v"].dtype),
+                (0, pos, 0, 0)
+            )
+            qpos = pos + jnp.arange(q.shape[1])      # absolute q positions
+            kpos = jnp.arange(kc.shape[1])           # cache slots
+            mask = kpos[None, :] <= qpos[:, None]
+        else:
+            # per-row positions (continuous batching: each slot decodes at
+            # its own depth). Rows write independently; a freshly admitted
+            # slot's stale cache rows sit at kpos > qpos and stay masked
+            # until overwritten.
+            def write_row(cache_row, new_row, p):
+                return lax.dynamic_update_slice(cache_row, new_row, (p, 0, 0))
+
+            kc = jax.vmap(write_row)(
+                ctx.kv_cache["k"], k.astype(ctx.kv_cache["k"].dtype), pos_v
+            )
+            vc = jax.vmap(write_row)(
+                ctx.kv_cache["v"], v.astype(ctx.kv_cache["v"].dtype), pos_v
+            )
+            qpos = pos_v[:, None] + jnp.arange(q.shape[1])[None, :]  # (B,S)
+            kpos = jnp.arange(kc.shape[1])
+            mask = kpos[None, None, :] <= qpos[:, :, None]           # (B,S,T)
         ctx.cache_updates["k"] = kc
         ctx.cache_updates["v"] = vc
-        qpos = pos + jnp.arange(q.shape[1])          # absolute q positions
-        kpos = jnp.arange(kc.shape[1])               # cache slots
-        mask = kpos[None, :] <= qpos[:, None]
         out = _dot_product_attention(
             q, kc.astype(dt), vc.astype(dt), causal=False,
             scale=1.0 / (hd**0.5), mask=mask,

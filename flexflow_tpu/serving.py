@@ -341,3 +341,232 @@ def http_serve(server: Server, port: int = 8000, model_name: str = "model"):
     httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     return httpd
+
+
+# ---------------------------------------------------------------------------
+# continuous batching for autoregressive generation
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "temperature", "future", "tokens",
+                 "pos")
+
+    def __init__(self, prompt: np.ndarray, max_new: int, temperature: float):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.future: Future = Future()
+        self.tokens: List[int] = []
+        self.pos = 0  # next cache write position for this slot
+
+
+class GenerationServer:
+    """Continuous batching over the KV-cache decode path (beyond the
+    reference triton/ backend, which serves stateless forwards only).
+
+    A fixed pool of `slots` shares one jitted single-token decode step with
+    PER-SLOT cache positions (ops/jax_ops.py cached-attention vector-pos
+    path). Each tick admits queued requests into free slots (one bucketed
+    prefill per admission scatters the prompt's K/V into the slot's cache
+    rows), then advances every active slot one token. Finished sequences
+    (EOS or their max_new_tokens) free their slot immediately — no
+    batch-drain barrier, the defining property of continuous batching.
+    """
+
+    def __init__(self, ff, slots: int = 4, max_len: int = 512,
+                 eos_id: Optional[int] = None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.ff = ff
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        ex = ff.executor
+        self._step = ex.decode_fn()
+        self._params = ff._params
+        self._caches = ex.init_kv_cache(self.slots, self.max_len)
+        # one-slot prefill caches per bucketed prompt length share the big
+        # pool's dtype/shape suffix, so rows scatter straight in
+        self._prefill_caches = ex.init_kv_cache(1, self.max_len)
+        self._rng = jax.random.key(seed)
+
+        @jax.jit
+        def scatter_slot(big, row, slot):
+            return jax.tree.map(lambda b, r: b.at[slot].set(r[0]), big, row)
+
+        @jax.jit
+        def pick(probs_last, temps, rng):
+            # probs_last: (B, V) — greedy where temp<=0, else sampled
+            greedy = jnp.argmax(probs_last, axis=-1).astype(jnp.int32)
+            logits = jnp.log(jnp.maximum(probs_last, 1e-30)) / jnp.maximum(
+                temps[:, None], 1e-6)
+            sampled = jax.random.categorical(rng, logits, axis=-1).astype(
+                jnp.int32)
+            return jnp.where(temps > 0.0, sampled, greedy)
+
+        self._scatter = scatter_slot
+        self._pick = pick
+        self._queue: "queue.Queue[_GenRequest]" = queue.Queue()
+        self._active: List[Optional[_GenRequest]] = [None] * self.slots
+        self._tokens = np.zeros((self.slots,), np.int32)
+        self._stop = threading.Event()
+        self._running = True
+        self._served = 0
+        self._steps = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- public API ------------------------------------------------------
+
+    def submit(self, prompt_ids: np.ndarray, max_new_tokens: int,
+               temperature: float = 0.0) -> Future:
+        if not self._running:
+            raise RuntimeError("GenerationServer is stopped")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_len ({self.max_len})")
+        req = _GenRequest(prompt, max_new_tokens, temperature)
+        self._queue.put(req)
+        return req.future
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 temperature: float = 0.0) -> np.ndarray:
+        return self.submit(prompt_ids, max_new_tokens, temperature).result()
+
+    def stop(self):
+        self._running = False
+        self._stop.set()
+        self._thread.join(timeout=30)
+        self._drain()
+
+    @property
+    def requests_served(self) -> int:
+        return self._served
+
+    @property
+    def decode_steps(self) -> int:
+        return self._steps
+
+    # -- scheduler loop --------------------------------------------------
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def _admit(self, req: _GenRequest, slot: int):
+        """Bucketed prefill into `slot`: pad the prompt right (pad rows land
+        at kpos > the slot's qpos, so they are masked until overwritten by
+        real decode writes), scatter the K/V rows, pick the first token from
+        the last REAL prompt position."""
+        import jax
+        import jax.numpy as jnp
+
+        tr, ntr = self._params
+        n = len(req.prompt)
+        padded = np.zeros((1, min(self._bucket(n), self.max_len)), np.int32)
+        padded[0, :n] = req.prompt
+        probs, upd = self._step(tr, ntr, self._prefill_caches, 0,
+                                jnp.asarray(padded))
+        for key, rows in upd.items():
+            self._caches[key] = self._scatter(self._caches[key], rows, slot)
+        self._rng, sub = jax.random.split(self._rng)
+        tok = int(np.asarray(self._pick(
+            probs[:, n - 1, :],
+            jnp.full((1,), req.temperature, jnp.float32), sub))[0])
+        req.pos = n
+        req.tokens.append(tok)
+        self._tokens[slot] = tok
+        self._active[slot] = req
+        self._finish_if_done(slot)
+
+    def _finish_if_done(self, slot: int):
+        req = self._active[slot]
+        if req is None:
+            return
+        done = len(req.tokens) >= req.max_new
+        if self.eos_id is not None and req.tokens and req.tokens[-1] == self.eos_id:
+            done = True
+        if done:
+            self._active[slot] = None
+            self._served += 1
+            req.future.set_result(np.asarray(req.tokens, np.int32))
+
+    def _loop(self):
+        import jax
+        import jax.numpy as jnp
+
+        tr, ntr = self._params
+        while not self._stop.is_set():
+            # admission: fill every free slot from the queue
+            admitted = False
+            for slot in range(self.slots):
+                if self._active[slot] is not None:
+                    continue
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                self._admit(req, slot)
+                admitted = True
+            live = [s for s in range(self.slots) if self._active[s] is not None]
+            if not live:
+                if not admitted:
+                    time.sleep(0.001)
+                continue
+            # one decode tick for the whole pool (idle slots compute too —
+            # fixed shapes keep the step compiled once)
+            pos = np.array([self._active[s].pos if self._active[s] else 0
+                            for s in range(self.slots)], np.int32)
+            probs, upd = self._step(tr, ntr, self._caches, jnp.asarray(pos),
+                                    jnp.asarray(self._tokens)[:, None])
+            self._caches = upd
+            temps = np.array([self._active[s].temperature if self._active[s]
+                              else 0.0 for s in range(self.slots)], np.float32)
+            self._rng, sub = jax.random.split(self._rng)
+            toks = np.asarray(self._pick(probs[:, -1, :],
+                                         jnp.asarray(temps), sub))
+            self._steps += 1
+            for s in live:
+                req = self._active[s]
+                req.pos += 1
+                req.tokens.append(int(toks[s]))
+                self._tokens[s] = toks[s]
+                self._finish_if_done(s)
+        self._drain()
+
+    def _drain(self):
+        """Cancel whatever is still queued or mid-decode so callers
+        unblock — a truncated sequence must not look like a completed one.
+        Runs on the loop thread at exit AND on the stop() caller's thread
+        after join, so a submit racing stop() still gets resolved."""
+        for s in range(self.slots):
+            req = self._active[s]
+            if req is not None:
+                self._active[s] = None
+                if not req.future.done():
+                    req.future.cancel()
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.cancel()
+
+
+def serve_generation(ff, slots: int = 4, max_len: int = 512,
+                     eos_id: Optional[int] = None, seed: int = 0
+                     ) -> GenerationServer:
+    """Continuous-batching generation endpoint over a compiled causal-LM
+    FFModel (KV-cache decode path required — see FFModel.generate)."""
+    return GenerationServer(ff, slots=slots, max_len=max_len, eos_id=eos_id,
+                            seed=seed)
