@@ -872,6 +872,8 @@ class FFModel:
         ex = self.executor
         prompt_ids = np.asarray(prompt_ids, np.int32)
         b, s = prompt_ids.shape
+        if s < 1:
+            raise ValueError("prompt must contain at least one token")
         caches = ex.init_kv_cache(b, s + max_new_tokens)
         step = ex.decode_fn()
         tr, ntr = self._params

@@ -411,6 +411,8 @@ class GenerationServer:
         self._active: List[Optional[_GenRequest]] = [None] * self.slots
         self._tokens = np.zeros((self.slots,), np.int32)
         self._stop = threading.Event()
+        # guards the _running/queue.put pair against a submit racing stop()
+        self._lock = threading.Lock()
         self._running = True
         self._served = 0
         self._steps = 0
@@ -421,18 +423,21 @@ class GenerationServer:
 
     def submit(self, prompt_ids: np.ndarray, max_new_tokens: int,
                temperature: float = 0.0) -> Future:
-        if not self._running:
-            raise RuntimeError("GenerationServer is stopped")
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("prompt must contain at least one token")
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_len ({self.max_len})")
         req = _GenRequest(prompt, max_new_tokens, temperature)
-        self._queue.put(req)
+        with self._lock:
+            if not self._running:
+                raise RuntimeError("GenerationServer is stopped")
+            self._queue.put(req)
         return req.future
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
@@ -440,10 +445,15 @@ class GenerationServer:
         return self.submit(prompt_ids, max_new_tokens, temperature).result()
 
     def stop(self):
-        self._running = False
-        self._stop.set()
+        with self._lock:
+            self._running = False
+            self._stop.set()
         self._thread.join(timeout=30)
-        self._drain()
+        # drain from this thread ONLY once the loop thread is dead —
+        # otherwise its finally-drain owns the cleanup and a concurrent
+        # drain here would null _active slots mid-tick under the loop
+        if not self._thread.is_alive():
+            self._drain()
 
     @property
     def requests_served(self) -> int:
@@ -505,6 +515,17 @@ class GenerationServer:
         import jax.numpy as jnp
 
         tr, ntr = self._params
+        try:
+            self._loop_body(tr, ntr)
+        finally:
+            # runs on ANY exit — including a decode-step exception — so
+            # blocked callers always unblock instead of hanging forever
+            self._drain()
+
+    def _loop_body(self, tr, ntr):
+        import jax
+        import jax.numpy as jnp
+
         while not self._stop.is_set():
             # admission: fill every free slot from the queue
             admitted = False
@@ -541,7 +562,6 @@ class GenerationServer:
                 req.tokens.append(int(toks[s]))
                 self._tokens[s] = toks[s]
                 self._finish_if_done(s)
-        self._drain()
 
     def _drain(self):
         """Cancel whatever is still queued or mid-decode so callers

@@ -210,6 +210,8 @@ def test_generation_server_stop_contract():
     server = ff.serve_generation(slots=1, max_len=16)
     with pytest.raises(ValueError):
         server.submit(np.array([1, 2], np.int32), max_new_tokens=0)
+    with pytest.raises(ValueError):
+        server.submit(np.array([], np.int32), max_new_tokens=2)
     server.stop()
     with pytest.raises(RuntimeError):
         server.submit(np.array([1, 2], np.int32), max_new_tokens=2)
