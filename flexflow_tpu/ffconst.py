@@ -135,6 +135,8 @@ class OpType(enum.Enum):
     GATHER = "gather"
     REDUCE_SUM = "reduce_sum"
     MEAN = "mean"
+    # recurrent (reference legacy NMT app, nmt/rnn.h)
+    LSTM = "lstm"
     # MoE
     TOPK = "topk"
     GROUP_BY = "group_by"

@@ -392,6 +392,20 @@ class FFModel:
         node = self._add(OpType.TOPK, A.TopKAttrs(k, sorted), [input], name or "topk")
         return Tensor(node, 0), Tensor(node, 1)
 
+    # ---- recurrent ----
+
+    def lstm(self, input: Tensor, hidden: int,
+             initial_state: Optional[Tuple[Tensor, Tensor]] = None,
+             use_bias: bool = True, reverse: bool = False,
+             name=None) -> Tuple[Tensor, Tensor, Tensor]:
+        """LSTM over a (batch, seq, dim) sequence -> (outputs, h_n, c_n)
+        (reference legacy NMT LSTM node, nmt/rnn.h:161). `initial_state`
+        wires a decoder to an encoder's final (h, c)."""
+        ins = [input] + (list(initial_state) if initial_state else [])
+        node = self._add(OpType.LSTM, A.LSTMAttrs(hidden, use_bias, reverse),
+                         ins, name or "lstm")
+        return Tensor(node, 0), Tensor(node, 1), Tensor(node, 2)
+
     # ---- MoE ----
 
     def group_by(self, input: Tensor, assign: Tensor, n: int, alpha: float,

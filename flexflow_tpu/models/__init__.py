@@ -17,6 +17,7 @@ from flexflow_tpu.models.dlrm import build_dlrm
 from flexflow_tpu.models.inception import build_inception_v3
 from flexflow_tpu.models.resnext import build_resnext50
 from flexflow_tpu.models.candle_uno import build_candle_uno
+from flexflow_tpu.models.nmt import NMTConfig, build_nmt, nmt_dp_strategy
 from flexflow_tpu.models.xdl import build_xdl
 
 __all__ = [
@@ -34,5 +35,8 @@ __all__ = [
     "build_inception_v3",
     "build_resnext50",
     "build_candle_uno",
+    "NMTConfig",
+    "build_nmt",
+    "nmt_dp_strategy",
     "build_xdl",
 ]

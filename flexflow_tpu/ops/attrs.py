@@ -202,6 +202,64 @@ class BatchMatmulAttrs(OpAttrs):
 
 
 # ---------------------------------------------------------------------------
+# recurrent
+
+
+@dataclasses.dataclass(frozen=True)
+class LSTMAttrs(OpAttrs):
+    """Single-layer LSTM over a full sequence (capability analog of the
+    reference's legacy NMT LSTM node, nmt/rnn.h:161 add_lstm_node — which
+    unrolls one CUDA node per LSTM_PER_NODE_LENGTH timesteps; on TPU the
+    whole sequence is one lax.scan with the input projection hoisted into a
+    single MXU matmul).
+
+    Inputs: x (batch, seq, in_dim) [, h0 (batch, hidden), c0 (batch, hidden)].
+    Outputs: y (batch, seq, hidden), h_n (batch, hidden), c_n (batch, hidden).
+    Gate order i,f,g,o matches torch.nn.LSTM's weight layout (wx/wh are its
+    weight_ih/weight_hh transposed, bias = b_ih + b_hh). Batch dim shards on
+    the data axis; the sequence dim is the recurrence and never shards.
+    """
+
+    hidden: int
+    use_bias: bool = True
+    reverse: bool = False
+
+    def infer(self, x: Shape, h0: Optional[Shape] = None,
+              c0: Optional[Shape] = None):
+        if x.ndim != 3:
+            raise ValueError(f"lstm expects (batch, seq, in_dim), got {x}")
+        for nm, st in (("h0", h0), ("c0", c0)):
+            if st is None:
+                continue
+            if st.ndim != 2 or st.dims[0].size != x.dims[0].size \
+                    or st.dims[1].size != self.hidden:
+                raise ValueError(
+                    f"lstm initial state {nm} must be (batch={x.dims[0].size},"
+                    f" hidden={self.hidden}), got {st}"
+                )
+        b, s = x.dims[0], x.dims[1]
+        h = ParallelDim(self.hidden)
+        y = Shape((_carry(b), ParallelDim(s.size), h), x.dtype, x.replica)
+        state = Shape((_carry(b), h), x.dtype, x.replica)
+        return (y, state, state)
+
+    def weights(self, x: Shape, *state):
+        in_dim = x.dims[-1].size
+        w = {
+            "wx": WeightSpec(TensorShape((in_dim, 4 * self.hidden), x.dtype)),
+            "wh": WeightSpec(TensorShape((self.hidden, 4 * self.hidden), x.dtype)),
+        }
+        if self.use_bias:
+            w["bias"] = WeightSpec(TensorShape((4 * self.hidden,), x.dtype), "zeros")
+        return w
+
+    def flops(self, ins, outs):
+        x = ins[0]
+        b, s, d = (dim.size for dim in x.dims)
+        return 2 * b * s * 4 * self.hidden * (d + self.hidden)
+
+
+# ---------------------------------------------------------------------------
 # attention
 
 

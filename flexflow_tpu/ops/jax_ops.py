@@ -524,6 +524,49 @@ def _topk(attrs, inputs, params, ctx):
 
 
 # ---------------------------------------------------------------------------
+# recurrent
+
+
+@register_lowering(OpType.LSTM)
+def _lstm(attrs, inputs, params, ctx):
+    """LSTM over the whole sequence (reference nmt/lstm.cu, one cuDNN node
+    per timestep-block). TPU shape: the input projection x@wx for ALL
+    timesteps is one big MXU matmul outside the recurrence; lax.scan carries
+    only the (batch, 4*hidden) recurrent matmul. Cell state accumulates in
+    fp32; gate order i,f,g,o matches torch.nn.LSTM."""
+    x = inputs[0]  # (B, S, D)
+    B, S, _ = x.shape
+    H = attrs.hidden
+    wx = params["wx"].astype(x.dtype)
+    wh = params["wh"].astype(x.dtype)
+    h0 = inputs[1] if len(inputs) > 1 else jnp.zeros((B, H), x.dtype)
+    c0 = (inputs[2] if len(inputs) > 2 else jnp.zeros((B, H), x.dtype))
+    if attrs.reverse:
+        x = jnp.flip(x, axis=1)
+    xg = jnp.dot(x, wx, preferred_element_type=jnp.float32).astype(x.dtype)
+    if attrs.use_bias:
+        xg = xg + params["bias"].astype(x.dtype)
+
+    def step(carry, xt):
+        h, c = carry  # (B,H) activation dtype, (B,H) fp32
+        gates = (
+            xt + jnp.dot(h, wh, preferred_element_type=jnp.float32).astype(x.dtype)
+        ).astype(jnp.float32)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = (jax.nn.sigmoid(o) * jnp.tanh(c)).astype(x.dtype)
+        return (h, c), h
+
+    (h_n, c_n), ys = lax.scan(
+        step, (h0, c0.astype(jnp.float32)), xg.transpose(1, 0, 2)
+    )
+    y = ys.transpose(1, 0, 2)
+    if attrs.reverse:
+        y = jnp.flip(y, axis=1)
+    return [y, h_n, c_n.astype(x.dtype)]
+
+
+# ---------------------------------------------------------------------------
 # MoE: group_by / aggregate / fused experts
 #
 # TPU-native design: dense capacity-based dispatch. Scatter/gather per token

@@ -36,6 +36,7 @@ def _run(script, *flags, timeout=420):
     ("inception_v3.py", ("-b", "4",)),
     ("candle_uno.py", ("-b", "16",)),
     ("dlrm_train.py", ("-b", "32",)),
+    ("nmt_seq2seq.py", ("-b", "32", "--mesh", "data=2,model=4")),
 ])
 def test_example_runs(script, flags):
     out = _run(script, *flags)

@@ -130,6 +130,46 @@ def test_softmax_embedding_gather_topk():
     np.testing.assert_array_equal(inds, ti.numpy())
 
 
+def test_lstm_vs_torch():
+    """LSTM sequence outputs + final state vs torch.nn.LSTM (gate order
+    i,f,g,o; torch's two biases sum into the framework's single bias)."""
+    B, S, D, H = 3, 7, 5, 8
+    rs = np.random.RandomState(3)
+    x = rs.randn(B, S, D).astype(np.float32)
+    h0 = rs.randn(B, H).astype(np.float32)
+    c0 = rs.randn(B, H).astype(np.float32)
+
+    ref = torch.nn.LSTM(D, H, batch_first=True)
+    with torch.no_grad():
+        ry, (rh, rc) = ref(
+            torch.from_numpy(x),
+            (torch.from_numpy(h0)[None], torch.from_numpy(c0)[None]),
+        )
+    wx = ref.weight_ih_l0.detach().numpy().T  # (D, 4H)
+    wh = ref.weight_hh_l0.detach().numpy().T  # (H, 4H)
+    bias = (ref.bias_ih_l0 + ref.bias_hh_l0).detach().numpy()
+
+    (y, hn, cn), _ = run_op(
+        OpType.LSTM, A.LSTMAttrs(H), [x, h0, c0],
+        {"wx": wx, "wh": wh, "bias": bias},
+    )
+    np.testing.assert_allclose(y, ry.numpy(), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hn, rh[0].numpy(), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cn, rc[0].numpy(), rtol=1e-5, atol=1e-5)
+
+    # reverse direction == torch bidirectional's backward half
+    bi = torch.nn.LSTM(D, H, batch_first=True, bidirectional=True)
+    with torch.no_grad():
+        by, _ = bi(torch.from_numpy(x))
+    (yr, _, _), _ = run_op(
+        OpType.LSTM, A.LSTMAttrs(H, reverse=True), [x],
+        {"wx": bi.weight_ih_l0_reverse.detach().numpy().T,
+         "wh": bi.weight_hh_l0_reverse.detach().numpy().T,
+         "bias": (bi.bias_ih_l0_reverse + bi.bias_hh_l0_reverse).detach().numpy()},
+    )
+    np.testing.assert_allclose(yr, by[..., H:].numpy(), rtol=1e-5, atol=1e-5)
+
+
 def test_attention_vs_torch():
     np.random.seed(1)
     B, S, E, H = 2, 6, 16, 4
