@@ -102,6 +102,10 @@ class PyTorchModel:
         # several sites becomes several FF layers; copy_weights fills each).
         # Note: the copies are not tied for training — updates diverge.
         self._name_map: Dict[str, List[str]] = {}
+        # nn.LSTM modules expand into one FF lstm per (layer, direction),
+        # each needing its OWN weight slice: target -> [(ff_name, layer,
+        # is_reverse)]
+        self._rnn_map: Dict[str, List[tuple]] = {}
 
     # ------------------------------------------------------------------
 
@@ -221,6 +225,34 @@ class PyTorchModel:
         if _is_rms_norm_module(mod):
             return self._record(node.target,
                                 ff.rms_norm(x, eps=_rms_eps(mod), name=name))
+        if isinstance(mod, nn.LSTM):
+            # expands into one FF lstm op per (layer, direction); returns
+            # the torch-shaped (output, states) tuple so downstream getitem
+            # nodes unpack it. The packed (layers*dirs, batch, hidden)
+            # states have no faithful analog here, so consuming them raises
+            # (see _TorchLSTMStates).
+            if not mod.batch_first:
+                raise NotImplementedError(
+                    "nn.LSTM import requires batch_first=True (framework "
+                    "layout is (batch, seq, dim))"
+                )
+            if getattr(mod, "proj_size", 0):
+                raise NotImplementedError(
+                    "nn.LSTM proj_size != 0 is not supported"
+                )
+            if len(node.args) > 1 or node.kwargs:
+                raise NotImplementedError(
+                    "nn.LSTM import with explicit initial states is not "
+                    "supported (torch packs them (layers*dirs, batch, "
+                    "hidden); build with FFModel.lstm(initial_state=...) "
+                    "directly)"
+                )
+            t, entries = _build_lstm_stack(
+                ff, x, mod.hidden_size, mod.num_layers, mod.bidirectional,
+                float(mod.dropout), mod.bias, name,
+            )
+            self._rnn_map.setdefault(node.target, []).extend(entries)
+            return (t, _TorchLSTMStates())
         if isinstance(mod, nn.Sequential):
             t = x
             for child_name, sub in mod.named_children():
@@ -243,6 +275,12 @@ class PyTorchModel:
 
         fn = node.target
         a = [val(x) for x in node.args]
+        if fn is operator.getitem:
+            if isinstance(a[0], (tuple, list)):
+                # unpacking a module's tuple return (e.g. nn.LSTM's
+                # (output, (h_n, c_n)))
+                return a[0][a[1]]
+            return self._lower_getitem(ff, a[0], a[1])
         if fn in (operator.add, torch.add):
             if isinstance(a[1], Tensor):
                 return ff.add(a[0], a[1])
@@ -307,6 +345,9 @@ class PyTorchModel:
             rate = node.kwargs.get("p", a[1] if len(a) > 1 else 0.5)
             return ff.dropout(a[0], rate=float(rate))
         raise NotImplementedError(f"torch function {fn} not supported")
+
+    def _lower_getitem(self, ff: FFModel, x: Tensor, idx):
+        return _tensor_getitem(ff, x, idx)
 
     def _lower_method(self, ff: FFModel, node, val):
         a = [val(x) for x in node.args]
@@ -373,6 +414,20 @@ class PyTorchModel:
                     if type(mod).__name__.startswith("Gemma"):
                         w = w + 1.0
                     ff.set_weight(ff_name, w, "scale")
+        for target, entries in self._rnn_map.items():
+            mod = self.traced.get_submodule(target)
+            for ff_name, layer, rev in entries:
+                sfx = f"l{layer}" + ("_reverse" if rev else "")
+                ff.set_weight(
+                    ff_name,
+                    getattr(mod, f"weight_ih_{sfx}").detach().numpy().T, "wx")
+                ff.set_weight(
+                    ff_name,
+                    getattr(mod, f"weight_hh_{sfx}").detach().numpy().T, "wh")
+                if mod.bias:
+                    b = (getattr(mod, f"bias_ih_{sfx}")
+                         + getattr(mod, f"bias_hh_{sfx}")).detach().numpy()
+                    ff.set_weight(ff_name, b, "bias")
 
     # ------------------------------------------------------------------
     # text IR (reference torch_to_file/file_to_ff, torch/model.py:2597,2540)
@@ -409,6 +464,113 @@ class PyTorchModel:
             f.write("\n".join(lines))
 
 
+class _TorchLSTMStates:
+    """Placeholder for nn.LSTM's (h_n, c_n) return slot: torch packs states
+    as (num_layers*num_directions, batch, hidden), which the
+    per-(layer, direction) expansion cannot reproduce faithfully — so a
+    model that actually CONSUMES them fails loudly here instead of
+    computing silently wrong results. (`y, _ = self.lstm(x)` binds but
+    never touches this and imports fine.)"""
+
+    def _unsupported(self):
+        raise NotImplementedError(
+            "nn.LSTM import: consuming h_n/c_n is not supported (torch "
+            "packs them (layers*dirs, batch, hidden)); read the sequence "
+            "output instead, or build with FFModel.lstm directly"
+        )
+
+    def __getitem__(self, i):
+        self._unsupported()
+
+    def __iter__(self):
+        self._unsupported()
+
+
+def _build_lstm_stack(ff: FFModel, x: Tensor, hidden: int, layers: int,
+                      bidir: bool, dropout: float, use_bias: bool,
+                      name: str):
+    """Shared stacked/bidirectional nn.LSTM expansion (fx import + text-IR
+    replay): one FF lstm per (layer, direction), directions concatenated on
+    the feature dim, inter-layer dropout. Returns (output, entries) where
+    entries = [(ff_node_name, layer, is_reverse)] for weight copy."""
+    t, entries = x, []
+    for layer in range(layers):
+        y, _, _ = ff.lstm(t, hidden, use_bias=use_bias,
+                          name=f"{name}_l{layer}")
+        entries.append((y.node.name, layer, False))
+        if bidir:
+            yr, _, _ = ff.lstm(t, hidden, use_bias=use_bias, reverse=True,
+                               name=f"{name}_l{layer}_rev")
+            entries.append((yr.node.name, layer, True))
+            y = ff.concat([y, yr], axis=-1, name=f"{name}_l{layer}_cat")
+        t = y
+        if dropout and layer < layers - 1:
+            t = ff.dropout(t, dropout, name=f"{name}_l{layer}_do")
+    return t, entries
+
+
+def _tensor_getitem(ff: FFModel, x: Tensor, idx):
+    """Basic tensor indexing (`y[:, -1]`, `y[..., :h]`): each indexed dim
+    becomes a split that keeps the addressed piece; int indices squeeze
+    their dim afterwards. Step slices / advanced indexing unsupported."""
+    idx = idx if isinstance(idx, tuple) else (idx,)
+    if any(it is Ellipsis for it in idx):
+        # expand `...` to full slices over the unindexed middle dims
+        pos = idx.index(Ellipsis)
+        fill = len(x.shape) - (len(idx) - 1)
+        idx = idx[:pos] + (slice(None),) * fill + idx[pos + 1:]
+    t, squeeze = x, []
+    for dim, it in enumerate(idx):
+        size = t.shape[dim]
+        if isinstance(it, slice):
+            if it == slice(None):
+                continue
+            if it.step not in (None, 1):
+                raise NotImplementedError(f"step slice {it} not supported")
+            start, stop, _ = it.indices(size)
+            if stop <= start:
+                raise NotImplementedError(f"empty slice {it}")
+            keep_start, keep_len = start, stop - start
+        elif isinstance(it, int):
+            if not -size <= it < size:
+                raise IndexError(
+                    f"index {it} out of range for dim {dim} of size {size}"
+                )
+            keep_start, keep_len = it % size, 1
+            squeeze.append(dim)
+        else:
+            raise NotImplementedError(f"index {it!r} not supported")
+        sizes = [keep_start, keep_len, size - keep_start - keep_len]
+        keep_pos = sum(1 for s in sizes[:1] if s > 0)
+        pieces = ff.split(t, [s for s in sizes if s > 0], axis=dim)
+        t = pieces[keep_pos] if isinstance(pieces, list) else pieces
+    if squeeze:
+        shape = [s for d, s in enumerate(t.shape) if d not in squeeze]
+        t = ff.reshape(t, shape)
+    return t
+
+
+def _parse_index(s: str):
+    """Parse a getitem index serialized by repr() back into ints/slices/
+    tuples/Ellipsis — WITHOUT eval (IR files are untrusted input)."""
+    import ast
+
+    def conv(n):
+        if isinstance(n, ast.Tuple):
+            return tuple(conv(e) for e in n.elts)
+        if isinstance(n, ast.Call) and getattr(n.func, "id", "") == "slice":
+            return slice(*(conv(a) for a in n.args))
+        if isinstance(n, ast.Constant):
+            return n.value
+        if isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.USub):
+            return -conv(n.operand)
+        if isinstance(n, ast.Name) and n.id == "Ellipsis":
+            return Ellipsis
+        raise NotImplementedError(f"text-IR index {s!r}")
+
+    return conv(ast.parse(s, mode="eval").body)
+
+
 def _module_spec(mod) -> str:
     import torch.nn as nn
 
@@ -441,6 +603,13 @@ def _module_spec(mod) -> str:
         return "BatchNorm2d"
     if _is_rms_norm_module(mod):
         return f"RMSNorm:{_rms_eps(mod)}"
+    if isinstance(mod, nn.LSTM):
+        if not mod.batch_first:
+            raise NotImplementedError("text-IR LSTM requires batch_first=True")
+        if getattr(mod, "proj_size", 0):
+            raise NotImplementedError("text-IR LSTM proj_size != 0 unsupported")
+        return (f"LSTM:{mod.hidden_size}:{mod.num_layers}"
+                f":{int(mod.bidirectional)}:{mod.dropout}:{int(mod.bias)}")
     raise NotImplementedError(f"no text-IR spec for {type(mod).__name__}")
 
 
@@ -469,19 +638,30 @@ def file_to_ff(path: str, ff: FFModel, input_tensors: Sequence[Tensor]) -> List[
                 name, fname, rawargs = parts[1], parts[2], parts[3]
                 args = rawargs.split(";")
                 ts = [env[a] for a in args if a in env]
-                # scalar operand may come before or after the tensor; parse
-                # with literal_eval (never eval untrusted IR files)
-                scalars = [ast.literal_eval(a) for a in args if a not in env]
+
+                def scalars():
+                    # scalar operand may come before or after the tensor;
+                    # parse with literal_eval (never eval untrusted IR
+                    # files). Lazy: getitem's slice reprs aren't literals.
+                    return [ast.literal_eval(a) for a in args if a not in env]
                 if fname == "add":
                     env[name] = (ff.add(ts[0], ts[1]) if len(ts) > 1
-                                 else ff.scalar_add(ts[0], float(scalars[0])))
+                                 else ff.scalar_add(ts[0], float(scalars()[0])))
                 elif fname == "mul":
                     env[name] = (ff.multiply(ts[0], ts[1]) if len(ts) > 1
-                                 else ff.scalar_multiply(ts[0], float(scalars[0])))
+                                 else ff.scalar_multiply(ts[0], float(scalars()[0])))
                 elif fname == "flatten":
                     env[name] = ff.flat(ts[0])
                 elif fname == "relu":
                     env[name] = ff.relu(ts[0])
+                elif fname == "getitem":
+                    v = ts[0]
+                    # the index is the SECOND arg (repr-serialized)
+                    sub = _parse_index(args[1])
+                    if isinstance(v, (tuple, list)):
+                        env[name] = v[sub]
+                    else:
+                        env[name] = _tensor_getitem(ff, v, sub)
                 else:
                     raise NotImplementedError(f"text-IR function {fname}")
     return outputs
@@ -520,4 +700,12 @@ def _apply_spec(ff: FFModel, spec: str, x: Tensor, name: str) -> Tensor:
         return ff.batch_norm(x, relu=False, name=name)
     if kind == "RMSNorm":
         return ff.rms_norm(x, eps=float(parts[1]), name=name)
+    if kind == "LSTM":
+        hidden, layers, bidir, drop, bias = (
+            int(parts[1]), int(parts[2]), bool(int(parts[3])),
+            float(parts[4]), bool(int(parts[5])),
+        )
+        t, _ = _build_lstm_stack(ff, x, hidden, layers, bidir, drop, bias,
+                                 name)
+        return (t, _TorchLSTMStates())
     raise NotImplementedError(f"text-IR spec {kind}")

@@ -429,10 +429,11 @@ class ConcatAttrs(OpAttrs):
     axis: int
 
     def infer(self, *ins: Shape):
-        total = sum(s.dims[self.axis].size for s in ins)
+        ax = self.axis % ins[0].ndim
+        total = sum(s.dims[ax].size for s in ins)
         dims = []
         for i, d in enumerate(ins[0].dims):
-            dims.append(ParallelDim(total) if i == self.axis else _carry(d))
+            dims.append(ParallelDim(total) if i == ax else _carry(d))
         return (Shape(tuple(dims), ins[0].dtype, ins[0].replica),)
 
 
@@ -442,10 +443,11 @@ class SplitAttrs(OpAttrs):
     axis: int
 
     def infer(self, x: Shape):
+        ax = self.axis % x.ndim
         outs = []
         for sz in self.sizes:
             dims = tuple(
-                ParallelDim(sz) if i == self.axis else _carry(d)
+                ParallelDim(sz) if i == ax else _carry(d)
                 for i, d in enumerate(x.dims)
             )
             outs.append(Shape(dims, x.dtype, x.replica))

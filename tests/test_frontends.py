@@ -44,6 +44,38 @@ def test_torch_fx_import_matches_torch():
     np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-5)
 
 
+class BiLSTMClassifier(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.lstm = nn.LSTM(12, 16, num_layers=2, batch_first=True,
+                            bidirectional=True)
+        self.head = nn.Linear(32, 5)
+
+    def forward(self, x):
+        y, _ = self.lstm(x)
+        return self.head(y[:, -1])
+
+
+def test_torch_fx_lstm_import_matches_torch():
+    """nn.LSTM (stacked + bidirectional) imports through fx: each
+    (layer, direction) becomes one FF lstm op, weights transposed and the
+    two torch biases summed."""
+    torch.manual_seed(2)
+    model = BiLSTMClassifier().eval()
+    ptm = PyTorchModel(model)
+    ff = FFModel(FFConfig(batch_size=4))
+    x_t = ff.create_tensor((4, 9, 12), DataType.FLOAT)
+    (out,) = ptm.torch_to_ff(ff, [x_t])
+    sm = ff.softmax(out)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    ptm.copy_weights(ff)
+    x = np.random.RandomState(0).randn(4, 9, 12).astype(np.float32)
+    ours = ff.predict(x)
+    with torch.no_grad():
+        theirs = torch.softmax(model(torch.from_numpy(x)), -1).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-5)
+
+
 class ResidualMLP(nn.Module):
     def __init__(self):
         super().__init__()
@@ -85,6 +117,20 @@ def test_text_ir_roundtrip(tmp_path):
     x_t = ff.create_tensor((4, 3, 32, 32), DataType.FLOAT)
     (out,) = file_to_ff(path, ff, [x_t])
     assert out.shape == (4, 10)
+
+
+def test_text_ir_lstm_roundtrip(tmp_path):
+    """The LSTM classifier (tuple return + y[:, -1] indexing) survives the
+    torch-free text-IR round trip."""
+    model = BiLSTMClassifier()
+    ptm = PyTorchModel(model)
+    path = str(tmp_path / "lstm.ff")
+    ptm.torch_to_file(path)
+
+    ff = FFModel(FFConfig(batch_size=4))
+    x_t = ff.create_tensor((4, 9, 12), DataType.FLOAT)
+    (out,) = file_to_ff(path, ff, [x_t])
+    assert out.shape == (4, 5)
 
 
 def test_keras_sequential_trains():
