@@ -276,9 +276,11 @@ class PyTorchModel:
         fn = node.target
         a = [val(x) for x in node.args]
         if fn is operator.getitem:
-            if isinstance(a[0], (tuple, list)):
+            if not isinstance(a[0], Tensor):
                 # unpacking a module's tuple return (e.g. nn.LSTM's
-                # (output, (h_n, c_n)))
+                # (output, states)) — or hitting a placeholder like
+                # _TorchLSTMStates, whose __getitem__ raises its own
+                # targeted message
                 return a[0][a[1]]
             return self._lower_getitem(ff, a[0], a[1])
         if fn in (operator.add, torch.add):
@@ -449,6 +451,14 @@ class PyTorchModel:
             elif node.op == "call_module":
                 mod = self.traced.get_submodule(node.target)
                 spec = _module_spec(mod)
+                if len(node.args) > 1 or node.kwargs:
+                    # the IR records one input per module line; extra call
+                    # args (e.g. nn.LSTM initial states) would be silently
+                    # dropped and the rebuilt model would diverge
+                    raise NotImplementedError(
+                        f"text-IR: module {node.target} called with extra "
+                        "args/kwargs; only single-input module calls export"
+                    )
                 args = ",".join(a.name for a in node.args
                                 if isinstance(a, torch.fx.Node))
                 lines.append(f"module\t{node.name}\t{args}\t{spec}")
@@ -541,7 +551,7 @@ def _tensor_getitem(ff: FFModel, x: Tensor, idx):
         else:
             raise NotImplementedError(f"index {it!r} not supported")
         sizes = [keep_start, keep_len, size - keep_start - keep_len]
-        keep_pos = sum(1 for s in sizes[:1] if s > 0)
+        keep_pos = int(keep_start > 0)  # a leading piece shifts the kept one
         pieces = ff.split(t, [s for s in sizes if s > 0], axis=dim)
         t = pieces[keep_pos] if isinstance(pieces, list) else pieces
     if squeeze:
@@ -658,10 +668,11 @@ def file_to_ff(path: str, ff: FFModel, input_tensors: Sequence[Tensor]) -> List[
                     v = ts[0]
                     # the index is the SECOND arg (repr-serialized)
                     sub = _parse_index(args[1])
-                    if isinstance(v, (tuple, list)):
-                        env[name] = v[sub]
-                    else:
+                    if isinstance(v, Tensor):
                         env[name] = _tensor_getitem(ff, v, sub)
+                    else:
+                        # tuple returns / placeholders index themselves
+                        env[name] = v[sub]
                 else:
                     raise NotImplementedError(f"text-IR function {fname}")
     return outputs

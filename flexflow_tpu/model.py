@@ -329,11 +329,15 @@ class FFModel:
                          name or "reverse")
 
     def concat(self, tensors: Sequence[Tensor], axis: int, name=None) -> Tensor:
+        # normalize here so attrs-equality (CSE, substitution-rule matching)
+        # never sees axis=-1 and axis=ndim-1 as distinct ops
+        axis = axis % len(tensors[0].shape)
         return self._one(OpType.CONCAT, A.ConcatAttrs(axis), list(tensors),
                          name or "concat")
 
     def split(self, input: Tensor, sizes: Union[int, Sequence[int]], axis: int,
               name=None) -> List[Tensor]:
+        axis = axis % len(input.shape)
         if isinstance(sizes, int):
             total = input.shape[axis]
             sizes = [total // sizes] * sizes

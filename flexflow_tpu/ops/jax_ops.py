@@ -175,7 +175,7 @@ def _sharded_flash(q, k, v, mesh, causal, scale, interpret=False):
     from jax.sharding import PartitionSpec as P
 
     from flexflow_tpu.ops.pallas import flash_attention
-    from flexflow_tpu.parallel.ring import _shard_map
+    from flexflow_tpu.parallel.compat import shard_map as _shard_map
 
     B, S, H, D = q.shape
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -1,0 +1,22 @@
+"""jax version-compat shims shared by the parallel subsystems."""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(fn, mesh, in_specs, out_specs, check_vma: bool = True):
+    """jax.shard_map on current jax; falls back to the pre-0.8
+    jax.experimental.shard_map (where check_vma was named check_rep).
+    check_vma=False opts out of the replication check — pallas_call outputs
+    carry no varying-mesh-axes annotation."""
+    kw = {} if check_vma else {"check_vma": False}
+    try:
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    except (AttributeError, TypeError):  # older jax
+        from jax.experimental.shard_map import shard_map as legacy
+
+        kw = {} if check_vma else {"check_rep": False}
+        return legacy(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

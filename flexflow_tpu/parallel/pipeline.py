@@ -21,8 +21,9 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.parallel.compat import shard_map
 
 
 def _axis_size(mesh, axis: str) -> int:
@@ -119,7 +120,7 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(params_specs, mb_spec),
         out_specs=mb_spec,
-        check_rep=False,
+        check_vma=False,
     )
     out = fn(stacked_params, mb)
     return out.reshape(x.shape[0], *out.shape[2:])

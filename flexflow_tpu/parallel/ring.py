@@ -20,22 +20,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
-def _shard_map(fn, mesh, in_specs, out_specs, check_vma: bool = True):
-    kw = {}
-    if not check_vma:
-        # pallas_call outputs carry no varying-mesh-axes annotation; the
-        # caller opts out of the replication check
-        kw["check_vma"] = False
-    try:
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, **kw)
-    except (AttributeError, TypeError):  # older jax
-        from jax.experimental.shard_map import shard_map
-
-        if not check_vma:
-            kw = {"check_rep": False}
-        return shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, **kw)
+from flexflow_tpu.parallel.compat import shard_map as _shard_map
 
 
 def _mesh_axis_size(mesh, name: str) -> int:
