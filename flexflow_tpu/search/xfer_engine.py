@@ -74,8 +74,9 @@ def _node_pred_activation(n: Node, name: str) -> bool:
 
 
 def _node_pred_attr_eq(n: Node, spec: Sequence) -> bool:
-    field, value = spec
-    return getattr(n.attrs, field, None) == value
+    """[field, value] or [[f1, v1], [f2, v2], ...]."""
+    pairs = spec if isinstance(spec[0], (list, tuple)) else [spec]
+    return all(getattr(n.attrs, f, None) == v for f, v in pairs)
 
 
 def _node_pred_unary_kind(n: Node, kinds: Sequence[str]) -> bool:
@@ -84,6 +85,10 @@ def _node_pred_unary_kind(n: Node, kinds: Sequence[str]) -> bool:
 
 def _node_pred_out_ndim(n: Node, ndim: int) -> bool:
     return bool(n.outputs) and n.outputs[0].ndim == ndim
+
+
+def _node_pred_view_free(n: Node, want: bool) -> bool:
+    return (n.sharding is None) == want
 
 
 def _node_pred_activation_in(n: Node, names: Sequence[str]) -> bool:
@@ -98,6 +103,7 @@ NODE_PREDICATES: Dict[str, Callable[[Node, Any], bool]] = {
     "attr_eq": _node_pred_attr_eq,
     "unary_kind": _node_pred_unary_kind,
     "out_ndim": _node_pred_out_ndim,
+    "view_free": _node_pred_view_free,
 }
 
 
@@ -142,12 +148,38 @@ def _where_cast_identity(nodes: Dict[str, Node], args: Sequence) -> bool:
     return bool(n.in_shapes) and n.in_shapes[0].dtype == n.attrs.dtype
 
 
+_DTYPE_WIDTH = {
+    DataType.BOOL: 0, DataType.INT32: 1, DataType.INT64: 2,
+    DataType.HALF: 1, DataType.BFLOAT16: 1, DataType.FLOAT: 2,
+    DataType.DOUBLE: 3,
+}
+
+
+def _where_cast_chain_safe(nodes: Dict[str, Node], args: Sequence) -> bool:
+    """cast(cast(x, mid), out) == cast(x, out) ONLY when the middle dtype
+    loses nothing: same numeric class as the source and at least as wide
+    (a narrowing or float->int middle step is a real quantization the
+    rewrite would silently remove)."""
+    c1 = nodes[args[0]]
+    if not c1.in_shapes:
+        return False
+    src, mid = c1.in_shapes[0].dtype, c1.attrs.dtype
+    ints = {DataType.BOOL, DataType.INT32, DataType.INT64}
+    if (src in ints) != (mid in ints):
+        return False
+    if src == DataType.HALF and mid == DataType.BFLOAT16 or \
+            src == DataType.BFLOAT16 and mid == DataType.HALF:
+        return False  # same width, different mantissa/exponent split
+    return _DTYPE_WIDTH[mid] >= _DTYPE_WIDTH[src]
+
+
 WHERE_PREDICATES: Dict[str, Callable[[Dict[str, Node], Any], bool]] = {
     "perms_inverse": _where_perms_inverse,
     "attrs_equal": _where_attrs_equal,
     "concat_undoes_split": _where_concat_undoes_split,
     "split_undoes_concat": _where_split_undoes_concat,
     "cast_identity": _where_cast_identity,
+    "cast_chain_safe": _where_cast_chain_safe,
 }
 
 
@@ -1061,6 +1093,161 @@ def gen_default_rules() -> List[Dict]:
 
     # --- 3-way merge (QKV-style: three linears off one input) ------------
     rules.append(_rule_merge_linears(3))
+
+    # --- widening cast-chain collapse ------------------------------------
+    rules.append({
+        "name": "collapse_cast_cast",
+        "src": {
+            "nodes": [{"id": "c1", "type": "CAST"},
+                      {"id": "c2", "type": "CAST"}],
+            "edges": [["c1", 0, "c2", 0]],
+            "inputs": [["x", "c1", 0]],
+            "outputs": [["c2", 0]],
+        },
+        "where": [{"kind": "cast_chain_safe", "args": ["c1", "c2"]}],
+        "dst": {
+            "nodes": [
+                {"id": "c", "type": "CAST", "reuse": "c2", "name": "{c2}",
+                 "attrs": {"dtype": {"$attr": ["c2", "dtype"]}}},
+            ],
+            "inputs": [["x", "c", 0]],
+            "outputs": [["c", 0]],
+        },
+    })
+
+    # --- inception-style conv merge: two same-shape convs off one input.
+    # groups==1 only: concatenating out-channels of grouped convs would
+    # rewire the channel->input-group connectivity.
+    conv_when = {"no_weight_sharding": True, "activation": "NONE",
+                 "attr_eq": [["use_bias", False], ["groups", 1]]}
+    rules.append({
+        "name": "merge_parallel_convs",
+        "src": {
+            "nodes": [{"id": "a", "type": "CONV2D", "when": dict(conv_when)},
+                      {"id": "b", "type": "CONV2D", "when": dict(conv_when)}],
+            "edges": [],
+            "inputs": [["x", "a", 0], ["x", "b", 0]],  # SHARED input
+            "outputs": [["a", 0], ["b", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["a", "b", f]}
+                  for f in ("kernel", "stride", "padding", "groups")],
+        "dst": {
+            "nodes": [
+                {"id": "wide", "type": "CONV2D", "reuse": "a",
+                 "name": "{a}_merged",
+                 "attrs": {
+                     "out_channels": {"$sum": [
+                         {"$attr": ["a", "out_channels"]},
+                         {"$attr": ["b", "out_channels"]},
+                     ]},
+                     "kernel": {"$list_attr": ["a", "kernel"]},
+                     "stride": {"$list_attr": ["a", "stride"]},
+                     "padding": {"$list_attr": ["a", "padding"]},
+                     "groups": {"$attr": ["a", "groups"]},
+                     "use_bias": False,
+                 }},
+                {"id": "sp", "type": "SPLIT", "name": "{a}_split",
+                 "attrs": {
+                     "sizes": [{"$attr": ["a", "out_channels"]},
+                               {"$attr": ["b", "out_channels"]}],
+                     "axis": 1,
+                 }},
+            ],
+            "edges": [["wide", 0, "sp", 0]],
+            "inputs": [["x", "wide", 0]],
+            "outputs": [["sp", 0], ["sp", 1]],
+        },
+    })
+
+    # --- hoist a shared unary past concat: concat(u(a), u(b)) -> u(concat)
+    rules.append({
+        "name": "hoist_unary_over_concat",
+        "src": {
+            "nodes": [
+                {"id": "u1", "type": "ELEMENT_UNARY"},
+                {"id": "u2", "type": "ELEMENT_UNARY"},
+                {"id": "cat", "type": "CONCAT"},
+            ],
+            "edges": [["u1", 0, "cat", 0], ["u2", 0, "cat", 1]],
+            "inputs": [["a", "u1", 0], ["b", "u2", 0]],
+            "outputs": [["cat", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["u1", "u2", f]}
+                  for f in ("kind", "scalar")],
+        "dst": {
+            "nodes": [
+                {"id": "c", "type": "CONCAT", "name": "{cat}",
+                 "attrs": {"axis": {"$attr": ["cat", "axis"]}}},
+                {"id": "u", "type": "ELEMENT_UNARY", "reuse": "u1",
+                 "name": "{u1}",
+                 "attrs": {"kind": {"$attr": ["u1", "kind"]},
+                           "scalar": {"$attr": ["u1", "scalar"]}}},
+            ],
+            "edges": [["c", 0, "u", 0]],
+            "inputs": [["a", "c", 0], ["b", "c", 1]],
+            "outputs": [["u", 0]],
+        },
+    })
+
+    # --- flatten nested same-axis concats --------------------------------
+    rules.append({
+        "name": "flatten_concat_concat",
+        "src": {
+            "nodes": [{"id": "inner", "type": "CONCAT"},
+                      {"id": "outer", "type": "CONCAT"}],
+            "edges": [["inner", 0, "outer", 0]],
+            "inputs": [["a", "inner", 0], ["b", "inner", 1],
+                       ["c", "outer", 1]],
+            "outputs": [["outer", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["inner", "outer", "axis"]}],
+        "dst": {
+            "nodes": [
+                {"id": "flat", "type": "CONCAT", "reuse": "outer",
+                 "name": "{outer}",
+                 "attrs": {"axis": {"$attr": ["outer", "axis"]}}},
+            ],
+            "inputs": [["a", "flat", 0], ["b", "flat", 1], ["c", "flat", 2]],
+            "outputs": [["flat", 0]],
+        },
+    })
+
+    # --- batch-matmul batch-dim partition (attention scores/values on a
+    # hand-built BMM path shard over the batch*heads dim) -----------------
+    for axis in ("model", "seq", "expert"):
+        for ndim in (3, 4):
+            shard = [[axis]] + [[] for _ in range(ndim - 1)]
+            plain = [[] for _ in range(ndim)]
+            rules.append({
+                "name": f"partition_bmm_combine_{axis}"
+                        + ("" if ndim == 3 else f"_{ndim}d"),
+                "requires_axis": axis,
+                "src": {
+                    "nodes": [{"id": "m", "type": "BATCH_MATMUL",
+                               "when": {"view_free": True,
+                                        "out_ndim": ndim}}],
+                    "inputs": [["a", "m", 0], ["b", "m", 1]],
+                    "outputs": [["m", 0]],
+                },
+                "dst": {
+                    "nodes": [
+                        {"id": "m2", "type": "BATCH_MATMUL", "reuse": "m",
+                         "name": "{m}", "attrs": {"$copy": "m"},
+                         "sharding": {
+                             "outputs": [shard],
+                             "weights": {},
+                             "inputs": [shard, shard],
+                         }},
+                        {"id": "comb", "type": "COMBINE",
+                         "name": "{m}_combine",
+                         "attrs": {"dim": 0, "axes": [axis]},
+                         "sharding": {"outputs": [plain], "weights": {}}},
+                    ],
+                    "edges": [["m2", 0, "comb", 0]],
+                    "inputs": [["a", "m2", 0], ["b", "m2", 1]],
+                    "outputs": [["comb", 0]],
+                },
+            })
 
     return rules
 

@@ -356,3 +356,130 @@ def test_merge_parallel_linears_3way():
     sp = [n for n in g.nodes if n.op_type == OpType.SPLIT]
     assert len(sp) == 1 and tuple(sp[0].attrs.sizes) == (64, 32, 32)
     g.infer_shapes()
+
+
+def test_collapse_cast_cast_widening_only():
+    """cast(cast(x, wider), out) collapses; a narrowing middle (a real
+    quantization step) must NOT match."""
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 8), DataType.FLOAT, name="input")
+    t = ff.cast(x, DataType.DOUBLE, name="c1")   # widening middle: safe
+    t = ff.cast(t, DataType.BFLOAT16, name="c2")
+    ff.mean(t, axes=[1], name="m")
+    ff.graph.infer_shapes()
+    cands = _rule("collapse_cast_cast").apply_all(ff.graph)
+    assert len(cands) == 1
+    g = cands[0]
+    casts = [n for n in g.nodes if n.op_type == OpType.CAST]
+    assert len(casts) == 1 and casts[0].attrs.dtype == DataType.BFLOAT16
+
+    ff2 = FFModel(FFConfig(batch_size=4))
+    x2 = ff2.create_tensor((4, 8), DataType.FLOAT, name="input")
+    t2 = ff2.cast(x2, DataType.BFLOAT16, name="c1")  # narrowing middle
+    t2 = ff2.cast(t2, DataType.FLOAT, name="c2")
+    ff2.mean(t2, axes=[1], name="m")
+    ff2.graph.infer_shapes()
+    assert _rule("collapse_cast_cast").apply_all(ff2.graph) == []
+
+
+def test_merge_parallel_convs_inception_branch():
+    """Two same-geometry convs off one input merge into a wide conv +
+    channel split (the inception-branch merge, reference
+    create_merge_convs-style xfers)."""
+    ff = FFModel(FFConfig(batch_size=2))
+    x = ff.create_tensor((2, 8, 16, 16), DataType.FLOAT, name="input")
+    a = ff.conv2d(x, 24, 3, 3, 1, 1, 1, 1, use_bias=False, name="a")
+    b = ff.conv2d(x, 40, 3, 3, 1, 1, 1, 1, use_bias=False, name="b")
+    ff.concat([a, b], axis=1, name="cat")
+    ff.graph.infer_shapes()
+    cands = _rule("merge_parallel_convs").apply_all(ff.graph)
+    assert len(cands) == 1
+    g = cands[0]
+    convs = [n for n in g.nodes if n.op_type == OpType.CONV2D]
+    assert len(convs) == 1 and convs[0].attrs.out_channels == 64
+    sp = [n for n in g.nodes if n.op_type == OpType.SPLIT]
+    assert len(sp) == 1 and tuple(sp[0].attrs.sizes) == (24, 40)
+    assert sp[0].attrs.axis == 1
+    g.infer_shapes()
+
+    # different stride must not merge
+    ff2 = FFModel(FFConfig(batch_size=2))
+    x2 = ff2.create_tensor((2, 8, 16, 16), DataType.FLOAT, name="input")
+    a2 = ff2.conv2d(x2, 24, 3, 3, 1, 1, 1, 1, use_bias=False, name="a")
+    b2 = ff2.conv2d(x2, 24, 3, 3, 2, 2, 1, 1, use_bias=False, name="b")
+    ff2.mean(a2, axes=[1, 2, 3], name="ma")
+    ff2.mean(b2, axes=[1, 2, 3], name="mb")
+    ff2.graph.infer_shapes()
+    assert _rule("merge_parallel_convs").apply_all(ff2.graph) == []
+
+    # grouped convs must not merge: concatenated out-channels would rewire
+    # the channel->input-group connectivity
+    ff3 = FFModel(FFConfig(batch_size=2))
+    x3 = ff3.create_tensor((2, 8, 16, 16), DataType.FLOAT, name="input")
+    a3 = ff3.conv2d(x3, 24, 3, 3, 1, 1, 1, 1, groups=2, use_bias=False,
+                    name="a")
+    b3 = ff3.conv2d(x3, 24, 3, 3, 1, 1, 1, 1, groups=2, use_bias=False,
+                    name="b")
+    ff3.concat([a3, b3], axis=1, name="cat")
+    ff3.graph.infer_shapes()
+    assert _rule("merge_parallel_convs").apply_all(ff3.graph) == []
+
+
+def test_hoist_unary_over_concat():
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 8), DataType.FLOAT, name="ia")
+    y = ff.create_tensor((4, 8), DataType.FLOAT, name="ib")
+    a = ff.relu(x, name="ra")
+    b = ff.relu(y, name="rb")
+    ff.concat([a, b], axis=1, name="cat")
+    ff.graph.infer_shapes()
+    cands = _rule("hoist_unary_over_concat").apply_all(ff.graph)
+    assert len(cands) >= 1
+    g = cands[0]
+    unaries = [n for n in g.nodes if n.op_type == OpType.ELEMENT_UNARY]
+    assert len(unaries) == 1
+    cat = [n for n in g.nodes if n.op_type == OpType.CONCAT][0]
+    # the unary now consumes the concat
+    u = unaries[0]
+    assert [e.src for e in g.in_edges(u)] == [cat.guid]
+    g.infer_shapes()
+    assert u.outputs[0].dims[1].size == 16
+
+
+def test_flatten_concat_concat():
+    ff = FFModel(FFConfig(batch_size=4))
+    xs = [ff.create_tensor((4, 8), DataType.FLOAT, name=f"i{k}")
+          for k in range(3)]
+    inner = ff.concat(xs[:2], axis=1, name="inner")
+    ff.concat([inner, xs[2]], axis=1, name="outer")
+    ff.graph.infer_shapes()
+    cands = _rule("flatten_concat_concat").apply_all(ff.graph)
+    assert len(cands) == 1
+    g = cands[0]
+    cats = [n for n in g.nodes if n.op_type == OpType.CONCAT]
+    assert len(cats) == 1 and len(g.in_edges(cats[0])) == 3
+    g.infer_shapes()
+    assert cats[0].outputs[0].dims[1].size == 24
+
+
+def test_partition_bmm_combine_applies():
+    """The BMM batch-dim partition rule shards a hand-built attention-style
+    batched matmul over `model` with an explicit Combine."""
+    ff = FFModel(FFConfig(batch_size=4))
+    a = ff.create_tensor((8, 16, 32), DataType.FLOAT, name="a")
+    b = ff.create_tensor((8, 32, 16), DataType.FLOAT, name="b")
+    m = ff.batch_matmul(a, b, name="bmm")
+    ff.mean(m, axes=[1, 2], name="mean")
+    ff.graph.infer_shapes()
+    rule = _rule("partition_bmm_combine_model")
+    cands = rule.apply_all(ff.graph)
+    assert len(cands) == 1
+    g = cands[0]
+    bmm = [n for n in g.nodes if n.op_type == OpType.BATCH_MATMUL][0]
+    assert bmm.sharding is not None
+    assert bmm.sharding.output_specs[0][0] == ("model",)
+    comb = [n for n in g.nodes if n.op_type == OpType.COMBINE]
+    assert len(comb) == 1 and comb[0].attrs.dim == 0
+    g.infer_shapes()
+    # idempotent: the sharded BMM no longer matches (view_free guard)
+    assert rule.apply_all(g) == []
