@@ -365,7 +365,13 @@ def _pick_block(n: int, want: int) -> Optional[int]:
 
 def flash_attention_available(S: int, T: int, *, dropout: float = 0.0,
                               interpret: bool = False) -> bool:
-    """True when the Pallas path supports these shapes on this backend."""
+    """True when the Pallas path supports these shapes on this backend.
+    FF_TPU_NO_FLASH=1 disables every flash dispatch site (plain, ring,
+    Ulysses) — A/B runs and kernel-bug escape hatch."""
+    import os
+
+    if os.environ.get("FF_TPU_NO_FLASH") == "1":
+        return False
     if dropout > 0.0:
         return False
     if _pick_block(S, 512) is None or _pick_block(T, 512) is None:
