@@ -249,26 +249,28 @@ class Graph:
                 g._in[e.dst].append(e)
         return a, b
 
-    def connected_components_ignoring(self, node: Node) -> List[Set[Node]]:
-        """Weakly-connected components of the graph with `node` removed —
-        used to find horizontal splits around a bottleneck."""
-        rest = [n for n in self.nodes if n.guid != node.guid]
+    def connected_components(self, within: Set[Node]) -> List[Set[Node]]:
+        """Weakly-connected components of the subgraph induced on `within`:
+        only edges with BOTH endpoints inside couple nodes. Used for
+        horizontal splits (around a bottleneck, or of independently
+        searchable regions in the view DP)."""
+        keep = {n.guid for n in within}
         seen: Set[int] = set()
         comps: List[Set[Node]] = []
-        adj: Dict[int, Set[int]] = {n.guid: set() for n in rest}
-        for n in rest:
-            for e in self._out[n.guid]:
-                if e.dst != node.guid and e.src != node.guid:
+        adj: Dict[int, Set[int]] = {g: set() for g in keep}
+        for g in keep:
+            for e in self._out[g]:
+                if e.dst in keep:
                     adj[e.src].add(e.dst)
                     adj[e.dst].add(e.src)
-            for e in self._in[n.guid]:
-                if e.dst != node.guid and e.src != node.guid:
+            for e in self._in[g]:
+                if e.src in keep:
                     adj[e.src].add(e.dst)
                     adj[e.dst].add(e.src)
-        for n in rest:
-            if n.guid in seen:
+        for g0 in keep:
+            if g0 in seen:
                 continue
-            comp, stack = set(), [n.guid]
+            comp, stack = set(), [g0]
             while stack:
                 g = stack.pop()
                 if g in seen:
@@ -278,6 +280,12 @@ class Graph:
                 stack.extend(adj[g] - seen)
             comps.append(comp)
         return comps
+
+    def connected_components_ignoring(self, node: Node) -> List[Set[Node]]:
+        """Weakly-connected components of the graph with `node` removed."""
+        return self.connected_components(
+            {n for n in self.nodes if n.guid != node.guid}
+        )
 
     # ---- hashing / export ----
 

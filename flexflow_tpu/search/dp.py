@@ -30,6 +30,11 @@ class ViewDP:
         # objective(time, memory_per_chip) -> scalar; None = pure run time.
         # The memory-λ search (graph.cc:2046) passes a blend here so the DP
         # itself prefers memory-lean views, not just the outer loop.
+        # CONTRACT: must be LINEAR in (time, memory) — the horizontal
+        # decomposition solves independent components separately, which is
+        # exact only when the objective distributes over the additive cost
+        # terms (the built-in λ-blend does; a hard-threshold penalty would
+        # not).
         self.objective = objective
         # exhaustive base case bound: total view-combination count, not node
         # count — a 6-node module with 3 views each (432 combos) is cheap to
@@ -37,6 +42,7 @@ class ViewDP:
         # (col-linear → sharded elementwise → row-linear must flip together)
         self.product_cap = product_cap
         self._memo: Dict = {}
+        self._cands_memo: Dict[int, Dict[str, List[ShardingView]]] = {}
 
     def optimize(self, graph: Graph) -> Dict[str, ShardingView]:
         strategy = self._solve(graph, {})
@@ -58,6 +64,12 @@ class ViewDP:
         return result
 
     def _candidates(self, graph: Graph) -> Dict[str, List[ShardingView]]:
+        # memoized by structure: per-component sub-solves re-enter with the
+        # same graph and must not redo full enumeration
+        ck = graph.structure_hash()
+        hit = self._cands_memo.get(ck)
+        if hit is not None:
+            return hit
         out = {}
         for n in graph.nodes:
             views = space.enumerate_views(
@@ -73,7 +85,19 @@ class ViewDP:
                 views = [n.sharding] + views
             if len(views) > 1:
                 out[n.name] = views
+        self._cands_memo[ck] = out
         return out
+
+    def _searchable_components(self, graph: Graph,
+                               cands: Dict[str, List[ShardingView]]):
+        """Connected components of the searchable nodes, linked only by
+        DIRECT searchable-searchable edges (paths through fixed or
+        choice-free nodes do not couple choices: those nodes' views are
+        constants, so every cost term factors per component)."""
+        names = set(cands)
+        within = {n for n in graph.nodes if n.name in names}
+        return [{n.name for n in comp}
+                for comp in graph.connected_components(within)]
 
     def _eval(self, graph: Graph, strategy: Dict[str, ShardingView]) -> float:
         gc = graph_cost(graph, strategy, self.cost, self.training)
@@ -123,6 +147,24 @@ class ViewDP:
                     best_assign, best_cost = list(assign), c
             strategy = dict(fixed)
             strategy.update(table.to_strategy(best_assign))
+            return strategy
+
+        # horizontal decomposition (graph.cc:267 / split_horizontal's role):
+        # searchable nodes whose every connection to the other searchable
+        # nodes runs through a fixed or choice-free node are independent —
+        # node, edge, and weight-sync costs all separate — so each component
+        # solves exactly on its own (often making the exhaustive base case
+        # reachable where the joint product blows the cap)
+        comps = self._searchable_components(graph, cands)
+        if len(comps) > 1:
+            strategy = dict(fixed)
+            for comp in comps:
+                f = dict(fixed)
+                for name in cands:
+                    if name not in comp:
+                        f[name] = cands[name][0]  # pinned; costs separate
+                sub = self._solve(graph, f)
+                strategy.update({k: v for k, v in sub.items() if k in comp})
             return strategy
 
         # sequence split at a bottleneck (graph.cc:115)

@@ -362,3 +362,47 @@ def test_native_simulator_overlaps_grad_sync():
     assert sim >= 40.0                     # compute channel is the floor
     # first 3 syncs hide under the remaining compute; the last one tails
     assert sim == pytest.approx(46.0)
+
+
+def test_view_dp_horizontal_decomposition():
+    """Independent branches between choice-free boundaries decompose: each
+    solves exactly (per-branch exhaustive) even when the JOINT product
+    blows the cap — split_horizontal's role in the reference DP
+    (graph.cc:267)."""
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 4096), DataType.FLOAT, name="input")
+    branches = []
+    for b in range(2):
+        t = x
+        for i in range(4):
+            t = ff.dense(t, 4096, use_bias=False, name=f"b{b}_d{i}")
+        branches.append(t)
+    ff.concat(branches, axis=1, name="cat")
+    ff.graph.infer_shapes()
+
+    axis_sizes = {"data": 2, "model": 4}
+    cost = CostModel(TPUMachineModel.make("v5e", 8), axis_sizes)
+    dp = ViewDP(cost, product_cap=300)  # joint product >> cap
+
+    cands = dp._candidates(ff.graph)
+    # with the shared boundary nodes (input, concat) fixed — as the
+    # bottleneck sequence split does — the two chains are separate
+    # components
+    comps = dp._searchable_components(
+        ff.graph, {k: v for k, v in cands.items()
+                   if k not in ("cat", "input")})
+    assert len(comps) == 2
+    assert {n.split("_")[0] for n in comps[0]} in ({"b0"}, {"b1"})
+
+    strategy = dp.optimize(ff.graph)
+    t_dp = graph_cost(ff.graph, strategy, cost).time
+    base = default_dp_strategy(ff.graph, axis_sizes)
+    t_base = graph_cost(ff.graph, base, cost).time
+    # big weights, batch 8: TP must beat plain DP, and the decomposed
+    # search must find it on BOTH branches
+    assert t_dp < t_base
+    sharded = [n for n, v in strategy.items()
+               if n.startswith("b") and v.weight_specs.get("kernel")
+               and any(v.weight_specs["kernel"])]
+    assert any(n.startswith("b0") for n in sharded)
+    assert any(n.startswith("b1") for n in sharded)
