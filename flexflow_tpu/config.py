@@ -58,6 +58,11 @@ class FFConfig:
     # the search cost model (reference measure_operator_cost discipline,
     # simulator.cc:537); cache file avoids re-measuring across runs
     measure_costs: bool = False
+    # after the model-based search, compile the top-k candidate strategies'
+    # REAL train steps and keep the empirically fastest (SURVEY §7: XLA
+    # fusion makes op-sum != program time, so the final ranking is timed,
+    # not modeled). 0/1 = off; costs k-1 extra compiles at compile() time.
+    validate_top_k: int = 0
     measure_cache_file: Optional[str] = None
     # cost strategies with the native event-driven task-graph simulator
     # (ffsim_simulate — Simulator::simulate_runtime analog) instead of the
@@ -149,6 +154,8 @@ class FFConfig:
                 }
             elif a == "--budget" or a == "--search-budget":
                 cfg.search_budget = int(take())
+            elif a == "--validate-top-k":
+                cfg.validate_top_k = int(take())
             elif a == "--alpha" or a == "--search-alpha":
                 cfg.search_alpha = float(take())
             elif a == "--only-data-parallel":

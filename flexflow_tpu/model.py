@@ -78,6 +78,10 @@ class FFModel:
         self._cache_snapshots: Dict[str, object] = {}
         self._used_names: set = set()
         self._rng_seed = self.config.seed
+        # set by compile() when validate_top_k >= 2 ran the empirical
+        # strategy validation: {"timed_ms", "modeled_ms",
+        # "picked_modeled_rank"}
+        self.strategy_validation: Optional[Dict] = None
         self._step_count = 0
         self._fit_calls = 0
         self.current_metrics: Optional[PerfMetrics] = None
@@ -560,13 +564,18 @@ class FFModel:
                 strategy = {
                     k: view_from_json(v) for k, v in _json.load(f).items()
                 }
+        search_candidates: List = []
         if strategy is None and not cfg.only_data_parallel and cfg.search_budget > 0:
             from flexflow_tpu.runtime import distributed as dist
 
             if cfg.search_budget > 5 and not dist.is_multi_host():
                 from flexflow_tpu.search.api import graph_optimize
 
-                self.graph, strategy = graph_optimize(self.graph, self._mesh, cfg)
+                self.graph, strategy = graph_optimize(
+                    self.graph, self._mesh, cfg,
+                    candidates_out=(search_candidates
+                                    if cfg.validate_top_k > 1 else None),
+                )
             else:
                 # multi-host uses the views-only search: the strategy dict
                 # broadcast below covers it, whereas a graph-rewriting
@@ -581,30 +590,19 @@ class FFModel:
             if dist.is_multi_host():
                 strategy = dist.broadcast_strategy(strategy, self._mesh)
 
+        validated_executor = None
+        if len(search_candidates) > 1:
+            self.graph, strategy, validated_executor = self._validate_candidates(
+                search_candidates[: cfg.validate_top_k]
+            )
+
         # default DP: shard every INPUT's batch dim over "data"; explicit
         # strategy views override per node name
-        data_degree = dict(zip(self._mesh.axis_names, self._mesh.devices.shape)).get(
-            "data", 1
-        )
-        for n in self.graph.nodes:
-            if strategy and n.name in strategy:
-                n.sharding = strategy[n.name]
-            elif n.op_type == OpType.INPUT and data_degree > 1:
-                shape = n.outputs[0]
-                if shape.dims[0].size % data_degree == 0:
-                    n.sharding = ShardingView((batch_spec(shape.ndim),))
+        self._apply_strategy(self.graph, strategy)
 
-        self._executor = Executor(
-            self.graph,
-            self._mesh,
-            loss_type=loss_type,
-            metrics=self._metrics,
-            optimizer=self._optimizer,
-            seq_length=cfg.seq_length,
-            donate=cfg.donate_buffers,
-            remat=cfg.remat,
-            zero_sharded_opt=cfg.param_sync == ParamSyncType.SHARDED,
-        )
+        # the winner's executor already compiled its train step during the
+        # timed playoff — reuse it (params re-init below, same seed)
+        self._executor = validated_executor or self._build_executor(self.graph)
         rng = jax.random.key(cfg.seed)
         self._params = self._executor.init_params(rng, self._init_overrides)
         self._opt_state = self._executor.init_opt_state(
@@ -633,6 +631,109 @@ class FFModel:
             with open(cfg.export_strategy_computation_graph_file, "w") as f:
                 f.write(self.graph.to_dot(costs=costs))
         return self
+
+    def _apply_strategy(self, graph, strategy) -> None:
+        """Attach strategy views to nodes; unnamed INPUTs default to
+        batch-over-data sharding."""
+        data_degree = dict(
+            zip(self._mesh.axis_names, self._mesh.devices.shape)
+        ).get("data", 1)
+        for n in graph.nodes:
+            if strategy and n.name in strategy:
+                n.sharding = strategy[n.name]
+            elif n.op_type == OpType.INPUT and data_degree > 1:
+                shape = n.outputs[0]
+                if shape.dims[0].size % data_degree == 0:
+                    n.sharding = ShardingView((batch_spec(shape.ndim),))
+
+    def _build_executor(self, graph) -> Executor:
+        cfg = self.config
+        return Executor(
+            graph,
+            self._mesh,
+            loss_type=self._loss_type,
+            metrics=self._metrics,
+            optimizer=self._optimizer,
+            seq_length=cfg.seq_length,
+            donate=cfg.donate_buffers,
+            remat=cfg.remat,
+            zero_sharded_opt=cfg.param_sync == ParamSyncType.SHARDED,
+        )
+
+    def _validate_candidates(self, candidates):
+        """Empirical top-k strategy validation (SURVEY §7 mitigation: 'cost
+        the whole step for top-k candidate strategies' — XLA fusion makes
+        the op-sum model an imperfect ranking). Compiles each candidate's
+        REAL train step on the target mesh, times a few steps on synthetic
+        data, and keeps the fastest. Records the outcome in
+        self.strategy_validation."""
+        import time as _time
+
+        import jax
+
+        results = []  # (timed, modeled_rank, graph, strategy, executor)
+        for rank, (modeled, graph, strategy) in enumerate(candidates):
+            try:
+                self._apply_strategy(graph, strategy)
+                ex = self._build_executor(graph)
+                rng = jax.random.key(self.config.seed)
+                params = ex.init_params(rng, self._init_overrides)
+                opt_state = ex.init_opt_state(self._optimizer, params[0])
+                step = ex.train_step()
+                inputs = [
+                    jax.device_put(np.zeros(
+                        tuple(d.size for d in n.outputs[0].dims),
+                        n.outputs[0].dtype.jnp_dtype,
+                    ))
+                    for n in graph.nodes if n.op_type == OpType.INPUT
+                ]
+                labels = jax.device_put(self._synth_labels(graph))
+                tr, ntr = params
+                # the step donates (tr, ntr, opt): rebind every call
+                tr, ntr, opt_state, m = step(tr, ntr, opt_state, rng,
+                                             labels, *inputs)
+                float(np.asarray(m["loss"]))  # sync (tunnel-safe)
+                t0 = _time.perf_counter()
+                for _ in range(3):
+                    tr, ntr, opt_state, m = step(tr, ntr, opt_state, rng,
+                                                 labels, *inputs)
+                float(np.asarray(m["loss"]))
+                dt = (_time.perf_counter() - t0) / 3
+                results.append((dt, rank, graph, strategy, ex))
+            except Exception as e:  # an uncompilable candidate loses, only
+                import warnings
+
+                warnings.warn(f"strategy candidate failed validation: {e}")
+        if not results:
+            _, g, s = candidates[0]
+            return g, s, None
+        results.sort(key=lambda r: r[0])
+        self.strategy_validation = {
+            "timed_ms": [r[0] * 1e3 for r in results],
+            # modeled rank (0 = the model's own pick) per timed entry —
+            # honest even when some candidates failed to compile
+            "modeled_ranks": [r[1] for r in results],
+            "modeled_ms": [candidates[r[1]][0] * 1e3 for r in results],
+            "picked_modeled_rank": results[0][1],
+        }
+        if self.config.profiling:
+            timed = ", ".join(f"{r[0]*1e3:.2f}" for r in results)
+            print(f"[search] top-{len(results)} validated (ms/step): {timed}")
+        return results[0][2], results[0][3], results[0][4]
+
+    def _synth_labels(self, graph):
+        """Zero labels for the timed playoff (values never matter). Shaped
+        like what fit() passes: the INPUT batch size + the sink's middle
+        dims — NOT the sink batch, which AggregateSpec graphs inflate by
+        label_repeats (the executor re-repeats labels itself)."""
+        sink = [n for n in graph.nodes if not graph.succs(n)][0]
+        out = sink.outputs[0]
+        first_input = next(n for n in graph.nodes if n.op_type == OpType.INPUT)
+        b = first_input.outputs[0].dims[0].size
+        dims = (b,) + tuple(d.size for d in out.dims[1:])
+        if self._loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            return np.zeros(dims[:-1], np.int32)
+        return np.zeros(dims, np.float32)
 
     @property
     def mesh(self):

@@ -85,12 +85,17 @@ def search_strategy(graph, mesh, config) -> Dict[str, ShardingView]:
     return mcmc_search(graph, mesh, config, cost=cost)
 
 
-def graph_optimize(graph: Graph, mesh, config) -> Tuple[Graph, Dict[str, ShardingView]]:
+def graph_optimize(graph: Graph, mesh, config,
+                   candidates_out=None) -> Tuple[Graph, Dict[str, ShardingView]]:
     """Full Unity search: substitutions + view DP. Returns (possibly
-    rewritten graph, strategy)."""
+    rewritten graph, strategy). `candidates_out`: optional list receiving
+    the top-k modeled candidates for empirical whole-step validation
+    (flat best-first path only; the sequence-DP and memory-λ paths return
+    a single stitched result)."""
     from flexflow_tpu.search.substitution import (
         memory_lambda_search,
         pick_search_fn,
+        unity_search,
     )
 
     cost = _cost_model(mesh, config)
@@ -119,11 +124,26 @@ def graph_optimize(graph: Graph, mesh, config) -> Tuple[Graph, Dict[str, Shardin
     # deep graphs: sequence-DP decomposition at module boundaries
     # (generic_sequence_optimize, substitution.cc:2572) — per-module
     # best-first is ~linear in depth where the flat search is not
-    best_graph, strategy, best_time = pick_search_fn(graph)(
+    fn = pick_search_fn(graph)
+    kw = {}
+    if candidates_out is not None:
+        if fn is unity_search:
+            kw["candidates_out"] = candidates_out
+            kw["candidates_k"] = max(getattr(config, "validate_top_k", 0), 2)
+        else:
+            import warnings
+
+            warnings.warn(
+                "validate_top_k: the sequence-DP search path stitches one "
+                "per-module result and cannot collect whole-graph "
+                "candidates; empirical validation is skipped for this graph"
+            )
+    best_graph, strategy, best_time = fn(
         graph,
         cost,
         budget=config.search_budget,
         alpha=config.search_alpha,
+        **kw,
     )
     if config.profiling:
         print(f"[search] best estimated step time {best_time * 1e3:.3f} ms")

@@ -516,6 +516,8 @@ def unity_search(
     use_dp: bool = True,
     memory_limit: Optional[float] = None,
     objective=None,
+    candidates_out: Optional[List] = None,
+    candidates_k: int = 4,
 ) -> Tuple[Graph, Dict[str, ShardingView], float]:
     """Best-first search over substitution rewrites; each candidate graph is
     costed at its optimal views (ViewDP when `use_dp`, else current views +
@@ -523,7 +525,13 @@ def unity_search(
     over `memory_limit` bytes/chip are heavily penalized (the reference's
     is_valid_strategy memory check, graph.cc:1983). `objective(time, mem)`
     replaces the pure-time ranking when given (memory-λ search). Returns
-    (best graph, best strategy, best cost)."""
+    (best graph, best strategy, best cost).
+
+    `candidates_out`: when a list is passed, the `candidates_k` best
+    DISTINCT candidates seen during the search are kept in it as
+    (modeled_cost, graph, strategy), best first — the pool for empirical
+    whole-step validation (SURVEY §7: 'cost the whole step for top-k
+    candidate strategies', compensating for model-vs-XLA-fusion gaps)."""
     from flexflow_tpu.search.dp import ViewDP
 
     xfers = xfers if xfers is not None else default_xfers(cost.axis_sizes)
@@ -552,8 +560,16 @@ def unity_search(
             t += 1e3 * (gc.memory_per_chip / memory_limit)
         return t, s
 
+    def collect(c: float, g: Graph, s: Dict[str, ShardingView]) -> None:
+        if candidates_out is None:
+            return
+        candidates_out.append((c, g, s))
+        candidates_out.sort(key=lambda t: t[0])
+        del candidates_out[candidates_k:]
+
     best_graph = graph
     best_cost, best_strategy = evaluate(graph)
+    collect(best_cost, graph, best_strategy)
     seen = {graph.structure_hash()}
     counter = itertools.count()
     heap = [(best_cost, next(counter), graph)]
@@ -570,6 +586,7 @@ def unity_search(
                     continue
                 seen.add(h)
                 cc, ss = evaluate(cand)
+                collect(cc, cand, ss)
                 if cc < best_cost:
                     best_graph, best_cost, best_strategy = cand, cc, ss
                 if cc <= alpha * best_cost:

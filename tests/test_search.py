@@ -406,3 +406,26 @@ def test_view_dp_horizontal_decomposition():
                and any(v.weight_specs["kernel"])]
     assert any(n.startswith("b0") for n in sharded)
     assert any(n.startswith("b1") for n in sharded)
+
+
+def test_validate_top_k_picks_timed_winner():
+    """validate_top_k compiles the top modeled candidates' real train steps
+    and keeps the empirically fastest (SURVEY §7: op-sum model != program
+    time under XLA fusion)."""
+    ff = FFModel(FFConfig(batch_size=8, search_budget=8, validate_top_k=2,
+                          mesh_shape={"data": 2, "model": 4}))
+    x = ff.create_tensor((8, 2048), DataType.FLOAT, name="input")
+    t = x
+    for i in range(2):
+        t = ff.dense(t, 2048, name=f"dense{i}")
+    ff.softmax(t, name="softmax")
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    v = ff.strategy_validation
+    assert v is not None and 1 <= len(v["timed_ms"]) <= 2
+    assert v["timed_ms"] == sorted(v["timed_ms"])  # winner first
+    # the picked strategy still trains
+    xs = np.random.RandomState(0).randn(16, 2048).astype(np.float32)
+    ys = np.random.RandomState(1).randint(0, 2048, 16).astype(np.int32)
+    m = ff.fit(xs, ys, epochs=1, verbose=False)
+    assert m.train_all == 16
