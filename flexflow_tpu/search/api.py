@@ -89,13 +89,13 @@ def graph_optimize(graph: Graph, mesh, config,
                    candidates_out=None) -> Tuple[Graph, Dict[str, ShardingView]]:
     """Full Unity search: substitutions + view DP. Returns (possibly
     rewritten graph, strategy). `candidates_out`: optional list receiving
-    the top-k modeled candidates for empirical whole-step validation
-    (flat best-first path only; the sequence-DP and memory-λ paths return
-    a single stitched result)."""
+    the top-k modeled candidates for empirical whole-step validation. The
+    flat best-first path fills it with its k best distinct candidates;
+    the sequence-DP stitched path contributes a winner-vs-unrewritten-
+    baseline pair instead; only the memory-λ path skips collection."""
     from flexflow_tpu.search.substitution import (
         memory_lambda_search,
         pick_search_fn,
-        unity_search,
     )
 
     cost = _cost_model(mesh, config)
@@ -127,17 +127,8 @@ def graph_optimize(graph: Graph, mesh, config,
     fn = pick_search_fn(graph)
     kw = {}
     if candidates_out is not None:
-        if fn is unity_search:
-            kw["candidates_out"] = candidates_out
-            kw["candidates_k"] = max(getattr(config, "validate_top_k", 0), 2)
-        else:
-            import warnings
-
-            warnings.warn(
-                "validate_top_k: the sequence-DP search path stitches one "
-                "per-module result and cannot collect whole-graph "
-                "candidates; empirical validation is skipped for this graph"
-            )
+        kw["candidates_out"] = candidates_out
+        kw["candidates_k"] = max(getattr(config, "validate_top_k", 0), 2)
     best_graph, strategy, best_time = fn(
         graph,
         cost,
@@ -145,6 +136,22 @@ def graph_optimize(graph: Graph, mesh, config,
         alpha=config.search_alpha,
         **kw,
     )
+    if candidates_out is not None and not candidates_out:
+        # the sequence-DP path stitched per-module results and built no
+        # whole-graph pool; give the playoff the next-best pair — the
+        # stitched winner vs the UNREWRITTEN graph at its own optimal
+        # views (catches a search result that models faster but compiles
+        # slower than the plain graph)
+        from flexflow_tpu.search.cost_model import graph_cost
+        from flexflow_tpu.search.dp import ViewDP
+
+        base_strategy = ViewDP(cost).optimize(graph)
+        base_time = graph_cost(graph, base_strategy, cost).time
+        pool = [(best_time, best_graph, strategy)]
+        if (best_graph.structure_hash() != graph.structure_hash()
+                or strategy != base_strategy):
+            pool.append((base_time, graph, base_strategy))
+        candidates_out.extend(sorted(pool, key=lambda t: t[0]))
     if config.profiling:
         print(f"[search] best estimated step time {best_time * 1e3:.3f} ms")
     return best_graph, strategy

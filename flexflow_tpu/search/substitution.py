@@ -108,7 +108,15 @@ def _replace_node(graph: Graph, old: Node, make_nodes) -> Graph:
     g.remove_node(node)
 
     def reuse(op_type, attrs, name):
-        return g.add_node(Node(old.guid, op_type, attrs, name))
+        n = g.add_node(Node(old.guid, op_type, attrs, name))
+        # seed shapes from the replaced node: in a module SUBGRAPH (sequence
+        # decomposition) the producers may live outside this graph, so
+        # infer_shapes cannot resolve the entry node's inputs — it keeps
+        # these cached shapes instead (graph.py infer_shapes guard)
+        n.in_shapes = old.in_shapes
+        if old.in_shapes:
+            n.outputs = tuple(attrs.infer(*old.in_shapes))
+        return n
 
     entry, exit_ = make_nodes(g, reuse)
     for e in in_edges:
@@ -418,13 +426,20 @@ def sequence_unity_search(
     memory_limit: Optional[float] = None,
     min_module: int = 6,
     objective=None,
+    candidates_out: Optional[List] = None,
+    candidates_k: int = 4,
 ) -> Tuple[Graph, Dict[str, ShardingView], float]:
     """Sequence-DP outer decomposition (reference generic_sequence_optimize,
     substitution.cc:2572): split the PCG at module boundaries, run the
     budgeted best-first substitution search per module, and stitch the
     rewritten modules + strategies back together. Keeps the search tractable
     on deep graphs (a 32-layer Llama is ~66 small solves instead of one
-    best-first over ~450 nodes)."""
+    best-first over ~450 nodes).
+
+    `candidates_out`: forwarded to the flat search when the graph has too
+    few module boundaries to decompose; the stitched path cannot build a
+    whole-graph pool itself (graph_optimize adds the winner-vs-baseline
+    pair instead)."""
     splits = [
         s for s in find_split_nodes(graph)
         if s.op_type not in PARALLEL_OP_TYPES
@@ -439,7 +454,9 @@ def sequence_unity_search(
     if len(spaced) < 2 or len(graph) <= 2 * min_module:
         return unity_search(graph, cost, budget=budget, alpha=alpha,
                             training=training, xfers=xfers,
-                            memory_limit=memory_limit, objective=objective)
+                            memory_limit=memory_limit, objective=objective,
+                            candidates_out=candidates_out,
+                            candidates_k=candidates_k)
 
     modules: List[Graph] = []
     rest = graph

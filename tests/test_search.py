@@ -429,3 +429,20 @@ def test_validate_top_k_picks_timed_winner():
     ys = np.random.RandomState(1).randint(0, 2048, 16).astype(np.int32)
     m = ff.fit(xs, ys, epochs=1, verbose=False)
     assert m.train_all == 16
+
+
+def test_validate_top_k_deep_graph_baseline_playoff():
+    """Deep graphs (> sequence-DP threshold) still get an empirical
+    playoff: the stitched search winner vs the unrewritten graph at its
+    own optimal views."""
+    ff = FFModel(FFConfig(batch_size=8, search_budget=8, validate_top_k=2,
+                          mesh_shape={"data": 2, "model": 4}))
+    x = ff.create_tensor((8, 256), DataType.FLOAT, name="input")
+    t = x
+    for i in range(42):  # > SEQUENCE_SEARCH_MIN_NODES incl. input/softmax
+        t = ff.dense(t, 256, use_bias=False, name=f"d{i}")
+    ff.softmax(t, name="softmax")
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    v = ff.strategy_validation
+    assert v is not None and len(v["timed_ms"]) >= 1
