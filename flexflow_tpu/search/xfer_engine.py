@@ -698,16 +698,18 @@ def _rule_gated_mlp(axis: str, ndim: int) -> Dict:
     }
 
 
-def _rule_merge_linears(n: int) -> Dict:
+def _rule_merge_linears(n: int, ndim: int = 2) -> Dict:
     """TASO-style merge: n bias-free linears off the SAME input fuse into
-    one wide linear + split (exact given the concatenated-weight mapping).
-    n=2 is the classic pair merge; n=3 is the QKV shape."""
+    one wide linear + split on the feature (last) dim (exact given the
+    concatenated-weight mapping). n=2 is the classic pair merge (the
+    gate/up pair of a gated MLP at ndim=3); n=3 is the QKV shape."""
     ids = ["a", "b", "c", "d"][:n]
     when = {"activation": "NONE", "attr_eq": ["use_bias", False],
-            "out_ndim": 2}
+            "out_ndim": ndim}
     stem = "_".join("{%s}" % i for i in ids)
     return {
-        "name": "merge_parallel_linears" + ("" if n == 2 else f"_{n}"),
+        "name": "merge_parallel_linears" + ("" if n == 2 else f"_{n}")
+                + _nd_suffix(ndim),
         "src": {
             "nodes": [{"id": i, "type": "LINEAR", "when": dict(when)}
                       for i in ids],
@@ -729,7 +731,7 @@ def _rule_merge_linears(n: int) -> Dict:
                 {"id": "sp", "type": "SPLIT", "name": f"{stem}_split",
                  "attrs": {
                      "sizes": [{"$attr": [i, "out_dim"]} for i in ids],
-                     "axis": 1,
+                     "axis": ndim - 1,
                  }},
             ],
             "edges": [["wide", 0, "sp", 0]],
@@ -881,6 +883,7 @@ def gen_default_rules() -> List[Dict]:
 
     # --- TASO-style merge: n linears sharing an input -> wide + split ---
     rules.append(_rule_merge_linears(2))
+    rules.append(_rule_merge_linears(2, ndim=3))
 
     # --- parallelization rules (explicit parallel-op insertions) --------
     # linear column/row TP per mesh axis and activation rank (the
@@ -1093,6 +1096,7 @@ def gen_default_rules() -> List[Dict]:
 
     # --- 3-way merge (QKV-style: three linears off one input) ------------
     rules.append(_rule_merge_linears(3))
+    rules.append(_rule_merge_linears(3, ndim=3))
 
     # --- widening cast-chain collapse ------------------------------------
     rules.append({

@@ -483,3 +483,24 @@ def test_partition_bmm_combine_applies():
     g.infer_shapes()
     # idempotent: the sharded BMM no longer matches (view_free guard)
     assert rule.apply_all(g) == []
+
+
+def test_merge_parallel_linears_3d_gate_up():
+    """The 3D merge variant fuses a gated-MLP's gate/up pair (3D
+    activations) into one wide matmul + last-dim split — the 2D-only rule
+    could never match transformer blocks."""
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 16, 64), DataType.FLOAT, name="input")
+    g = ff.dense(x, 128, use_bias=False, name="gate")
+    u = ff.dense(x, 128, use_bias=False, name="up")
+    ff.multiply(ff.silu(g, name="silu"), u, name="gxu")
+    ff.graph.infer_shapes()
+    cands = _rule("merge_parallel_linears_3d").apply_all(ff.graph)
+    assert len(cands) == 1
+    gr = cands[0]
+    wide = [n for n in gr.nodes if n.op_type == OpType.LINEAR]
+    assert len(wide) == 1 and wide[0].attrs.out_dim == 256
+    sp = [n for n in gr.nodes if n.op_type == OpType.SPLIT][0]
+    assert sp.attrs.axis == 2 and tuple(sp.attrs.sizes) == (128, 128)
+    gr.infer_shapes()
+    assert [d.size for d in sp.outputs[0].dims] == [4, 16, 128]
