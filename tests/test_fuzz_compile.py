@@ -69,3 +69,30 @@ def test_random_graph_search_compile_train(seed):
     p = ff.predict(x[:16])
     assert p.shape == (16, n_classes)
     assert np.isfinite(np.asarray(p)).all()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_random_lstm_stack_compile_train(seed):
+    """Recurrent fuzz: random LSTM stacks (depth, direction, state handoff)
+    survive compile + sharded training with finite loss."""
+    rs = np.random.RandomState(seed + 100)
+    b, s, in_dim, classes = 8, 12, 16, 3
+    hid = int(rs.choice([16, 24]))
+    ff = FFModel(FFConfig(batch_size=b, seed=seed,
+                          mesh_shape={"data": 2, "model": 4}))
+    x = ff.create_tensor((b, s, in_dim), DataType.FLOAT, name="input")
+    t, state = x, None
+    for i in range(rs.randint(1, 4)):
+        t, h, c = ff.lstm(t, hid, initial_state=state,
+                          reverse=bool(rs.randint(2)), name=f"lstm{i}")
+        state = (h, c) if rs.randint(2) else None
+    t = ff.mean(t, axes=[1], name="pool")
+    t = ff.dense(t, classes, name="head")
+    ff.softmax(t, name="softmax")
+    ff.compile(optimizer=AdamOptimizer(lr=1e-3),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY])
+    xs = rs.randn(16, s, in_dim).astype(np.float32)
+    ys = rs.randint(0, classes, 16).astype(np.int32)
+    m = ff.fit(xs, ys, epochs=1, verbose=False)
+    assert np.isfinite(m.sparse_cce_loss)
