@@ -568,27 +568,44 @@ class FFModel:
         if strategy is None and not cfg.only_data_parallel and cfg.search_budget > 0:
             from flexflow_tpu.runtime import distributed as dist
 
-            if cfg.search_budget > 5 and not dist.is_multi_host():
+            if cfg.search_budget > 5:
                 from flexflow_tpu.search.api import graph_optimize
 
-                self.graph, strategy = graph_optimize(
-                    self.graph, self._mesh, cfg,
-                    candidates_out=(search_candidates
-                                    if cfg.validate_top_k > 1 else None),
-                )
+                # multi-host: only process 0 searches; the rewritten PCG +
+                # strategy ship to every host (GraphOptimalViewSerialized,
+                # graph.cc:2162) so all processes lower the identical
+                # program. The timed playoff stays single-host (its step
+                # timings would race the collective schedule).
+                if not dist.is_multi_host():
+                    self.graph, strategy = graph_optimize(
+                        self.graph, self._mesh, cfg,
+                        candidates_out=(search_candidates
+                                        if cfg.validate_top_k > 1 else None),
+                    )
+                else:
+                    if cfg.validate_top_k > 1:
+                        import warnings
+
+                        warnings.warn(
+                            "validate_top_k: the timed playoff is single-"
+                            "host only (its step timings would race the "
+                            "collective schedule); skipped on multi-host"
+                        )
+                    if dist.process_index() == 0:
+                        self.graph, strategy = graph_optimize(
+                            self.graph, self._mesh, cfg
+                        )
+                    self.graph, strategy = dist.broadcast_graph(
+                        self.graph, strategy
+                    )
             else:
-                # multi-host uses the views-only search: the strategy dict
-                # broadcast below covers it, whereas a graph-rewriting
-                # search would need whole-PCG serialization to guarantee
-                # identical graphs on every host (reference ships the full
-                # serialized PCG, graph.cc:2162 — future work here)
                 from flexflow_tpu.search.api import search_strategy
 
                 strategy = search_strategy(self.graph, self._mesh, cfg)
-            # every process must lower the identical strategy: ship
-            # process 0's search result to all
-            if dist.is_multi_host():
-                strategy = dist.broadcast_strategy(strategy, self._mesh)
+                # every process must lower the identical strategy: ship
+                # process 0's search result to all
+                if dist.is_multi_host():
+                    strategy = dist.broadcast_strategy(strategy, self._mesh)
 
         validated_executor = None
         if len(search_candidates) > 1:

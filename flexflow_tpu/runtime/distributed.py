@@ -66,28 +66,11 @@ def is_multi_host() -> bool:
     return process_count() > 1
 
 
-def broadcast_strategy(strategy: Optional[Dict], mesh=None) -> Optional[Dict]:
-    """Make every process use process 0's strategy (the reference ships the
-    optimized PCG to all ranks as GraphOptimalViewSerialized). The strategy
-    dict {node name -> ShardingView} is JSON-serialized, padded, and
-    broadcast device-side; identical on every host afterwards."""
-    import jax
-
-    if not is_multi_host():
-        return strategy
-
+def _broadcast_payload(payload: bytes) -> Optional[bytes]:
+    """Two-phase process-0 broadcast: length, then fixed-size buffer.
+    Length 0 is the None sentinel (process 0 had nothing)."""
     from jax.experimental import multihost_utils
 
-    from flexflow_tpu.parallel.sharding import view_from_json, view_to_json
-
-    if process_index() == 0 and strategy is not None:
-        payload = json.dumps(
-            {k: view_to_json(v) for k, v in sorted(strategy.items())}
-        ).encode()
-    else:
-        payload = b""
-    # two-phase broadcast: length, then fixed-size buffer. Length 0 is the
-    # None sentinel (process 0 had no strategy) — every host returns None.
     n = multihost_utils.broadcast_one_to_all(np.int64(len(payload)))
     if int(n) == 0:
         return None
@@ -95,8 +78,64 @@ def broadcast_strategy(strategy: Optional[Dict], mesh=None) -> Optional[Dict]:
     if process_index() == 0:
         buf[:] = np.frombuffer(payload, np.uint8)
     buf = multihost_utils.broadcast_one_to_all(buf)
-    decoded = json.loads(bytes(bytearray(np.asarray(buf).tolist())).decode())
-    return {k: view_from_json(v) for k, v in decoded.items()}
+    return np.asarray(buf).tobytes()
+
+
+def _strategy_to_jsonable(strategy: Optional[Dict]):
+    from flexflow_tpu.parallel.sharding import view_to_json
+
+    if strategy is None:
+        return None
+    return {k: view_to_json(v) for k, v in sorted(strategy.items())}
+
+
+def _strategy_from_jsonable(d) -> Optional[Dict]:
+    from flexflow_tpu.parallel.sharding import view_from_json
+
+    if d is None:
+        return None
+    return {k: view_from_json(v) for k, v in d.items()}
+
+
+def broadcast_strategy(strategy: Optional[Dict], mesh=None) -> Optional[Dict]:
+    """Make every process use process 0's strategy (the reference ships the
+    optimized PCG to all ranks as GraphOptimalViewSerialized). The strategy
+    dict {node name -> ShardingView} is JSON-serialized, padded, and
+    broadcast device-side; identical on every host afterwards."""
+    if not is_multi_host():
+        return strategy
+
+    payload = b""
+    if process_index() == 0 and strategy is not None:
+        payload = json.dumps(_strategy_to_jsonable(strategy)).encode()
+    got = _broadcast_payload(payload)
+    if got is None:
+        return None
+    return _strategy_from_jsonable(json.loads(got.decode()))
+
+
+def broadcast_graph(graph, strategy: Optional[Dict]):
+    """Ship process 0's (possibly search-REWRITTEN) PCG + strategy to every
+    host — the full GraphOptimalViewSerialized analog (graph.cc:2162):
+    with graph shipping, multi-host can run the substitution search (which
+    changes the graph) instead of being limited to views-only search."""
+    if not is_multi_host():
+        return graph, strategy
+
+    from flexflow_tpu.pcg.serialize import graph_from_dict, graph_to_dict
+
+    payload = b""
+    if process_index() == 0:
+        payload = json.dumps({
+            "graph": graph_to_dict(graph),
+            "strategy": _strategy_to_jsonable(strategy),
+        }).encode()
+    got = _broadcast_payload(payload)
+    # unlike a strategy, a graph always exists on process 0 — an empty
+    # payload would leave hosts with DIVERGENT graphs, so fail loudly
+    assert got is not None, "broadcast_graph: empty payload from process 0"
+    d = json.loads(got.decode())
+    return graph_from_dict(d["graph"]), _strategy_from_jsonable(d["strategy"])
 
 
 def host_local_batch(global_batch_arrays, mesh, shardings):

@@ -55,6 +55,30 @@ def main():
         m = ff.fit(xs, ys, epochs=2, verbose=False)
         assert m.train_all == 64
         print(f"proc {pid}: mlp OK correct={m.train_correct}")
+    elif model == "unity":
+        # graph-REWRITING search multi-host: process 0 searches, the
+        # rewritten PCG + strategy broadcast to every host
+        # (GraphOptimalViewSerialized analog)
+        cfg = FFConfig(batch_size=16, mesh_shape={"data": 4, "model": 2},
+                       search_budget=8, seed=11)
+        ff = FFModel(cfg)
+        x = ff.create_tensor((16, 512), name="x")
+        t = ff.dense(x, 512, use_bias=False, name="d0")
+        t = ff.relu(t, name="r0")
+        t = ff.dense(t, 8, name="d1")
+        ff.softmax(t, name="sm")
+        ff.compile(optimizer=AdamOptimizer(lr=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[MetricsType.ACCURACY])
+        rs = np.random.RandomState(5)
+        xs = rs.randn(64, 512).astype(np.float32)
+        ys = rs.randint(0, 8, 64).astype(np.int32)
+        m = ff.fit(xs, ys, epochs=2, verbose=False)
+        assert m.train_all == 64
+        # graph identity across hosts: same node multiset after the rewrite
+        names = ",".join(sorted(n.name for n in ff.graph.nodes))
+        print(f"proc {pid}: unity OK correct={m.train_correct} "
+              f"graph=[{names}]")
     else:  # llama
         from flexflow_tpu.models.llama import (
             LlamaConfig, build_llama, llama_tp_strategy,

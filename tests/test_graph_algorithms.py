@@ -106,3 +106,36 @@ def test_structure_hash_guid_independent():
     g1, _ = diamond()
     g2, _ = diamond()
     assert g1.structure_hash() == g2.structure_hash()
+
+
+def test_pcg_json_roundtrip():
+    """graph_to_json/graph_from_json reproduce guids, attrs, shardings,
+    edges, and the structure hash (GraphOptimalViewSerialized analog,
+    reference graph.cc:2162)."""
+    from flexflow_tpu import DataType, FFConfig, FFModel
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama, llama_tp_strategy
+    from flexflow_tpu.pcg.serialize import graph_from_json, graph_to_json
+
+    ff = FFModel(FFConfig(batch_size=4))
+    lcfg = LlamaConfig.tiny()
+    build_llama(ff, lcfg, batch_size=4, seq_len=16)
+    ff.graph.infer_shapes()
+    # attach views so sharding round-trips too
+    views = llama_tp_strategy(lcfg)
+    for n in ff.graph.nodes:
+        if n.name in views:
+            n.sharding = views[n.name]
+
+    g2 = graph_from_json(graph_to_json(ff.graph))
+    assert g2.structure_hash() == ff.graph.structure_hash()
+    assert sorted(n.guid for n in g2.nodes) == sorted(
+        n.guid for n in ff.graph.nodes)
+    for n in ff.graph.nodes:
+        m = g2.node(n.guid)
+        assert m.attrs == n.attrs and m.name == n.name
+        assert m.sharding == n.sharding
+        assert [tuple(d.size for d in o.dims) for o in m.outputs] == \
+               [tuple(d.size for d in o.dims) for o in n.outputs]
+    # new nodes mint fresh guids past the watermark
+    fresh = g2.create_node(list(g2.nodes)[0].op_type, None, "fresh")
+    assert fresh.guid > max(n.guid for n in ff.graph.nodes)

@@ -64,3 +64,16 @@ def test_multihost_llama_tiny_two_processes():
     outs = _run_workers("llama")
     for i, out in enumerate(outs):
         assert f"proc {i}: llama OK" in out, out
+
+
+def test_multihost_unity_search_graph_broadcast():
+    """The graph-rewriting Unity search works multi-host: process 0's
+    rewritten PCG ships to every host (GraphOptimalViewSerialized analog)
+    and both processes train the identical graph."""
+    outs = _run_workers("unity")
+    for i, out in enumerate(outs):
+        assert f"proc {i}: unity OK" in out, out
+    g0 = [l for l in outs[0].splitlines() if "graph=[" in l][0]
+    g1 = [l for l in outs[1].splitlines() if "graph=[" in l][0]
+    assert g0.split("graph=")[1] == g1.split("graph=")[1]
+    assert g0.split("correct=")[1] == g1.split("correct=")[1]
