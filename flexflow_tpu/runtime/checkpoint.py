@@ -95,11 +95,42 @@ def save_checkpoint(path: str, ffmodel, extra: Optional[Dict] = None,
         np.savez(os.path.join(path, "arrays.npz"), **arrays)
     if not primary:
         return
+    import dataclasses as _dc
+
+    opt = ffmodel._optimizer
+    opt_spec = None
+    if opt is not None:
+        if _dc.is_dataclass(opt):
+            opt_spec = {"cls": type(opt).__name__, "fields": _dc.asdict(opt)}
+        else:
+            import warnings
+
+            warnings.warn(
+                f"optimizer {type(opt).__name__} is not a dataclass and "
+                "cannot be serialized; restore_model will require an "
+                "explicit optimizer= argument"
+            )
+    cfg = ffmodel.config
     meta = {
         "step_count": ffmodel._step_count,
-        "seed": ffmodel.config.seed,
+        "seed": cfg.seed,
         "backend": backend,
         "extra": extra or {},
+        # compile spec: everything restore_model needs to rebuild this
+        # model WITHOUT the original builder code (the PCG itself is in
+        # pcg.json) — a search-REWRITTEN graph resumes exactly, no re-search
+        "config": {
+            "batch_size": cfg.batch_size,
+            "mesh_shape": dict(cfg.mesh_shape or {}),
+            "seed": cfg.seed,
+            "seq_length": cfg.seq_length,
+            "remat": cfg.remat,
+            "param_sync": cfg.param_sync.name,
+            "donate_buffers": cfg.donate_buffers,
+        },
+        "loss_type": ffmodel._loss_type.name,
+        "metrics": [m.name for m in ffmodel._metrics],
+        "optimizer": opt_spec,
     }
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(meta, f)
@@ -114,6 +145,12 @@ def save_checkpoint(path: str, ffmodel, extra: Optional[Dict] = None,
     }
     with open(os.path.join(path, "strategy.json"), "w") as f:
         json.dump(strat, f, indent=1)
+    # the full PCG (GraphOptimalViewSerialized analog): restore_model
+    # rebuilds the graph — including any search rewrites — from this alone
+    from flexflow_tpu.pcg.serialize import graph_to_json
+
+    with open(os.path.join(path, "pcg.json"), "w") as f:
+        f.write(graph_to_json(ffmodel.graph))
 
 
 def restore_checkpoint(path: str, ffmodel) -> Dict:
@@ -220,3 +257,74 @@ def restore_checkpoint_orbax(path: str, ffmodel):
     state = ckptr.restore(os.path.join(os.path.abspath(path), "state"), target)
     ffmodel._params = (state["trainable"], state["nontrainable"])
     ffmodel._opt_state = state["opt_state"]
+
+
+def restore_model(path: str, config=None, optimizer=None):
+    """Rebuild a ready-to-train FFModel from a checkpoint ALONE — no builder
+    code needed. The PCG snapshot (pcg.json) carries the graph exactly as
+    compiled, INCLUDING search rewrites, so a model whose graph the Unity
+    search transformed resumes identically without re-running the search
+    (the reference reloads via its serialized PCG the same way,
+    graph.cc:2162).
+
+    `config` overrides the saved FFConfig — it must keep the mesh axes the
+    snapshot's ShardingViews reference (growing/shrinking an EXISTING axis
+    reshards arrays on restore; removing an axis a strategy uses cannot
+    work without a re-search from the un-rewritten graph). `optimizer`
+    overrides the saved optimizer (required when the original was not a
+    serializable dataclass). Saved metadata lands on the returned model as
+    `ff.restored_meta`."""
+    from flexflow_tpu import ffconst
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.pcg.serialize import graph_from_json
+    from flexflow_tpu.runtime import optimizer as opt_mod
+    from flexflow_tpu.runtime.optimizer import Optimizer
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    with open(os.path.join(path, "pcg.json")) as f:
+        graph = graph_from_json(f.read())
+
+    saved_cfg = meta["config"]
+    cfg = config or FFConfig(
+        batch_size=saved_cfg["batch_size"],
+        mesh_shape=saved_cfg["mesh_shape"] or None,
+        seed=saved_cfg["seed"],
+        seq_length=saved_cfg["seq_length"],
+        remat=saved_cfg["remat"],
+        param_sync=ffconst.ParamSyncType[saved_cfg["param_sync"]],
+        donate_buffers=saved_cfg["donate_buffers"],
+    )
+    opt = optimizer
+    if opt is None:
+        if not meta.get("optimizer"):
+            raise ValueError(
+                "checkpoint has no serialized optimizer (the original was "
+                "not a dataclass); pass optimizer= explicitly"
+            )
+        opt_cls = getattr(opt_mod, meta["optimizer"]["cls"], None)
+        if not (isinstance(opt_cls, type) and issubclass(opt_cls, Optimizer)):
+            raise ValueError(
+                f"unknown optimizer class {meta['optimizer']['cls']!r} in "
+                "checkpoint; pass optimizer= explicitly"
+            )
+        opt = opt_cls(**meta["optimizer"]["fields"])
+
+    ff = FFModel(cfg)
+    ff.graph = graph
+    ff._used_names = {n.name for n in graph.nodes}
+    # the graph nodes already carry their shardings; passing them as the
+    # explicit strategy keeps compile() out of its search branch even if a
+    # config override sets search_budget > 0 (re-searching would break the
+    # exact-resume contract)
+    strategy = {n.name: n.sharding for n in graph.nodes
+                if n.sharding is not None}
+    ff.compile(
+        optimizer=opt,
+        loss_type=ffconst.LossType[meta["loss_type"]],
+        metrics=[ffconst.MetricsType[m] for m in meta["metrics"]],
+        strategy=strategy or None,
+    )
+    ff.restored_meta = restore_checkpoint(path, ff)
+    return ff

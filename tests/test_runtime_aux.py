@@ -519,3 +519,35 @@ def test_elastic_resume_across_mesh_sizes(tmp_path):
     np.testing.assert_allclose(np.asarray(ff4.predict(x)), np.asarray(ref),
                                rtol=2e-3, atol=2e-5)
     ff4.fit(x, y, epochs=1, verbose=False)  # keeps training on the new mesh
+
+
+def test_restore_model_from_checkpoint_alone(tmp_path):
+    """restore_model rebuilds a READY model from the checkpoint's PCG
+    snapshot — no builder code — including a search-REWRITTEN graph
+    (fusion changed the node set), with bit-identical predictions and
+    matched continued training."""
+    from flexflow_tpu.runtime.checkpoint import restore_model
+
+    x, y = data()
+    ff1 = FFModel(FFConfig(batch_size=16, search_budget=8,
+                           mesh_shape={"data": 2, "model": 4}))
+    xt = ff1.create_tensor((16, 10), DataType.FLOAT, name="input")
+    t = ff1.dense(xt, 64, name="d0")
+    t = ff1.relu(t, name="r0")  # fusable: the search may fold it into d0
+    t = ff1.dense(t, 4, name="d1")
+    ff1.softmax(t, name="softmax")
+    ff1.compile(optimizer=AdamOptimizer(lr=0.01),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics=[MetricsType.ACCURACY])
+    ff1.fit(x, y, epochs=2, verbose=False)
+    save_checkpoint(str(tmp_path / "ck"), ff1)
+
+    ff2 = restore_model(str(tmp_path / "ck"))
+    # identical graphs (incl. any rewrite) and predictions
+    assert ff2.graph.structure_hash() == ff1.graph.structure_hash()
+    np.testing.assert_allclose(ff1.predict(x), ff2.predict(x), rtol=1e-6)
+    # training continues step-for-step
+    ff1.fit(x, y, epochs=1, verbose=False)
+    ff2.fit(x, y, epochs=1, verbose=False)
+    np.testing.assert_allclose(ff1.predict(x), ff2.predict(x),
+                               rtol=1e-4, atol=1e-6)
