@@ -248,6 +248,16 @@ def restore_latest(ckpt_dir: str, ffmodel) -> Dict:
     return restore_checkpoint(os.path.join(ckpt_dir, latest["name"]), ffmodel)
 
 
+def restore_latest_model(ckpt_dir: str, config=None, optimizer=None):
+    """Builder-free resume from the newest periodic checkpoint: the
+    restore_model counterpart of restore_latest (crash recovery without
+    the original model-construction code)."""
+    with open(os.path.join(ckpt_dir, "latest.json")) as f:
+        latest = json.load(f)
+    return restore_model(os.path.join(ckpt_dir, latest["name"]),
+                         config=config, optimizer=optimizer)
+
+
 def restore_checkpoint_orbax(path: str, ffmodel):
     import orbax.checkpoint as ocp
 

@@ -361,6 +361,14 @@ def test_periodic_checkpoint_and_restore_latest(tmp_path):
     np.testing.assert_allclose(ff1.predict(x), ff2.predict(x), rtol=1e-5,
                                atol=1e-6)
 
+    # builder-free crash recovery: same checkpoint, no model code
+    from flexflow_tpu.runtime.checkpoint import restore_latest_model
+
+    ff3 = restore_latest_model(str(tmp_path))
+    assert ff3._step_count == 8
+    np.testing.assert_allclose(ff1.predict(x), ff3.predict(x), rtol=1e-5,
+                               atol=1e-6)
+
 
 def test_orbax_checkpoint_sharded_roundtrip(tmp_path):
     """Orbax backend against SHARDED train state: save under a TP strategy
