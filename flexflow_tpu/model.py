@@ -568,29 +568,30 @@ class FFModel:
         if strategy is None and not cfg.only_data_parallel and cfg.search_budget > 0:
             from flexflow_tpu.runtime import distributed as dist
 
+            # the timed playoff is single-host only: its step timings would
+            # race the collective schedule across hosts
+            collect = (search_candidates
+                       if cfg.validate_top_k > 1 and not dist.is_multi_host()
+                       else None)
+            if cfg.validate_top_k > 1 and dist.is_multi_host():
+                import warnings
+
+                warnings.warn(
+                    "validate_top_k: the timed playoff is single-host only; "
+                    "skipped on multi-host"
+                )
             if cfg.search_budget > 5:
                 from flexflow_tpu.search.api import graph_optimize
 
                 # multi-host: only process 0 searches; the rewritten PCG +
                 # strategy ship to every host (GraphOptimalViewSerialized,
                 # graph.cc:2162) so all processes lower the identical
-                # program. The timed playoff stays single-host (its step
-                # timings would race the collective schedule).
+                # program
                 if not dist.is_multi_host():
                     self.graph, strategy = graph_optimize(
-                        self.graph, self._mesh, cfg,
-                        candidates_out=(search_candidates
-                                        if cfg.validate_top_k > 1 else None),
+                        self.graph, self._mesh, cfg, candidates_out=collect,
                     )
                 else:
-                    if cfg.validate_top_k > 1:
-                        import warnings
-
-                        warnings.warn(
-                            "validate_top_k: the timed playoff is single-"
-                            "host only (its step timings would race the "
-                            "collective schedule); skipped on multi-host"
-                        )
                     if dist.process_index() == 0:
                         self.graph, strategy = graph_optimize(
                             self.graph, self._mesh, cfg
@@ -601,7 +602,9 @@ class FFModel:
             else:
                 from flexflow_tpu.search.api import search_strategy
 
-                strategy = search_strategy(self.graph, self._mesh, cfg)
+                strategy = search_strategy(
+                    self.graph, self._mesh, cfg, candidates_out=collect,
+                )
                 # every process must lower the identical strategy: ship
                 # process 0's search result to all
                 if dist.is_multi_host():

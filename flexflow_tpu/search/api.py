@@ -76,13 +76,51 @@ def _maybe_measure(cost, graph, config) -> None:
                   f"mxu_eff={cost.machine.mxu_efficiency:.3f}")
 
 
-def search_strategy(graph, mesh, config) -> Dict[str, ShardingView]:
-    """Views-only search on a fixed graph (MCMC)."""
+def space_dp_strategy(graph, axis_sizes):
+    from flexflow_tpu.search.space import default_dp_strategy
+
+    return default_dp_strategy(graph, axis_sizes)
+
+
+def _collect_playoff_pair(candidates_out, cost, ref_graph, *, winner,
+                          baseline, winner_graph, baseline_graph) -> None:
+    """Shared winner-vs-baseline pool for the validate_top_k playoff:
+    modeled-cost both, drop the baseline when identical to the winner,
+    keep the pool sorted best-modeled first."""
+    from flexflow_tpu.search.cost_model import graph_cost
+
+    pool = [(graph_cost(winner_graph, winner, cost).time,
+             winner_graph, winner)]
+    if (winner_graph.structure_hash() != baseline_graph.structure_hash()
+            or winner != baseline):
+        pool.append((graph_cost(baseline_graph, baseline, cost).time,
+                     baseline_graph, baseline))
+    candidates_out.extend(sorted(pool, key=lambda t: t[0]))
+
+
+def search_strategy(graph, mesh, config,
+                    candidates_out=None) -> Dict[str, ShardingView]:
+    """Views-only search on a fixed graph (MCMC). `candidates_out`: when a
+    list is passed, receives the (modeled_cost, graph, strategy) pair of
+    the MCMC winner and the plain-DP baseline for the validate_top_k timed
+    playoff — same contract as graph_optimize."""
     from flexflow_tpu.search.mcmc import mcmc_search
 
     cost = _cost_model(mesh, config)
     _maybe_measure(cost, graph, config)
-    return mcmc_search(graph, mesh, config, cost=cost)
+    strategy = mcmc_search(graph, mesh, config, cost=cost)
+    # no playoff pool under memory_search: the DP baseline (full weight
+    # replication) may exceed the memory limit the search honored, and the
+    # playoff would compile and run the over-limit layout (the memory-λ
+    # graph_optimize path skips collection for the same reason)
+    if candidates_out is not None and not config.memory_search:
+        base = space_dp_strategy(graph, cost.axis_sizes)
+        _collect_playoff_pair(
+            candidates_out, cost, graph,
+            winner=strategy, baseline=base,
+            winner_graph=graph, baseline_graph=graph,
+        )
+    return strategy
 
 
 def graph_optimize(graph: Graph, mesh, config,
@@ -142,16 +180,13 @@ def graph_optimize(graph: Graph, mesh, config,
         # stitched winner vs the UNREWRITTEN graph at its own optimal
         # views (catches a search result that models faster but compiles
         # slower than the plain graph)
-        from flexflow_tpu.search.cost_model import graph_cost
         from flexflow_tpu.search.dp import ViewDP
 
-        base_strategy = ViewDP(cost).optimize(graph)
-        base_time = graph_cost(graph, base_strategy, cost).time
-        pool = [(best_time, best_graph, strategy)]
-        if (best_graph.structure_hash() != graph.structure_hash()
-                or strategy != base_strategy):
-            pool.append((base_time, graph, base_strategy))
-        candidates_out.extend(sorted(pool, key=lambda t: t[0]))
+        _collect_playoff_pair(
+            candidates_out, cost, graph,
+            winner=strategy, baseline=ViewDP(cost).optimize(graph),
+            winner_graph=best_graph, baseline_graph=graph,
+        )
     if config.profiling:
         print(f"[search] best estimated step time {best_time * 1e3:.3f} ms")
     return best_graph, strategy

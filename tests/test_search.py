@@ -446,3 +446,17 @@ def test_validate_top_k_deep_graph_baseline_playoff():
                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
     v = ff.strategy_validation
     assert v is not None and len(v["timed_ms"]) >= 1
+
+
+def test_validate_top_k_mcmc_path_playoff():
+    """The views-only MCMC path (budget <= 5) also feeds the timed playoff:
+    MCMC winner vs plain DP."""
+    ff = FFModel(FFConfig(batch_size=8, search_budget=3, validate_top_k=2,
+                          mesh_shape={"data": 2, "model": 4}))
+    x = ff.create_tensor((8, 1024), DataType.FLOAT, name="input")
+    t = ff.dense(x, 1024, name="d0")
+    ff.softmax(t, name="softmax")
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    v = ff.strategy_validation
+    assert v is not None and len(v["timed_ms"]) >= 1
