@@ -1,0 +1,53 @@
+"""Transformer encoder training — the reference examples/cpp/Transformer
+analog (attention encoder stack + regression head, MSE on synthetic
+random data, transformer.cc:138-188). --enc-dec switches to the
+encoder-decoder variant with cross-attention.
+
+Run:  python examples/python/transformer.py -b 8 -e 2 [--enc-dec]
+"""
+
+import numpy as np
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType, MetricsType
+from flexflow_tpu.models.transformer import (
+    TransformerConfig,
+    build_transformer_encoder,
+    build_transformer_encoder_decoder,
+)
+
+SEQ = 32
+
+
+def main(argv=None):
+    import sys
+
+    args = list(argv if argv is not None else sys.argv[1:])
+    enc_dec = "--enc-dec" in args
+    if enc_dec:
+        args.remove("--enc-dec")
+    ffcfg = FFConfig.from_args(args)
+    cfg = TransformerConfig(dim=64, heads=8, hidden=256, layers=4)
+    ff = FFModel(ffcfg)
+    if enc_dec:
+        build_transformer_encoder_decoder(ff, cfg, src_len=SEQ,
+                                          tgt_len=SEQ // 2)
+    else:
+        build_transformer_encoder(ff, cfg, seq_len=SEQ)
+    ff.compile(optimizer=AdamOptimizer(lr=1e-3),
+               loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               metrics=[MetricsType.MEAN_SQUARED_ERROR])
+    rs = np.random.RandomState(0)
+    n = 512
+    if enc_dec:
+        src = rs.randn(n, SEQ, cfg.dim).astype(np.float32)
+        tgt = rs.randn(n, SEQ // 2, cfg.dim).astype(np.float32)
+        y = tgt.mean(-1, keepdims=True).astype(np.float32)
+        ff.fit([src, tgt], y, epochs=ffcfg.epochs)
+    else:
+        x = rs.randn(n, SEQ, cfg.dim).astype(np.float32)
+        y = x.mean(-1, keepdims=True).astype(np.float32)
+        ff.fit(x, y, epochs=ffcfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,11 @@ from flexflow_tpu.models.inception import build_inception_v3
 from flexflow_tpu.models.resnext import build_resnext50
 from flexflow_tpu.models.candle_uno import build_candle_uno
 from flexflow_tpu.models.nmt import NMTConfig, build_nmt, nmt_dp_strategy
+from flexflow_tpu.models.transformer import (
+    TransformerConfig,
+    build_transformer_encoder,
+    build_transformer_encoder_decoder,
+)
 from flexflow_tpu.models.xdl import build_xdl
 
 __all__ = [
@@ -39,4 +44,7 @@ __all__ = [
     "build_nmt",
     "nmt_dp_strategy",
     "build_xdl",
+    "TransformerConfig",
+    "build_transformer_encoder",
+    "build_transformer_encoder_decoder",
 ]

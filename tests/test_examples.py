@@ -37,6 +37,8 @@ def _run(script, *flags, timeout=420):
     ("candle_uno.py", ("-b", "16",)),
     ("dlrm_train.py", ("-b", "32",)),
     ("nmt_seq2seq.py", ("-b", "32", "--mesh", "data=2,model=4")),
+    ("transformer.py", ("-b", "8",)),
+    ("transformer.py", ("-b", "8", "--enc-dec")),
 ])
 def test_example_runs(script, flags):
     out = _run(script, *flags)
