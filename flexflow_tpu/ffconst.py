@@ -36,7 +36,22 @@ class DataType(enum.Enum):
         return cls(jnp.dtype(dtype).name)
 
 
-class ActiMode(enum.Enum):
+class _Coercible:
+    """Mixin for enums the layer builders accept as enum | str | None.
+    Coercion happens at the builder boundary so attrs always carry the
+    enum (lowerings and search predicates compare against enum members —
+    a stored str would silently fail those comparisons)."""
+
+    @classmethod
+    def coerce(cls, value):
+        if value is None and hasattr(cls, "NONE"):
+            return cls.NONE
+        if isinstance(value, cls):
+            return value
+        return cls(str(value).lower())
+
+
+class ActiMode(_Coercible, enum.Enum):
     NONE = "none"
     RELU = "relu"
     SIGMOID = "sigmoid"
@@ -45,7 +60,7 @@ class ActiMode(enum.Enum):
     SILU = "silu"
 
 
-class AggrMode(enum.Enum):
+class AggrMode(_Coercible, enum.Enum):
     """Embedding aggregation (reference: AGGR_MODE_{NONE,SUM,AVG})."""
 
     NONE = "none"
@@ -53,7 +68,7 @@ class AggrMode(enum.Enum):
     AVG = "avg"
 
 
-class PoolType(enum.Enum):
+class PoolType(_Coercible, enum.Enum):
     MAX = "max"
     AVG = "avg"
 

@@ -137,7 +137,7 @@ class FFModel:
               name: Optional[str] = None) -> Tensor:
         node = self._add(
             OpType.LINEAR,
-            A.LinearAttrs(out_dim, use_bias, activation),
+            A.LinearAttrs(out_dim, use_bias, ActiMode.coerce(activation)),
             [input],
             name or "dense",
         )
@@ -153,7 +153,8 @@ class FFModel:
             OpType.CONV2D,
             A.Conv2DAttrs(
                 out_channels, (kernel_h, kernel_w), (stride_h, stride_w),
-                (padding_h, padding_w), groups, use_bias, activation,
+                (padding_h, padding_w), groups, use_bias,
+                ActiMode.coerce(activation),
             ),
             [input],
             name or "conv2d",
@@ -169,7 +170,8 @@ class FFModel:
         return self._one(
             OpType.POOL2D,
             A.Pool2DAttrs((kernel_h, kernel_w), (stride_h, stride_w),
-                          (padding_h, padding_w), pool_type, activation),
+                          (padding_h, padding_w), PoolType.coerce(pool_type),
+                          ActiMode.coerce(activation)),
             [input], name or "pool2d",
         )
 
@@ -178,7 +180,7 @@ class FFModel:
                   kernel_initializer=None, name: Optional[str] = None) -> Tensor:
         node = self._add(
             OpType.EMBEDDING,
-            A.EmbeddingAttrs(num_entries, out_dim, aggr, dtype),
+            A.EmbeddingAttrs(num_entries, out_dim, AggrMode.coerce(aggr), dtype),
             [input], name or "embedding",
         )
         self._record_init(node, kernel=kernel_initializer)
@@ -438,8 +440,8 @@ class FFModel:
                 name=None) -> Tensor:
         return self._one(
             OpType.EXPERTS,
-            A.ExpertsAttrs(n_experts, k, hidden_dim, out_dim, alpha, activation,
-                           lambda_bal),
+            A.ExpertsAttrs(n_experts, k, hidden_dim, out_dim, alpha,
+                           ActiMode.coerce(activation), lambda_bal),
             [input, gate], name or "experts",
         )
 
@@ -694,6 +696,11 @@ class FFModel:
         results = []  # (timed, modeled_rank, graph, strategy, executor)
         for rank, (modeled, graph, strategy) in enumerate(candidates):
             try:
+                # candidates may alias the same Graph object (winner-vs-
+                # baseline pairs pass one graph twice); a private copy keeps
+                # each candidate's node shardings from leaking into the
+                # executors built for the others
+                graph = graph.copy()
                 self._apply_strategy(graph, strategy)
                 ex = self._build_executor(graph)
                 rng = jax.random.key(self.config.seed)

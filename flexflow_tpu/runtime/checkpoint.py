@@ -327,14 +327,16 @@ def restore_model(path: str, config=None, optimizer=None):
     # the graph nodes already carry their shardings; passing them as the
     # explicit strategy keeps compile() out of its search branch even if a
     # config override sets search_budget > 0 (re-searching would break the
-    # exact-resume contract)
+    # exact-resume contract). Passed even when EMPTY (single-device
+    # checkpoints carry no shardings): strategy={} still means "decided",
+    # None would re-enter the search.
     strategy = {n.name: n.sharding for n in graph.nodes
                 if n.sharding is not None}
     ff.compile(
         optimizer=opt,
         loss_type=ffconst.LossType[meta["loss_type"]],
         metrics=[ffconst.MetricsType[m] for m in meta["metrics"]],
-        strategy=strategy or None,
+        strategy=strategy,
     )
     ff.restored_meta = restore_checkpoint(path, ff)
     return ff

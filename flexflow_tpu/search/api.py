@@ -82,7 +82,7 @@ def space_dp_strategy(graph, axis_sizes):
     return default_dp_strategy(graph, axis_sizes)
 
 
-def _collect_playoff_pair(candidates_out, cost, ref_graph, *, winner,
+def _collect_playoff_pair(candidates_out, cost, *, winner,
                           baseline, winner_graph, baseline_graph) -> None:
     """Shared winner-vs-baseline pool for the validate_top_k playoff:
     modeled-cost both, drop the baseline when identical to the winner,
@@ -116,7 +116,7 @@ def search_strategy(graph, mesh, config,
     if candidates_out is not None and not config.memory_search:
         base = space_dp_strategy(graph, cost.axis_sizes)
         _collect_playoff_pair(
-            candidates_out, cost, graph,
+            candidates_out, cost,
             winner=strategy, baseline=base,
             winner_graph=graph, baseline_graph=graph,
         )
@@ -183,7 +183,7 @@ def graph_optimize(graph: Graph, mesh, config,
         from flexflow_tpu.search.dp import ViewDP
 
         _collect_playoff_pair(
-            candidates_out, cost, graph,
+            candidates_out, cost,
             winner=strategy, baseline=ViewDP(cost).optimize(graph),
             winner_graph=best_graph, baseline_graph=graph,
         )

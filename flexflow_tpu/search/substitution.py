@@ -112,7 +112,12 @@ def _replace_node(graph: Graph, old: Node, make_nodes) -> Graph:
         # seed shapes from the replaced node: in a module SUBGRAPH (sequence
         # decomposition) the producers may live outside this graph, so
         # infer_shapes cannot resolve the entry node's inputs — it keeps
-        # these cached shapes instead (graph.py infer_shapes guard)
+        # these cached shapes instead (graph.py infer_shapes guard).
+        # INVARIANT: this seed is only valid while every rewrite consumes
+        # the same inputs with the same meaning as the node it replaces; a
+        # rewrite that reinterprets its inputs (e.g. collapsing a cast, so
+        # the true producer dtype differs from old's recorded input dtype)
+        # must recompute in_shapes from its bound external inputs instead.
         n.in_shapes = old.in_shapes
         if old.in_shapes:
             n.outputs = tuple(attrs.infer(*old.in_shapes))
