@@ -91,7 +91,10 @@ class FFConfig:
     transfer_guard: Optional[str] = None
     # rematerialization: "attention" wraps attention ops in jax.checkpoint so
     # S×S probs are recomputed in backward instead of saved (HBM for FLOPs —
-    # net-new vs the reference, which has no remat); "none" disables
+    # net-new vs the reference, which has no remat); "hidden" instead
+    # recomputes MLP hidden activations (SwiGLU gate/up/silu/mul, expanding
+    # Linear+activation chains) — the dominant saved-activation HBM at LLM
+    # shapes for ~2% extra FLOPs; "none" disables
     remat: str = "attention"
     # op fusion: on TPU XLA fuses inside one jitted program for free; this
     # flag only controls whether the PCG keeps explicit FusedOp groups for

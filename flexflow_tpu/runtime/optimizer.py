@@ -80,9 +80,17 @@ class AdamOptimizer(Optimizer):
     epsilon: float = 1e-8
     weight_decay: float = 0.0
     adamw: bool = True
+    # moment storage dtype: "float32" (exact) or "bfloat16" (halves the
+    # optimizer-state HBM and its per-step read/write traffic — at ~1B
+    # params on one 16 GB chip this is the difference between the Adam
+    # state crowding activations into XLA auto-remat and not). Update math
+    # always runs in fp32; only storage rounds. Net-new vs the reference
+    # (optimizer_kernel.cu is fp32-only).
+    state_dtype: str = "float32"
 
     def init_state(self, params):
-        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        dt = jnp.dtype(self.state_dtype)
+        zeros = lambda p: jnp.zeros_like(p, dtype=dt)
         return {
             "step": jnp.zeros((), jnp.int32),
             "m": jax.tree.map(zeros, params),
@@ -93,10 +101,13 @@ class AdamOptimizer(Optimizer):
         step = state["step"] + 1
         bc1 = 1.0 - self.beta1 ** step.astype(jnp.float32)
         bc2 = 1.0 - self.beta2 ** step.astype(jnp.float32)
+        dt = jnp.dtype(self.state_dtype)
 
         def upd(g, p, m, v):
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
+            m = m.astype(jnp.float32)
+            v = v.astype(jnp.float32)
             if not self.adamw:
                 g = g + self.weight_decay * p32
             m = self.beta1 * m + (1 - self.beta1) * g
@@ -106,7 +117,7 @@ class AdamOptimizer(Optimizer):
             new_p = p32 - self.lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
             if self.adamw and self.weight_decay:
                 new_p = new_p - self.lr * self.weight_decay * p32
-            return new_p.astype(p.dtype), m, v
+            return new_p.astype(p.dtype), m.astype(dt), v.astype(dt)
 
         triples = jax.tree.map(upd, grads, params, state["m"], state["v"])
         is_triple = lambda t: isinstance(t, tuple)
