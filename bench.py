@@ -23,12 +23,26 @@ def _log(msg: str) -> None:
     print(f"[bench +{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
+def _bench_profile() -> str:
+    """"smoke" | "200m" | "1b" — the SINGLE source of truth for which bench
+    configuration this process runs. Every fairness knob (remat policy,
+    optimizer state dtype, metric name) keys off this one function so the
+    two sides can never drift apart."""
+    if os.environ.get("FLEXFLOW_BENCH_SMOKE"):
+        return "smoke"
+    cfg = os.environ.get("FLEXFLOW_BENCH_CONFIG", "1b")
+    if cfg not in ("1b", "200m"):
+        sys.exit(f"unknown FLEXFLOW_BENCH_CONFIG={cfg!r} (want 1b|200m)")
+    return cfg
+
+
 def _llama_cfg():
     from flexflow_tpu.models.llama import LlamaConfig
 
-    if os.environ.get("FLEXFLOW_BENCH_SMOKE"):
+    prof = _bench_profile()
+    if prof == "smoke":
         return LlamaConfig.tiny()
-    if os.environ.get("FLEXFLOW_BENCH_CONFIG", "1b") == "200m":
+    if prof == "200m":
         # ~200M params (rounds 1-2 continuity config)
         return LlamaConfig(vocab_size=32000, dim=1024, layers=12, heads=16,
                            kv_heads=8, hidden=2816)
@@ -113,9 +127,7 @@ def bench_framework(x, y) -> float:
     import jax
 
     _log("framework: building model")
-    is_1b = (os.environ.get("FLEXFLOW_BENCH_CONFIG", "1b") == "1b"
-             and not os.environ.get("FLEXFLOW_BENCH_SMOKE"))
-    if is_1b:
+    if _bench_profile() == "1b":
         # ~0.9B params: fp32 masters + Adam state alone are ~7 GB, so the
         # framework uses its selective MLP-hidden remat (~2% extra FLOPs)
         # and bf16 moment STORAGE (update math stays fp32; the naive
@@ -229,9 +241,7 @@ def bench_naive(x, y) -> float:
     # baseline handicap.
     naive_remat = os.environ.get("FLEXFLOW_BENCH_NAIVE_REMAT")
     if naive_remat is None:
-        naive_remat = ("dots" if os.environ.get(
-            "FLEXFLOW_BENCH_CONFIG", "1b") == "200m"
-            or os.environ.get("FLEXFLOW_BENCH_SMOKE") else "full")
+        naive_remat = "full" if _bench_profile() == "1b" else "dots"
     if naive_remat == "dots":
         layer_ckpt = jax.checkpoint(
             layer,
@@ -256,9 +266,7 @@ def bench_naive(x, y) -> float:
     b1, b2, eps, lr = 0.9, 0.999, 1e-8, 1e-4
     # at the 1B config BOTH sides store Adam moments in bf16 (update math
     # fp32) — identical optimizer numerics to the framework side
-    state_dt = (jnp.bfloat16 if os.environ.get(
-        "FLEXFLOW_BENCH_CONFIG", "1b") == "1b"
-        and not os.environ.get("FLEXFLOW_BENCH_SMOKE") else jnp.float32)
+    state_dt = jnp.bfloat16 if _bench_profile() == "1b" else jnp.float32
 
     # donate p/m/v so the update aliases the old buffers in place — without
     # this, old+new fp32 state coexists (~21 GB at the 0.9B config) and no
@@ -353,9 +361,7 @@ def main():
                      "[--config 1b|200m]")
         os.environ["FLEXFLOW_BENCH_CONFIG"] = sys.argv[i + 1]
         del sys.argv[i:i + 2]
-    if os.environ.get("FLEXFLOW_BENCH_CONFIG", "1b") not in ("1b", "200m"):
-        sys.exit(f"unknown FLEXFLOW_BENCH_CONFIG="
-                 f"{os.environ['FLEXFLOW_BENCH_CONFIG']!r} (want 1b|200m)")
+    _bench_profile()  # validate FLEXFLOW_BENCH_CONFIG before spawning sides
     if os.environ.get("FLEXFLOW_BENCH_SMOKE"):
         BATCH, SEQ, WARMUP, ITERS = 2, 128, 1, 2
     if len(sys.argv) > 2 and sys.argv[1] == "--side":
@@ -372,12 +378,7 @@ def main():
     fw = _spawn_side("framework")
     nv = _spawn_side("naive")
     mfu = fw * _flops_per_token(_llama_cfg(), SEQ) / _peak_flops()
-    if os.environ.get("FLEXFLOW_BENCH_SMOKE"):
-        name = "llama_smoke_train_tokens_per_sec"
-    elif os.environ.get("FLEXFLOW_BENCH_CONFIG", "1b") == "200m":
-        name = "llama_200m_train_tokens_per_sec"
-    else:
-        name = "llama_1b_train_tokens_per_sec"
+    name = f"llama_{_bench_profile()}_train_tokens_per_sec"
     print(json.dumps({
         "metric": name,
         "value": round(fw, 1),

@@ -437,11 +437,12 @@ class FFModel:
     def experts(self, input: Tensor, gate: Tensor, n_experts: int, k: int,
                 hidden_dim: int, out_dim: int, alpha: float = 1.0,
                 activation: ActiMode = ActiMode.GELU, lambda_bal: float = 1e-2,
-                name=None) -> Tensor:
+                dispatch: str = "sort", name=None) -> Tensor:
         return self._one(
             OpType.EXPERTS,
             A.ExpertsAttrs(n_experts, k, hidden_dim, out_dim, alpha,
-                           ActiMode.coerce(activation), lambda_bal),
+                           ActiMode.coerce(activation), lambda_bal,
+                           dispatch=dispatch),
             [input, gate], name or "experts",
         )
 

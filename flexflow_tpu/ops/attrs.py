@@ -686,6 +686,12 @@ class ExpertsAttrs(OpAttrs):
     # matches the composite group_by/aggregate path, which combines with
     # raw softmax probs (reference aggregate.cc)
     normalize: bool = True
+    # dispatch implementation: "sort" = token-sort + row scatter/gather
+    # into a static (n*cap, d) buffer — O(tokens*dim) like the reference's
+    # group_by.cu/aggregate.cu scatter kernels, the only design that
+    # reaches Mixtral-scale shapes; "dense" = one-hot dispatch matmuls
+    # (O(tokens*k*n*cap) fp32 mask) — kept as the numerics oracle
+    dispatch: str = "sort"
 
     def capacity(self, batch: int) -> int:
         return max(1, int(math.ceil(self.k * batch * self.alpha / self.n_experts)))

@@ -643,12 +643,52 @@ def _aggregate_spec(attrs, inputs, params, ctx):
     return [per_slot.reshape(b * k, -1)]
 
 
+def _sorted_dispatch(topi, t: int, n_experts: int, cap: int):
+    """Token-sort dispatch plan. `topi` (t, k) int expert ids.
+
+    Slots are prioritized in the same k-major arrival order as
+    _dispatch_mask's cumsum (slot f = k_idx * t + token), so the two
+    implementations drop exactly the same tokens at capacity. Returns
+      slot_of_flat: (t*k,) buffer row per flat slot (n*cap = dropped)
+      kept_per_expert: (n,) tokens kept per expert after capacity
+    All O(t*k log(t*k)) sort work — no (t, n, cap) materialization.
+    Reference analog: the sequential expert-queue scan in group_by.cu,
+    re-expressed as sort + rank for a data-parallel machine."""
+    k = topi.shape[1]
+    flat_e = topi.astype(jnp.int32).transpose(1, 0).reshape(-1)  # k-major
+    order = jnp.argsort(flat_e, stable=True)  # arrival order within expert
+    sorted_e = flat_e[order]
+    # rank within its expert = global sorted position - expert start
+    start_of_own = jnp.searchsorted(sorted_e, sorted_e, side="left")
+    pos_in_e = jnp.arange(t * k, dtype=jnp.int32) - start_of_own.astype(jnp.int32)
+    valid = pos_in_e < cap
+    buf_slot = jnp.where(valid, sorted_e * cap + pos_in_e, n_experts * cap)
+    # invert the sort: flat slot f -> its buffer row
+    slot_of_flat = jnp.zeros((t * k,), jnp.int32).at[order].set(buf_slot)
+    counts = jnp.searchsorted(
+        sorted_e, jnp.arange(n_experts, dtype=jnp.int32), side="right"
+    ) - jnp.searchsorted(
+        sorted_e, jnp.arange(n_experts, dtype=jnp.int32), side="left"
+    )
+    kept = jnp.minimum(counts, cap)
+    return slot_of_flat, kept
+
+
 @register_lowering(OpType.EXPERTS)
 def _experts(attrs, inputs, params, ctx):
     """Fused MoE FFN: top-k gate -> capacity dispatch -> two-layer expert
     FFN (einsum over stacked expert weights) -> weighted combine. Auxiliary
     load-balance loss (Switch-style) is written into ctx.state_updates for
-    the executor to add to the loss."""
+    the executor to add to the loss.
+
+    attrs.dispatch picks the dispatch implementation:
+      "sort"  (default) — argsort tokens by expert, scatter rows into a
+        static (n*cap, d) buffer, gather back after the expert matmuls.
+        O(tokens*dim) data movement like the reference's scatter kernels
+        (group_by.cu / aggregate.cu); scales to Mixtral shapes where the
+        one-hot mask alone would be GiBs.
+      "dense" — one-hot dispatch/combine einsums; numerics oracle.
+    """
     x, gate_logits = inputs  # (..., d), (..., n)
     orig_shape = x.shape
     d = x.shape[-1]
@@ -660,16 +700,62 @@ def _experts(attrs, inputs, params, ctx):
     if attrs.normalize:
         topv = topv / topv.sum(axis=-1, keepdims=True)
     cap = attrs.capacity(t)
-    disp = _dispatch_mask(topi.astype(jnp.int32), attrs.n_experts, cap)  # (t,k,n,c)
-    combine = disp * topv[..., None, None]
-    disp_tok = disp.sum(axis=1)  # (t,n,c)
-    buf = jnp.einsum("tnc,td->ncd", disp_tok.astype(xt.dtype), xt)
-    h = jnp.einsum("ncd,ndh->nch", buf, params["w1"].astype(xt.dtype))
-    h = apply_activation(h, attrs.activation)
-    o = jnp.einsum("nch,nho->nco", h, params["w2"].astype(xt.dtype))
-    y = jnp.einsum("tknc,nco->to", combine.astype(o.dtype), o)
+    n = attrs.n_experts
+
+    if getattr(attrs, "dispatch", "sort") == "sort":
+        slot_of_flat, kept = _sorted_dispatch(topi, t, n, cap)
+        token_of_flat = jnp.tile(jnp.arange(t, dtype=jnp.int32), attrs.k)
+        # scatter token rows into the expert buffer; row n*cap collects
+        # dropped slots and is sliced off. (token, expert) pairs are
+        # unique (top_k), so kept rows get exactly one write.
+        buf = jnp.zeros((n * cap + 1, d), xt.dtype).at[slot_of_flat].set(
+            xt[token_of_flat], mode="drop", unique_indices=False
+        )
+        buf = buf[:-1].reshape(n, cap, d)
+        # expert-parallel: pin the buffer to the weights' expert axis so
+        # the scatter lowers to the token all-to-all over that axis and
+        # each device runs only its expert slice of the matmuls (the
+        # reference's Repartition/Combine EP over NCCL, done by GSPMD)
+        view = ctx.sharding
+        if (ctx.mesh is not None and view is not None
+                and "w1" in getattr(view, "weight_specs", {})):
+            from jax.sharding import NamedSharding
+
+            from flexflow_tpu.parallel.sharding import (
+                prune_spec,
+                spec_to_partition_spec,
+            )
+
+            spec = prune_spec(
+                view.weight_specs["w1"][:1] + ((), ()),
+                buf.shape, ctx.mesh,
+            )
+            buf = lax.with_sharding_constraint(
+                buf, NamedSharding(ctx.mesh, spec_to_partition_spec(spec))
+            )
+        h = jnp.einsum("ncd,ndh->nch", buf, params["w1"].astype(xt.dtype))
+        h = apply_activation(h, attrs.activation)
+        o = jnp.einsum("nch,nho->nco", h, params["w2"].astype(xt.dtype))
+        o_flat = jnp.concatenate(
+            [o.reshape(n * cap, attrs.out_dim),
+             jnp.zeros((1, attrs.out_dim), o.dtype)], axis=0
+        )
+        per_slot = o_flat[slot_of_flat]  # (t*k, out) — dropped slots -> 0
+        w = topv.transpose(1, 0).reshape(-1, 1).astype(per_slot.dtype)
+        y = (per_slot * w).reshape(attrs.k, t, attrs.out_dim).sum(axis=0)
+        kept_f = kept.astype(jnp.float32)
+        frac = kept_f / jnp.maximum(kept_f.sum(), 1.0)
+    else:
+        disp = _dispatch_mask(topi.astype(jnp.int32), n, cap)  # (t,k,n,c)
+        combine = disp * topv[..., None, None]
+        disp_tok = disp.sum(axis=1)  # (t,n,c)
+        buf = jnp.einsum("tnc,td->ncd", disp_tok.astype(xt.dtype), xt)
+        h = jnp.einsum("ncd,ndh->nch", buf, params["w1"].astype(xt.dtype))
+        h = apply_activation(h, attrs.activation)
+        o = jnp.einsum("nch,nho->nco", h, params["w2"].astype(xt.dtype))
+        y = jnp.einsum("tknc,nco->to", combine.astype(o.dtype), o)
+        frac = disp_tok.sum(axis=(0, 2)) / jnp.maximum(disp_tok.sum(), 1.0)
     # Switch-transformer load-balance aux loss: n * sum_e f_e * p_e
-    frac = disp_tok.sum(axis=(0, 2)) / jnp.maximum(disp_tok.sum(), 1.0)  # (n,)
     mean_prob = probs.mean(axis=0)
     aux = attrs.n_experts * jnp.sum(frac * mean_prob) * attrs.lambda_bal
     ctx.state_updates["__aux_loss__"] = aux

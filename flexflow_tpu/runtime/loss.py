@@ -33,6 +33,11 @@ def _fused_sparse_ce(logits, labels):
 
 
 def _fused_sparse_ce_fwd(logits, labels):
+    # clamp once so forward (gather) and backward (one_hot) agree on the
+    # effective target index even for out-of-range/sentinel labels —
+    # autodiff of the plain expression is self-consistent only because the
+    # gather and its transpose share clamping; the hand VJP must too
+    labels = jnp.clip(labels, 0, logits.shape[-1] - 1)
     lf = logits.astype(jnp.float32)
     lse = jax.nn.logsumexp(lf, axis=-1)
     tgt = jnp.take_along_axis(lf, labels[:, None], axis=-1)[:, 0]
@@ -40,7 +45,7 @@ def _fused_sparse_ce_fwd(logits, labels):
 
 
 def _fused_sparse_ce_bwd(res, gbar):
-    logits, labels, lse = res
+    logits, labels, lse = res  # labels already clamped by fwd
     n = logits.shape[0]
     probs = jnp.exp(logits.astype(jnp.float32) - lse[:, None])
     onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
