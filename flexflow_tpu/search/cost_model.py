@@ -122,7 +122,8 @@ class CostModel:
         return t * pipeline_compute_factor(node, view, self.axis_sizes)
 
     def node_comm_time(self, graph: Graph, node: Node,
-                       view: Optional[ShardingView]) -> float:
+                       view: Optional[ShardingView],
+                       training: bool = True) -> float:
         """Collective cost attributable to the node itself:
         - parallel ops (Reduction/Combine/Repartition/AllToAll) price the
           collective GSPMD will emit for them;
@@ -201,6 +202,46 @@ class CostModel:
                     return 2.0 * self.machine.all_to_all_time(
                         ins[0].global_bytes(), deg, axes=tuple(w1[0])
                     )
+        # sequence-parallel attention: the comm that makes ring attention
+        # win. A plain MULTIHEAD_ATTENTION under a seq-sharded view is
+        # executable (the shard_map flash wrapper keeps S local, so GSPMD
+        # all-gathers q/k/v first) but pays that gather serially;
+        # RING_ATTENTION instead ppermutes k/v blockwise, overlapping the
+        # transfer with per-block attention compute — only the unhidden
+        # remainder is charged (ulysses: two all-to-all exchange legs).
+        if (node.op_type in (OpType.MULTIHEAD_ATTENTION,
+                             OpType.RING_ATTENTION)
+                and view is not None and node.outputs
+                and node.outputs[0].ndim >= 3):
+            spec = view.output_spec(0)
+            seq_axes = tuple(spec[1]) if spec and len(spec) > 1 and spec[1] else ()
+            deg = axes_degree(seq_axes)
+            if deg > 1:
+                a = node.attrs
+                b = node.outputs[0].dims[0].size
+                s = node.outputs[0].dims[1].size
+                dt = node.outputs[0].dtype.size_bytes
+                hd = a.kdim
+                q_bytes = b * s * a.num_heads * hd * dt
+                kv_bytes = 2 * b * s * a.num_kv * hd * dt
+                if node.op_type == OpType.MULTIHEAD_ATTENTION:
+                    return self.machine.all_gather_time(
+                        q_bytes + kv_bytes, deg, axes=seq_axes
+                    )
+                if getattr(a, "seq_mode", "ring") == "ulysses":
+                    # the lowering repeats GQA KV to num_heads before the
+                    # exchange, so the all-to-all moves full-head KV
+                    kv_full = 2 * b * s * a.num_heads * hd * dt
+                    return 2.0 * self.machine.all_to_all_time(
+                        q_bytes + kv_full, deg, axes=seq_axes
+                    )
+                transfer = self.machine.all_gather_time(
+                    kv_bytes, deg, axes=seq_axes
+                )
+                compute = self.node_compute_time(graph, node, view,
+                                                 training=training)
+                return max((deg - 1) * self.machine.ici_latency,
+                           transfer - compute)
         # pipeline: each of the (M+P-1) schedule ticks ppermutes one
         # microbatch activation to the next stage (one ICI hop)
         if is_pipe_sharded(node, view) and ins:
@@ -365,7 +406,7 @@ def graph_cost(graph: Graph, strategy: Dict[str, ShardingView],
     for node in graph.topo_order():
         view = strategy.get(node.name, node.sharding)
         compute += cost.node_compute_time(graph, node, view, training)
-        comm += cost.node_comm_time(graph, node, view)
+        comm += cost.node_comm_time(graph, node, view, training)
         if training:
             comm += cost.weight_sync_time(graph, node, view)
         mem += cost.node_memory(graph, node, view, training)

