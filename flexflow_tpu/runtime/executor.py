@@ -616,25 +616,33 @@ class Executor:
     def init_kv_cache(self, batch: int, max_len: int, dtype=None):
         """Per-attention-node K/V buffers for autoregressive decoding
         (net-new vs the reference, which has no generation path). Buffer
-        dtype follows each attention's activation dtype unless given."""
+        dtype follows each attention's activation dtype unless given.
+        RING_ATTENTION nodes decode through the shared MHA cache path
+        (decode is sequential — no sequence to shard); PIPELINE
+        composites get layer-stacked (L, b, maxlen, kv, hd) buffers
+        threaded through their layer scan."""
         caches = {}
         for n in self.topo:
-            if n.op_type != OpType.MULTIHEAD_ATTENTION:
-                continue
-            hd = n.attrs.kdim
-            kv = n.attrs.num_kv
+            ins = self.graph.input_shapes(n)
             dt = dtype
             if dt is None:
-                ins = self.graph.input_shapes(n)
                 dt = ins[0].dtype.jnp_dtype if ins else jnp.bfloat16
-            shape = (batch, max_len, kv, hd)
+            if n.op_type in (OpType.MULTIHEAD_ATTENTION,
+                             OpType.RING_ATTENTION):
+                shape = (batch, max_len, n.attrs.num_kv, n.attrs.kdim)
+            elif n.op_type == OpType.PIPELINE:
+                dim = ins[0].dims[-1].size
+                shape = (n.attrs.layers, batch, max_len, n.attrs.kv_heads,
+                         dim // n.attrs.heads)
+            else:
+                continue
             caches[node_key(n)] = {
                 "k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)
             }
         if not caches:
             raise ValueError(
-                "generate() needs MULTIHEAD_ATTENTION nodes (ring/Ulysses "
-                "and PIPELINE composites have no decode path)"
+                "generate() needs attention nodes (MULTIHEAD_ATTENTION, "
+                "RING_ATTENTION, or a PIPELINE composite)"
             )
         return caches
 

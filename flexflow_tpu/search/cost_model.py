@@ -229,11 +229,14 @@ class CostModel:
                         q_bytes + kv_bytes, deg, axes=seq_axes
                     )
                 if getattr(a, "seq_mode", "ring") == "ulysses":
-                    # the lowering repeats GQA KV to num_heads before the
-                    # exchange, so the all-to-all moves full-head KV
+                    # leg 1 moves q + full-head KV (the lowering repeats
+                    # GQA KV to num_heads before the exchange); leg 2
+                    # moves only the attention output (q-sized)
                     kv_full = 2 * b * s * a.num_heads * hd * dt
-                    return 2.0 * self.machine.all_to_all_time(
+                    return self.machine.all_to_all_time(
                         q_bytes + kv_full, deg, axes=seq_axes
+                    ) + self.machine.all_to_all_time(
+                        q_bytes, deg, axes=seq_axes
                     )
                 transfer = self.machine.all_gather_time(
                     kv_bytes, deg, axes=seq_axes

@@ -392,35 +392,53 @@ def _single_succ(graph: Graph, node: Node):
 
 def _find_decoder_runs(graph: Graph) -> List[List[Node]]:
     """Maximal runs (>= 2) of consecutive identical decoder blocks, each
-    returned as the flat node list of the whole run."""
+    returned as the flat node list of the whole run. Block i can only be
+    EXTENDED by block i+1 when its residual output feeds exactly the next
+    block's (rms1, add1) pair — an external tap (aux head, early exit)
+    ends the run there, so the rewrite never deletes a tensor someone
+    else consumes. A signature change mid-chain starts a fresh run (e.g.
+    blocks A,A,B,B yield the A,A and B,B runs)."""
     blocks = {}
     for n in graph.nodes:
         m = _match_decoder_block(graph, n)
         if m:
             nodes, h_key, out, sig = m
             blocks[h_key] = (nodes, out, sig)
+
+    def extends(cur_key):
+        """Key of the next chained block, or None if the run ends here."""
+        nodes, out, sig = blocks[cur_key]
+        nxt_key = (out.guid, 0)
+        nxt = blocks.get(nxt_key)
+        if nxt is None or nxt[2] != sig:
+            return None
+        # the residual output must feed ONLY the next block's rms1 + add1
+        nxt_nodes = nxt[0]
+        if {s.guid for s in graph.succs(out)} != {
+                nxt_nodes[0].guid, nxt_nodes[2].guid}:
+            return None
+        return nxt_key
+
+    continued = {extends(k) for k in blocks} - {None}
     runs = []
-    starts = set(blocks)
-    # a block whose input is another block's output is not a run start
-    for h_key, (_, out, _) in blocks.items():
-        starts.discard((out.guid, 0))
-    for start in starts:
+    for start in blocks:
+        if start in continued:
+            continue  # not a run head: a same-sig block chains into it
         run_nodes = []
         key = start
-        sig0 = blocks[key][2]
         count = 0
-        while key in blocks and blocks[key][2] == sig0:
-            nodes, out, _ = blocks[key]
-            run_nodes.extend(nodes)
-            key = (out.guid, 0)
+        while True:
+            run_nodes.extend(blocks[key][0])
             count += 1
+            key = extends(key)
+            if key is None:
+                break
         if count >= 2:
             runs.append(run_nodes)
     return runs
 
 
-def make_blocks_to_pipeline(axis_sizes: Dict[str, int],
-                            batch_size: Optional[int] = None) -> GraphXfer:
+def make_blocks_to_pipeline(axis_sizes: Dict[str, int]) -> GraphXfer:
     """N consecutive decoder blocks -> one PIPELINE composite (stacked
     weights, GPipe over the `pipe` axis). The structure-discovery analog of
     the reference's parallel-chain rewrites for the net-new pipeline mode

@@ -97,6 +97,58 @@ def test_discovered_ring_graph_compiles_and_trains():
     assert np.isfinite(m.sparse_cce_loss)
 
 
+def test_decoder_run_stops_at_external_tap():
+    """A mid-run residual tapped by an aux head ends the run there — the
+    rewrite must never delete a tensor an outside consumer reads."""
+    from flexflow_tpu.search.substitution import _find_decoder_runs
+
+    cfg = LlamaConfig(vocab_size=128, dim=64, layers=4, heads=4,
+                      kv_heads=2, hidden=128, rope_theta=10000.0)
+    ff = FFModel(FFConfig(batch_size=4))
+    h = build_llama(ff, cfg, seq_len=32)
+    # tap layer 1's residual stream with an aux head
+    l1_out = next(n for n in ff.graph.nodes if n.name == "l1_res2")
+    from flexflow_tpu.model import Tensor
+    ff.dense(Tensor(l1_out), 8, name="aux_head")
+    ff.graph.infer_shapes()
+    runs = _find_decoder_runs(ff.graph)
+    # blocks 0-1 end at the tap; blocks 2-3 form the second run
+    assert sorted(len(r) // 10 for r in runs) == [2, 2]
+
+
+def test_decoder_runs_restart_after_signature_change():
+    """Identical blocks after a mid-chain signature change still form
+    their own run (A,A,B,B -> two 2-block runs)."""
+    from flexflow_tpu.search.substitution import _find_decoder_runs
+
+    ff = FFModel(FFConfig(batch_size=4))
+    from flexflow_tpu.ffconst import DataType
+
+    ids = ff.create_tensor((4, 32), DataType.INT32, name="ids")
+    h = ff.embedding(ids, 128, 64, dtype=DataType.BFLOAT16, name="emb")
+
+    def block(h, i, hidden):
+        a = ff.rms_norm(h, name=f"b{i}_n1")
+        a = ff.multihead_attention(a, a, a, 64, 4, bias=False, causal=True,
+                                   kv_heads=2, rope=True, name=f"b{i}_attn")
+        h = ff.add(h, a, name=f"b{i}_r1")
+        m = ff.rms_norm(h, name=f"b{i}_n2")
+        g = ff.dense(m, hidden, use_bias=False, name=f"b{i}_gate")
+        u = ff.dense(m, hidden, use_bias=False, name=f"b{i}_up")
+        x = ff.multiply(ff.silu(g, name=f"b{i}_silu"), u, name=f"b{i}_mul")
+        d = ff.dense(x, 64, use_bias=False, name=f"b{i}_down")
+        return ff.add(h, d, name=f"b{i}_r2")
+
+    for i in range(2):
+        h = block(h, i, 128)      # signature A
+    for i in range(2, 4):
+        h = block(h, i, 256)      # signature B
+    ff.dense(h, 128, use_bias=False, name="head")
+    ff.graph.infer_shapes()
+    runs = _find_decoder_runs(ff.graph)
+    assert sorted(len(r) // 10 for r in runs) == [2, 2]
+
+
 def test_search_discovers_pipeline_on_pipe_mesh():
     from flexflow_tpu.search.api import _cost_model
 
