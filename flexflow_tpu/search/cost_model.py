@@ -213,6 +213,21 @@ class CostModel:
                              OpType.RING_ATTENTION)
                 and view is not None and node.outputs
                 and node.outputs[0].ndim >= 3):
+            # head-sharded wo is a CONTRACTION over heads: each shard
+            # produces a partial sum of the output projection and GSPMD
+            # emits an all-reduce — priced like row-TP linears (the
+            # reference prices attention head parallelism's merge the same
+            # way through its comm tasks). ADDITIVE with the seq-parallel
+            # term below: a head+seq combined view pays both collectives.
+            attn_comm = 0.0
+            wo = view.weight_specs.get("wo")
+            if wo and len(wo) >= 1 and wo[0]:
+                deg_wo = axes_degree(wo[0])
+                if deg_wo > 1:
+                    attn_comm += self.machine.all_reduce_time(
+                        node.outputs[0].global_bytes(), deg_wo,
+                        axes=tuple(wo[0]),
+                    )
             spec = view.output_spec(0)
             seq_axes = tuple(spec[1]) if spec and len(spec) > 1 and spec[1] else ()
             deg = axes_degree(seq_axes)
@@ -225,26 +240,29 @@ class CostModel:
                 q_bytes = b * s * a.num_heads * hd * dt
                 kv_bytes = 2 * b * s * a.num_kv * hd * dt
                 if node.op_type == OpType.MULTIHEAD_ATTENTION:
-                    return self.machine.all_gather_time(
+                    attn_comm += self.machine.all_gather_time(
                         q_bytes + kv_bytes, deg, axes=seq_axes
                     )
-                if getattr(a, "seq_mode", "ring") == "ulysses":
+                elif getattr(a, "seq_mode", "ring") == "ulysses":
                     # leg 1 moves q + full-head KV (the lowering repeats
                     # GQA KV to num_heads before the exchange); leg 2
                     # moves only the attention output (q-sized)
                     kv_full = 2 * b * s * a.num_heads * hd * dt
-                    return self.machine.all_to_all_time(
+                    attn_comm += self.machine.all_to_all_time(
                         q_bytes + kv_full, deg, axes=seq_axes
                     ) + self.machine.all_to_all_time(
                         q_bytes, deg, axes=seq_axes
                     )
-                transfer = self.machine.all_gather_time(
-                    kv_bytes, deg, axes=seq_axes
-                )
-                compute = self.node_compute_time(graph, node, view,
-                                                 training=training)
-                return max((deg - 1) * self.machine.ici_latency,
-                           transfer - compute)
+                else:
+                    transfer = self.machine.all_gather_time(
+                        kv_bytes, deg, axes=seq_axes
+                    )
+                    compute = self.node_compute_time(graph, node, view,
+                                                     training=training)
+                    attn_comm += max((deg - 1) * self.machine.ici_latency,
+                                     transfer - compute)
+            if attn_comm > 0.0:
+                return attn_comm
         # pipeline: each of the (M+P-1) schedule ticks ppermutes one
         # microbatch activation to the next stage (one ICI hop)
         if is_pipe_sharded(node, view) and ins:

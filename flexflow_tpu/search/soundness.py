@@ -1,0 +1,458 @@
+"""Mechanical soundness verification of the substitution-rule corpus.
+
+The machine-checkable analog of TASO's rule verification (the reference
+ships substitutions/graph_subst_3_v2.json pre-verified; here every rule in
+search/rules/default_rules.json is replayed at test time):
+
+  1. `instantiate_rule` builds a tiny concrete graph realizing the rule's
+     src pattern (shapes/attrs chosen to satisfy the `when`/`where`
+     guards), with an identity "anchor" node on every pattern output so
+     rewiring is exercised;
+  2. the rule is applied through the real engine (find_matches +
+     apply_match);
+  3. both graphs run through the op lowerings with SHARED weights
+     (per-guid transfer; weight-restructuring rules declare a bijection in
+     WEIGHT_MAPS) and random inputs;
+  4. outputs must agree to floating-point-reassociation tolerance.
+
+A rule that cannot be instantiated or fails equivalence fails the suite —
+the corpus cannot silently grow unsound rewrites.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.ffconst import ActiMode, DataType, OpType, PoolType
+from flexflow_tpu.ops import attrs as A
+from flexflow_tpu.ops.registry import LowerCtx, get_lowering
+from flexflow_tpu.pcg.graph import Graph, Node
+from flexflow_tpu.pcg.tensor import TensorShape
+from flexflow_tpu.search.xfer_engine import (
+    ATTRS_CLASSES,
+    apply_match,
+    find_matches,
+)
+
+# Weight bijections live ON the rules ("weight_map": {"op": ..., ...});
+# this module only interprets the declared ops:
+#   concat_kernels: merged kernel = matched kernels concatenated on `axis`
+#   conv1x1_to_linear: conv kernel (f, c, 1, 1) -> linear kernel (c, f)
+
+
+# ---------------------------------------------------------------------------
+# pattern instantiation
+
+
+def _when_overrides(when: Optional[Dict]) -> Dict:
+    """Translate a `when` clause into concrete attr constraints."""
+    out: Dict = {}
+    if not when:
+        return out
+    if "activation" in when:
+        out["activation"] = ActiMode[when["activation"]]
+    if "activation_in" in when:
+        out["activation"] = ActiMode[when["activation_in"][0]]
+    if "unary_kind" in when:
+        out["kind"] = when["unary_kind"][0]
+    for pair in _pairs(when.get("attr_eq")):
+        f, v = pair
+        if isinstance(v, list):
+            v = tuple(v)
+        if f == "pool_type" and isinstance(v, str):
+            v = PoolType(v)
+        if f == "activation" and isinstance(v, str):
+            v = ActiMode(v)
+        out[f] = v
+    return out
+
+
+def _pairs(spec):
+    if not spec:
+        return []
+    return spec if isinstance(spec[0], (list, tuple)) else [spec]
+
+
+def _default_attrs(op: OpType, in_shapes: List[Shape], ov: Dict,
+                   n_outputs: int, rule_name: str,
+                   adversarial: bool = False):
+    """Concrete attrs for a pattern node given its input shapes and the
+    overrides derived from its `when` clause. `adversarial` flips every
+    non-pinned default toward the configuration MOST likely to break an
+    under-guarded rule (biased linears, last-axis-moving transposes,
+    narrowing casts, batch-axis norms): a rule whose guards are complete
+    simply fails to match the adversarial instance; one whose guards are
+    too weak matches — and must still preserve numerics."""
+    def get(f, d):
+        return ov.get(f, d)
+
+    nd = in_shapes[0].ndim if in_shapes else 2
+    if op == OpType.LINEAR:
+        return A.LinearAttrs(int(get("out_dim", 6)),
+                             get("use_bias", adversarial),
+                             get("activation", ActiMode.NONE))
+    if op == OpType.CONV2D:
+        kern = tuple(get("kernel", (3, 3)))
+        pad = tuple(get("padding", (1, 1) if kern == (3, 3) else (0, 0)))
+        return A.Conv2DAttrs(int(get("out_channels", 5)), kern,
+                             tuple(get("stride", (1, 1))), pad,
+                             int(get("groups", 1)),
+                             get("use_bias", adversarial),
+                             get("activation", ActiMode.NONE))
+    if op == OpType.EMBEDDING:
+        return A.EmbeddingAttrs(10, 6)
+    if op == OpType.ELEMENT_UNARY:
+        kind = get("kind", "gelu")
+        scalar = get("scalar", 0.7 if kind.startswith("scalar") or
+                     kind == "pow" else 0.0)
+        return A.ElementUnaryAttrs(kind, float(scalar))
+    if op == OpType.ELEMENT_BINARY:
+        return A.ElementBinaryAttrs(get("kind", "add"))
+    if op == OpType.RESHAPE:
+        dims = [d.size for d in in_shapes[0].dims]
+        if len(dims) == 1:  # chain partner: split a flattened input back
+            return A.ReshapeAttrs((2, dims[0] // 2))
+        return A.ReshapeAttrs(tuple([dims[0] * dims[1]] + dims[2:]))
+    if op == OpType.TRANSPOSE:
+        perm = get("perm", None)
+        if perm is None:
+            if adversarial and nd > 1:
+                perm = tuple(range(1, nd)) + (0,)   # MOVES the last axis
+            else:
+                # fix the last axis (satisfies perm_fixes_last)
+                perm = tuple(reversed(range(nd - 1))) + (nd - 1,)
+        return A.TransposeAttrs(tuple(perm))
+    if op == OpType.REVERSE:
+        return A.ReverseAttrs(int(get("axis", -1 if adversarial else 0)))
+    if op == OpType.CONCAT:
+        dflt = (-1 if adversarial else 1) if nd > 1 else 0
+        return A.ConcatAttrs(int(get("axis", dflt)))
+    if op == OpType.SPLIT:
+        ax = int(get("axis", 1 if nd > 1 else 0))
+        total = in_shapes[0].dims[ax].size
+        n = max(n_outputs, 2)
+        part = total // n
+        sizes = [part] * (n - 1) + [total - part * (n - 1)]
+        return A.SplitAttrs(tuple(sizes), ax)
+    if op == OpType.CAST:
+        if "identity" in rule_name:  # where cast_identity: dtype == input's
+            return A.CastAttrs(in_shapes[0].dtype)
+        dflt = DataType.HALF if adversarial else DataType.DOUBLE  # narrowing
+        return A.CastAttrs(get("dtype", dflt))
+    if op == OpType.SOFTMAX:
+        return A.SoftmaxAttrs(int(get("axis", -1)))
+    if op == OpType.POOL2D:
+        return A.Pool2DAttrs(tuple(get("kernel", (2, 2))),
+                             tuple(get("stride", (2, 2))),
+                             tuple(get("padding", (0, 0))),
+                             get("pool_type", PoolType.MAX),
+                             get("activation", ActiMode.NONE))
+    if op == OpType.LAYER_NORM:
+        dflt_axes = (0, -1) if adversarial and nd > 1 else (-1,)
+        return A.LayerNormAttrs(tuple(get("axes", dflt_axes)),
+                                get("elementwise_affine", not adversarial),
+                                float(get("eps", 1e-5)))
+    if op == OpType.RMS_NORM:
+        return A.RMSNormAttrs(float(get("eps", 1e-6)))
+    if op == OpType.BATCH_NORM:
+        return A.BatchNormAttrs(get("relu", False))
+    if op == OpType.DROPOUT:
+        return A.DropoutAttrs(float(get("rate", 0.0)))
+    if op in (OpType.REDUCE_SUM, OpType.MEAN):
+        kind = "sum" if op == OpType.REDUCE_SUM else "mean"
+        # reduce the LAST axis by default; rules that relate the axes to a
+        # concat/split axis pick concat axis 1 on 3d inputs, so -1 avoids
+        # it and (1,) hits it (selected by rule name below)
+        axes = get("axes", (1,) if "concat_axis" in rule_name else (-1,))
+        return A.ReduceAttrs(kind, tuple(axes), get("keepdims", True))
+    if op == OpType.MULTIHEAD_ATTENTION:
+        return A.MultiHeadAttentionAttrs(8, 2, causal=True)
+    if op == OpType.RING_ATTENTION:
+        return A.RingAttentionAttrs(8, 2, causal=True)
+    if op == OpType.EXPERTS:
+        return A.ExpertsAttrs(4, 2, 8, 6, 2.0, dispatch="sort")
+    raise NotImplementedError(f"no instantiator for {op}")
+
+
+# per-input-slot shape requirements by consumer type
+def _input_shape_for(op: OpType, dst_idx: int, profile_nd: int,
+                     rule_name: str) -> Tuple[Tuple[int, ...], DataType]:
+    f32 = DataType.FLOAT
+    if op in (OpType.CONV2D, OpType.POOL2D, OpType.BATCH_NORM):
+        return (2, 4, 6, 6), f32
+    if op == OpType.EMBEDDING:
+        return (2, 5), DataType.INT32
+    if op in (OpType.MULTIHEAD_ATTENTION, OpType.RING_ATTENTION):
+        return (2, 6, 8), f32
+    if op == OpType.EXPERTS:
+        return ((6, 8), f32) if dst_idx == 0 else ((6, 4), f32)
+    if profile_nd == 3:
+        return (2, 4, 6), f32
+    if profile_nd == 4:
+        return (2, 3, 4, 6), f32
+    return (4, 6), f32
+
+
+# rules whose shapes must chain (batch matmuls) get explicit input shapes
+_BMM_SHAPES = {
+    "assoc_bmm_left": {"a": (2, 3, 4), "b": (2, 4, 5), "c": (2, 5, 6)},
+    "assoc_bmm_right": {"a": (2, 3, 4), "b": (2, 4, 5), "c": (2, 5, 6)},
+    "slide_scalar_mul_out_of_bmm": {"a": (2, 3, 4), "b": (2, 4, 5)},
+    "slide_scalar_mul_into_bmm": {"a": (2, 3, 4), "b": (2, 4, 5)},
+}
+
+
+def _bmm_rule_shapes(name: str):
+    if name in _BMM_SHAPES:
+        return _BMM_SHAPES[name]
+    if name.startswith("partition_bmm_combine"):
+        nd = 5 if name.endswith("_5d") else 4 if name.endswith("_4d") else 3
+        lead = (2,) * (nd - 2)
+        return {"a": lead + (3, 4), "b": lead + (4, 5)}
+    return None
+
+
+def instantiate_rule(rule: Dict, profile_nd: int = 2,
+                     adversarial: bool = False):
+    """Build a concrete graph for the rule's src pattern. Returns
+    (graph, feed {input_id: array}, anchors {position: anchor node name})
+    or None when this profile cannot realize the pattern."""
+    src = rule["src"]
+    specs = {s["id"]: s for s in src["nodes"]}
+    pedges = [tuple(e) for e in src.get("edges", ())]
+    pinputs = [tuple(i) for i in src.get("inputs", ())]
+    poutputs = [tuple(o) for o in src.get("outputs", ())]
+    name = rule["name"]
+
+    g = Graph()
+    rs = np.random.RandomState(0)
+
+    # choose external input shapes from their first consumer
+    feed: Dict[str, np.ndarray] = {}
+    input_nodes: Dict[str, Node] = {}
+    for (iid, did, didx) in pinputs:
+        if iid in input_nodes:
+            continue
+        op = OpType[specs[did]["type"]]
+        bmm_shapes = _bmm_rule_shapes(name)
+        if bmm_shapes is not None and iid in bmm_shapes:
+            shape, dt = bmm_shapes[iid], DataType.FLOAT
+        else:
+            shape, dt = _input_shape_for(op, didx, profile_nd, name)
+        n = g.create_node(OpType.INPUT, A.InputAttrs(TensorShape(shape, dt)),
+                          f"in_{iid}")
+        n.outputs = tuple(n.attrs.infer())
+        input_nodes[iid] = n
+        if dt == DataType.INT32:
+            feed[iid] = rs.randint(0, 10, shape).astype(np.int32)
+        else:
+            feed[iid] = rs.randn(*shape).astype(np.float32)
+
+    # build pattern nodes in dependency order
+    built: Dict[str, Node] = {}
+    remaining = list(specs)
+    guard = 0
+    while remaining and guard < 100:
+        guard += 1
+        for pid in list(remaining):
+            deps = [sid for (sid, _, did, _) in pedges if did == pid]
+            if any(d not in built for d in deps):
+                continue
+            spec = specs[pid]
+            op = OpType[spec["type"]]
+            # collect input shapes in dst_idx order
+            ins: List[Tuple[int, Node, int]] = []
+            for (sid, si, did, di) in pedges:
+                if did == pid:
+                    ins.append((di, built[sid], si))
+            for (iid, did, didx) in pinputs:
+                if did == pid:
+                    ins.append((didx, input_nodes[iid], 0))
+            ins.sort(key=lambda t: t[0])
+            in_shapes = [p.outputs[i] for (_, p, i) in ins]
+            n_out = max([si for (sid, si, _, _) in pedges if sid == pid]
+                        + [oi for (nid, oi) in poutputs if nid == pid]
+                        + [0]) + 1
+            ov = _when_overrides(spec.get("when"))
+            if op == OpType.BATCH_MATMUL:
+                attrs = A.BatchMatmulAttrs()
+            else:
+                attrs = _default_attrs(op, in_shapes, ov, n_out, name,
+                                       adversarial=adversarial)
+            node = g.create_node(op, attrs, pid)
+            for (didx, producer, si) in ins:
+                g.add_edge(producer, node, si, didx)
+            try:
+                node.in_shapes = tuple(in_shapes)
+                node.outputs = tuple(attrs.infer(*in_shapes))
+            except Exception:
+                return None  # attrs inconsistent with these shapes
+            built[pid] = node
+            remaining.remove(pid)
+    if remaining:
+        return None
+
+    # identity anchors on every pattern output (externally consumed, so
+    # the rewrite's rewiring path is exercised)
+    anchors: List[str] = []
+    for k, (nid, oidx) in enumerate(poutputs):
+        a = g.create_node(OpType.ELEMENT_UNARY,
+                          A.ElementUnaryAttrs("identity"), f"anchor{k}")
+        g.add_edge(built[nid], a, oidx, 0)
+        anchors.append(a.name)
+    try:
+        g.infer_shapes()
+    except Exception:
+        return None
+    return g, feed, anchors
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+
+
+def _init_params(graph: Graph, seed: int = 1) -> Dict[int, Dict[str, np.ndarray]]:
+    """Random weights per weighted node, keyed by GUID (names may change
+    across a rewrite; guids survive via reuse)."""
+    rs = np.random.RandomState(seed)
+    out: Dict[int, Dict[str, np.ndarray]] = {}
+    for n in graph.topo_order():
+        if n.attrs is None or n.op_type == OpType.INPUT:
+            continue
+        ws = n.attrs.weights(*graph.input_shapes(n))
+        if not ws:
+            continue
+        out[n.guid] = {
+            wn: rs.randn(*[d for d in spec.shape.dims]).astype(np.float32)
+            * 0.3
+            for wn, spec in ws.items()
+        }
+    return out
+
+
+def _transfer_params(rule: Dict, src_params: Dict, dst_graph: Graph,
+                     match) -> Optional[Dict]:
+    """Weights for the rewritten graph: copy by guid when shapes agree,
+    else apply the rule's declared weight bijection."""
+    out: Dict[int, Dict[str, np.ndarray]] = {}
+    for n in dst_graph.topo_order():
+        if n.attrs is None or n.op_type == OpType.INPUT:
+            continue
+        ws = n.attrs.weights(*dst_graph.input_shapes(n))
+        if not ws:
+            continue
+        have = src_params.get(n.guid)
+        shapes_ok = have is not None and all(
+            wn in have and tuple(have[wn].shape) ==
+            tuple(d for d in spec.shape.dims)
+            for wn, spec in ws.items()
+        )
+        if shapes_ok:
+            out[n.guid] = dict(have)
+            continue
+        wm = rule.get("weight_map")
+        if wm is None:
+            return None  # restructured weights without a declared bijection
+        matched_weighted = [m for m in match.nodes.values()
+                            if m.guid in src_params]
+        if wm["op"] == "concat_kernels":
+            kerns = [src_params[m.guid]["kernel"]
+                     for m in sorted(matched_weighted, key=lambda x: x.guid)]
+            out[n.guid] = {"kernel": np.concatenate(kerns, axis=wm["axis"])}
+        elif wm["op"] == "conv1x1_to_linear":
+            (cv,) = matched_weighted
+            k = src_params[cv.guid]["kernel"]  # (f, c, 1, 1)
+            out[n.guid] = {"kernel": k[:, :, 0, 0].T.copy()}
+        else:
+            return None
+    return out
+
+
+def run_graph(graph: Graph, feed: Dict[str, np.ndarray],
+              params: Dict[int, Dict[str, np.ndarray]],
+              anchors: List[str]) -> List[np.ndarray]:
+    """Mini-interpreter over the registered lowerings (single device,
+    inference mode). Returns the anchor outputs in order."""
+    import jax.numpy as jnp
+
+    values: Dict[Tuple[int, int], object] = {}
+    by_name: Dict[str, Node] = {}
+    for n in graph.topo_order():
+        by_name[n.name] = n
+        if n.op_type == OpType.INPUT:
+            iid = n.name[len("in_"):]
+            values[(n.guid, 0)] = jnp.asarray(feed[iid])
+            continue
+        ins = [values[(e.src, e.src_idx)] for e in graph.in_edges(n)]
+        p = {k: jnp.asarray(v) for k, v in params.get(n.guid, {}).items()}
+        ctx = LowerCtx(training=False, rng=None, mesh=None)
+        outs = get_lowering(n.op_type)(n.attrs, ins, p, ctx)
+        for i, o in enumerate(outs):
+            values[(n.guid, i)] = o
+    return [np.asarray(values[(by_name[a].guid, 0)], np.float64)
+            for a in anchors]
+
+
+# ---------------------------------------------------------------------------
+# verification entry
+
+
+def _check_instance(rule: Dict, inst, rtol: float, atol: float,
+                    label: str) -> int:
+    g, feed, anchors = inst
+    matches = find_matches(rule, g)
+    params = _init_params(g)
+    ref = run_graph(g, feed, params, anchors)
+    checked = 0
+    for m in matches:
+        g2 = apply_match(rule, g, m)
+        if g2 is None:
+            continue
+        p2 = _transfer_params(rule, params, g2, m)
+        assert p2 is not None, (
+            f"rule {rule['name']}: rewrite restructures weights without a "
+            "declared weight_map bijection"
+        )
+        got = run_graph(g2, feed, p2, anchors)
+        for r, o in zip(ref, got):
+            np.testing.assert_allclose(
+                o, r, rtol=rtol, atol=atol,
+                err_msg=f"rule {rule['name']} changed numerics ({label})",
+            )
+        checked += 1
+    return checked
+
+
+def verify_rule(rule: Dict, rtol: float = 2e-4, atol: float = 1e-5) -> int:
+    """Instantiate, rewrite, and numerically compare. Returns the number
+    of (match, rewrite) pairs checked (>= 1), raises on failure.
+
+    Two passes: the BENIGN pass must produce at least one verified
+    rewrite; the ADVERSARIAL pass flips every non-pinned default toward a
+    guard-breaking configuration — instances that still match the rule
+    must also preserve numerics (a rule with complete guards simply does
+    not match them)."""
+    inst = None
+    for nd in (2, 3, 4):
+        inst = instantiate_rule(rule, profile_nd=nd)
+        if inst is None:
+            continue
+        if find_matches(rule, inst[0]):
+            break
+        inst = None
+    if inst is None:
+        raise AssertionError(
+            f"rule {rule['name']}: could not instantiate a matching graph"
+        )
+    checked = _check_instance(rule, inst, rtol, atol, "benign")
+    assert checked >= 1, f"rule {rule['name']}: no applicable rewrite"
+    for nd in (2, 3, 4):
+        adv = instantiate_rule(rule, profile_nd=nd, adversarial=True)
+        if adv is None or not find_matches(rule, adv[0]):
+            continue
+        # adversarial tolerance is looser: HALF-precision casts round
+        checked += _check_instance(rule, adv, max(rtol, 2e-3), 1e-3,
+                                   "adversarial")
+    return checked

@@ -51,10 +51,21 @@ ATTRS_CLASSES: Dict[OpType, type] = {
     OpType.ELEMENT_BINARY: A.ElementBinaryAttrs,
     OpType.RESHAPE: A.ReshapeAttrs,
     OpType.TRANSPOSE: A.TransposeAttrs,
+    OpType.REVERSE: A.ReverseAttrs,
     OpType.CONCAT: A.ConcatAttrs,
     OpType.SPLIT: A.SplitAttrs,
     OpType.CAST: A.CastAttrs,
     OpType.SOFTMAX: A.SoftmaxAttrs,
+    OpType.POOL2D: A.Pool2DAttrs,
+    OpType.LAYER_NORM: A.LayerNormAttrs,
+    OpType.RMS_NORM: A.RMSNormAttrs,
+    OpType.BATCH_NORM: A.BatchNormAttrs,
+    OpType.DROPOUT: A.DropoutAttrs,
+    OpType.REDUCE_SUM: A.ReduceAttrs,
+    OpType.MEAN: A.ReduceAttrs,
+    OpType.BATCH_MATMUL: A.BatchMatmulAttrs,
+    OpType.MULTIHEAD_ATTENTION: A.MultiHeadAttentionAttrs,
+    OpType.EXPERTS: A.ExpertsAttrs,
     OpType.COMBINE: CombineAttrs,
     OpType.REDUCTION: ReductionAttrs,
     OpType.REPARTITION: RepartitionAttrs,
@@ -74,9 +85,19 @@ def _node_pred_activation(n: Node, name: str) -> bool:
 
 
 def _node_pred_attr_eq(n: Node, spec: Sequence) -> bool:
-    """[field, value] or [[f1, v1], [f2, v2], ...]."""
+    """[field, value] or [[f1, v1], [f2, v2], ...]. JSON values normalize
+    before comparison: lists match tuples, strings match enum values."""
+    import enum as _enum
+
+    def eq(attr, v):
+        if isinstance(attr, tuple) and isinstance(v, list):
+            return attr == tuple(v)
+        if isinstance(attr, _enum.Enum) and isinstance(v, str):
+            return attr.value == v or attr.name == v
+        return attr == v
+
     pairs = spec if isinstance(spec[0], (list, tuple)) else [spec]
-    return all(getattr(n.attrs, f, None) == v for f, v in pairs)
+    return all(eq(getattr(n.attrs, f, None), v) for f, v in pairs)
 
 
 def _node_pred_unary_kind(n: Node, kinds: Sequence[str]) -> bool:
@@ -173,13 +194,97 @@ def _where_cast_chain_safe(nodes: Dict[str, Node], args: Sequence) -> bool:
     return _DTYPE_WIDTH[mid] >= _DTYPE_WIDTH[src]
 
 
+def _where_perm_fixes_last(nodes: Dict[str, Node], args: Sequence) -> bool:
+    """The transpose keeps the LAST axis in place — required to commute it
+    with ops that reduce/normalize over the last dim."""
+    perm = getattr(nodes[args[0]].attrs, "perm", None)
+    return perm is not None and perm[-1] == len(perm) - 1
+
+
+def _where_concat_sizes_match(nodes: Dict[str, Node], args: Sequence) -> bool:
+    """Two concats split their axis identically (piecewise binary ops on
+    both results only align when the pieces align)."""
+    a, b = nodes[args[0]], nodes[args[1]]
+    ax_a = getattr(a.attrs, "axis", None)
+    if ax_a != getattr(b.attrs, "axis", None):
+        return False
+    if not a.in_shapes or not b.in_shapes:
+        return False
+    sa = tuple(s.dims[ax_a % s.ndim].size for s in a.in_shapes)
+    sb = tuple(s.dims[ax_a % s.ndim].size for s in b.in_shapes)
+    return sa == sb
+
+
+def _where_axes_exclude_concat_axis(nodes, args) -> bool:
+    """A reduction's axes avoid the concat axis (so it distributes)."""
+    red, cat = nodes[args[0]], nodes[args[1]]
+    if not red.in_shapes:
+        return False
+    nd = red.in_shapes[0].ndim
+    axes = {a % nd for a in red.attrs.axes}
+    return (getattr(cat.attrs, "axis", 0) % nd) not in axes
+
+
+def _where_axes_equal_concat_axis(nodes, args) -> bool:
+    """The reduction reduces EXACTLY the concat axis (sum distributes into
+    an add of partial sums)."""
+    red, cat = nodes[args[0]], nodes[args[1]]
+    if not red.in_shapes:
+        return False
+    nd = red.in_shapes[0].ndim
+    axes = {a % nd for a in red.attrs.axes}
+    return axes == {getattr(cat.attrs, "axis", 0) % nd}
+
+
+def _where_cast_widens_exact(nodes: Dict[str, Node], args: Sequence) -> bool:
+    """The cast is exact (same numeric class, at least as wide), so
+    order-sensitive ops like relu commute with it bit-for-bit."""
+    n = nodes[args[0]]
+    if not n.in_shapes:
+        return False
+    src, dst = n.in_shapes[0].dtype, n.attrs.dtype
+    ints = {DataType.BOOL, DataType.INT32, DataType.INT64}
+    if (src in ints) != (dst in ints):
+        return False
+    if {src, dst} == {DataType.HALF, DataType.BFLOAT16}:
+        return False
+    return _DTYPE_WIDTH[dst] >= _DTYPE_WIDTH[src]
+
+
+def _where_inputs_same_dtype(nodes: Dict[str, Node], args) -> bool:
+    """All listed nodes' first inputs share a dtype (guards rewrites that
+    would otherwise route mixed dtypes through type promotion)."""
+    dts = []
+    for a in args:
+        n = nodes[a]
+        if not n.in_shapes:
+            return False
+        dts.append(n.in_shapes[0].dtype)
+    return all(d == dts[0] for d in dts)
+
+
+def _where_reverse_axis_not_last(nodes: Dict[str, Node], args) -> bool:
+    n = nodes[args[0]]
+    if not n.in_shapes:
+        return False
+    nd = n.in_shapes[0].ndim
+    return (n.attrs.axis % nd) != nd - 1
+
+
 WHERE_PREDICATES: Dict[str, Callable[[Dict[str, Node], Any], bool]] = {
+    "inputs_same_dtype": _where_inputs_same_dtype,
+    "reverse_axis_not_last": _where_reverse_axis_not_last,
     "perms_inverse": _where_perms_inverse,
     "attrs_equal": _where_attrs_equal,
     "concat_undoes_split": _where_concat_undoes_split,
     "split_undoes_concat": _where_split_undoes_concat,
     "cast_identity": _where_cast_identity,
     "cast_chain_safe": _where_cast_chain_safe,
+    "perm_fixes_last": _where_perm_fixes_last,
+    "concat_sizes_match": _where_concat_sizes_match,
+    "axes_exclude_concat_axis": _where_axes_exclude_concat_axis,
+    "axes_equal_concat_axis": _where_axes_equal_concat_axis,
+    "cast_widens_exact": _where_cast_widens_exact,
 }
 
 
@@ -359,6 +464,18 @@ def _build_attrs(spec: Any, matched: Dict[str, Node], op_type: OpType):
                 return getattr(matched[nid].attrs, field)
             if "$sum" in v:
                 return sum(val(x) for x in v["$sum"])
+            if "$prod" in v:
+                out = 1
+                for x in v["$prod"]:
+                    out = out * val(x)
+                return out
+            if "$perm_compose" in v:
+                # perm of applying transpose `a` then transpose `b`:
+                # (b∘a)[i] = a[b[i]]
+                aid, bid = v["$perm_compose"]
+                pa = getattr(matched[aid].attrs, "perm")
+                pb = getattr(matched[bid].attrs, "perm")
+                return tuple(pa[pb[i]] for i in range(len(pb)))
             if "$list_attr" in v:
                 nid, field = v["$list_attr"]
                 return list(getattr(matched[nid].attrs, field))
@@ -710,6 +827,9 @@ def _rule_merge_linears(n: int, ndim: int = 2) -> Dict:
     return {
         "name": "merge_parallel_linears" + ("" if n == 2 else f"_{n}")
                 + _nd_suffix(ndim),
+        # weight bijection checked by the soundness harness: the merged
+        # kernel is the matched kernels concatenated on the out dim
+        "weight_map": {"op": "concat_kernels", "axis": -1},
         "src": {
             "nodes": [{"id": i, "type": "LINEAR", "when": dict(when)}
                       for i in ids],
@@ -1126,6 +1246,8 @@ def gen_default_rules() -> List[Dict]:
                  "attr_eq": [["use_bias", False], ["groups", 1]]}
     rules.append({
         "name": "merge_parallel_convs",
+        # merged NCHW kernel = matched kernels concatenated on out-channels
+        "weight_map": {"op": "concat_kernels", "axis": 0},
         "src": {
             "nodes": [{"id": "a", "type": "CONV2D", "when": dict(conv_when)},
                       {"id": "b", "type": "CONV2D", "when": dict(conv_when)}],
@@ -1253,6 +1375,13 @@ def gen_default_rules() -> List[Dict]:
                 },
             })
 
+    # --- round-3 extension families (distributivity, commutation, scalar
+    # algebra, bmm identities, wider parallelization, conv identities) ----
+    from flexflow_tpu.search.rules_gen2 import extra_rules
+
+    rules += extra_rules()
+    names = [r["name"] for r in rules]
+    assert len(names) == len(set(names)), "duplicate rule names in corpus"
     return rules
 
 
