@@ -75,7 +75,7 @@ def _pairs(spec):
     return spec if isinstance(spec[0], (list, tuple)) else [spec]
 
 
-def _default_attrs(op: OpType, in_shapes: List[Shape], ov: Dict,
+def _default_attrs(op: OpType, in_shapes: List, ov: Dict,
                    n_outputs: int, rule_name: str,
                    adversarial: bool = False):
     """Concrete attrs for a pattern node given its input shapes and the

@@ -24,6 +24,7 @@ hand-coded GraphXfer, so unity_search consumes both transparently.
 from __future__ import annotations
 
 import dataclasses
+import enum as _enum
 import json
 import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -87,8 +88,6 @@ def _node_pred_activation(n: Node, name: str) -> bool:
 def _node_pred_attr_eq(n: Node, spec: Sequence) -> bool:
     """[field, value] or [[f1, v1], [f2, v2], ...]. JSON values normalize
     before comparison: lists match tuples, strings match enum values."""
-    import enum as _enum
-
     def eq(attr, v):
         if isinstance(attr, tuple) and isinstance(v, list):
             return attr == tuple(v)
