@@ -61,19 +61,23 @@ def _cost_model(mesh, config) -> CostModel:
     return CostModel(machine, axis_sizes, **kw)
 
 
-def _maybe_measure(cost, graph, config) -> None:
+def _maybe_measure(cost, graph, config, mesh=None) -> None:
     """When measure_costs is on, run the on-device microbenchmarks for the
-    graph's ops and calibrate the analytic knobs BEFORE searching (the
-    reference measures inside the cost query, simulator.cc:537; here the
-    sweep is up-front so the search loop stays cheap)."""
+    graph's ops AND the mesh's collectives, then calibrate the analytic
+    knobs BEFORE searching (the reference measures inside the cost query,
+    simulator.cc:537; here the sweep is up-front so the search loop stays
+    cheap)."""
     from flexflow_tpu.search.measured import MeasuredCostModel
 
     if isinstance(cost, MeasuredCostModel):
         cost.measure_graph(graph, {}, training=True)
-        cost.calibrate(graph, {})
+        knobs = cost.calibrate(graph, {}, mesh=mesh)
         if config.profiling:
             print(f"[search] measured {len(cost._measured)} op shards; "
-                  f"mxu_eff={cost.machine.mxu_efficiency:.3f}")
+                  f"mxu_eff={cost.machine.mxu_efficiency:.3f}; "
+                  f"ici samples={knobs.get('ici_samples', 0)} "
+                  f"eff={cost.machine.ici_efficiency:.3f} "
+                  f"lat={cost.machine.ici_latency:.2e}")
 
 
 def space_dp_strategy(graph, axis_sizes):
@@ -107,7 +111,7 @@ def search_strategy(graph, mesh, config,
     from flexflow_tpu.search.mcmc import mcmc_search
 
     cost = _cost_model(mesh, config)
-    _maybe_measure(cost, graph, config)
+    _maybe_measure(cost, graph, config, mesh=mesh)
     strategy = mcmc_search(graph, mesh, config, cost=cost)
     # no playoff pool under memory_search: the DP baseline (full weight
     # replication) may exceed the memory limit the search honored, and the
@@ -137,7 +141,7 @@ def graph_optimize(graph: Graph, mesh, config,
     )
 
     cost = _cost_model(mesh, config)
-    _maybe_measure(cost, graph, config)
+    _maybe_measure(cost, graph, config, mesh=mesh)
     if getattr(config, "use_simulator", False):
         import warnings
 

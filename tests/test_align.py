@@ -319,7 +319,6 @@ def test_align_moe_aggregate_gate_grads():
     # slot — reproduce the k-major slot assignment
     tv = torch.from_numpy(topv).requires_grad_(True)
     te = [torch.from_numpy(e).requires_grad_(True) for e in experts]
-    pos = {}
     counts = [0] * n
     slot_of = {}
     for kk in range(k):
