@@ -102,6 +102,43 @@ def _collect_playoff_pair(candidates_out, cost, *, winner,
     candidates_out.extend(sorted(pool, key=lambda t: t[0]))
 
 
+def _simulate_rerank(candidates_out, cost, config):
+    """Re-rank a playoff pool by the event simulator's overlap-aware list
+    scheduler (reference simulate_runtime, simulator.cc:822). Shared by
+    the Unity and MCMC entry points. Returns the new head
+    (sim_cost, graph, strategy) when every candidate simulated, else None
+    (native engine unavailable -> pool left untouched)."""
+    import warnings
+
+    if candidates_out is None:
+        warnings.warn(
+            "use_simulator: no playoff pool to re-rank (validate_top_k < 2 "
+            "or multi-host) — the search result is the serial-sum ranking"
+        )
+        return None
+    from flexflow_tpu import native
+    from flexflow_tpu.search.table import simulated_strategy_cost
+
+    if not native.available():
+        warnings.warn(
+            "use_simulator requires the native engine (libffsim); the "
+            "playoff pool keeps its serial-sum ranking"
+        )
+        return None
+    reranked = []
+    for (c, g, s) in candidates_out:
+        sim = simulated_strategy_cost(g, cost, s)
+        if sim is None:
+            return None
+        reranked.append((sim, g, s))
+    reranked.sort(key=lambda t: t[0])
+    candidates_out[:] = reranked
+    if config.profiling:
+        print("[search] playoff pool re-ranked by event simulator: "
+              + ", ".join(f"{c * 1e3:.3f}" for c, _, _ in reranked))
+    return reranked[0]
+
+
 def search_strategy(graph, mesh, config,
                     candidates_out=None) -> Dict[str, ShardingView]:
     """Views-only search on a fixed graph (MCMC). `candidates_out`: when a
@@ -124,6 +161,12 @@ def search_strategy(graph, mesh, config,
             winner=strategy, baseline=base,
             winner_graph=graph, baseline_graph=graph,
         )
+        if getattr(config, "use_simulator", False):
+            # the anneal optimized the simulated objective; rank the
+            # playoff pool on the same scale
+            head = _simulate_rerank(candidates_out, cost, config)
+            if head is not None:
+                strategy = head[2]
     return strategy
 
 
@@ -142,14 +185,6 @@ def graph_optimize(graph: Graph, mesh, config,
 
     cost = _cost_model(mesh, config)
     _maybe_measure(cost, graph, config, mesh=mesh)
-    if getattr(config, "use_simulator", False):
-        import warnings
-
-        warnings.warn(
-            "use_simulator only applies to the MCMC path (search_budget "
-            "<= 5); the Unity substitution search costs strategies with "
-            "the summed tables"
-        )
     if config.memory_search:
         # memory-aware path: λ binary search blending run time and per-chip
         # memory (graph.cc:2046-2131 analog)
@@ -191,6 +226,15 @@ def graph_optimize(graph: Graph, mesh, config,
             winner=strategy, baseline=ViewDP(cost).optimize(graph),
             winner_graph=best_graph, baseline_graph=graph,
         )
+    if getattr(config, "use_simulator", False):
+        # re-rank the playoff pool with the event simulator's overlap-
+        # aware list scheduler: a candidate whose grad allreduces hide
+        # behind later compute can beat one the serial sum prefers. The
+        # simulator's pick becomes the modeled winner (the timed playoff,
+        # when enabled, still gets the final word on hardware).
+        head = _simulate_rerank(candidates_out, cost, config)
+        if head is not None:
+            best_time, best_graph, strategy = head
     if config.profiling:
         print(f"[search] best estimated step time {best_time * 1e3:.3f} ms")
     return best_graph, strategy

@@ -67,6 +67,23 @@ class StrategyTable:
         return g
 
 
+def simulated_strategy_cost(graph: Graph, cost: CostModel,
+                            strategy: Dict[str, ShardingView],
+                            training: bool = True) -> Optional[float]:
+    """Overlap-aware step time of ONE fixed strategy through the native
+    event simulator's two-channel list scheduler (ffsim_simulate —
+    the reference's simulate_runtime, simulator.cc:822): grad allreduces
+    ride the ICI channel asynchronously and can hide behind later compute,
+    which the serial table sum cannot express. Returns None when the
+    native engine is unavailable."""
+    from flexflow_tpu import native
+
+    if not native.available():
+        return None
+    table = build_table(graph, cost, {}, strategy, training)
+    return table.to_native().simulate([0] * len(table.nodes))
+
+
 def build_table(
     graph: Graph,
     cost: CostModel,
