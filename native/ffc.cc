@@ -92,9 +92,12 @@ PyObject *enum_member(const char *enum_name, const char *member) {
   return m;
 }
 
-// numpy array from a host buffer (copies; caller keeps ownership)
+// numpy array from a host buffer (copies; caller keeps ownership).
+// force_2d keeps the (rows, row_elems) shape even when row_elems == 1 —
+// token/prompt buffers must stay 2-D for fit/generate.
 PyObject *np_from_buffer(const void *data, int64_t n_elems,
-                         const char *dtype, int64_t rows, int64_t row_elems) {
+                         const char *dtype, int64_t rows, int64_t row_elems,
+                         bool force_2d = false) {
   PyObject *np = np_module();
   if (!np) return nullptr;
   PyObject *mem = PyMemoryView_FromMemory(
@@ -105,7 +108,7 @@ PyObject *np_from_buffer(const void *data, int64_t n_elems,
   Py_DECREF(mem);
   if (!arr) { set_error_from_python(); return nullptr; }
   PyObject *shaped;
-  if (row_elems > 1) {
+  if (row_elems > 1 || force_2d) {
     shaped = PyObject_CallMethod(arr, "reshape", "(LL)", (long long)rows,
                                  (long long)row_elems);
   } else {
@@ -356,25 +359,97 @@ void ffc_tensor_destroy(ffc_tensor_t t) {
   Py_XDECREF(reinterpret_cast<PyObject *>(t));
 }
 
-int ffc_model_compile(ffc_model_t handle, ffc_loss_t loss, float lr) {
+ffc_tensor_t ffc_model_embedding_aggr(ffc_model_t handle, ffc_tensor_t input,
+                                      int num_entries, int out_dim,
+                                      ffc_aggr_t aggr, ffc_dtype_t dtype) {
   g_error.clear();
   auto *st = reinterpret_cast<ModelState *>(handle);
-  PyObject *mod = ff_module();
-  PyObject *opt_cls = PyObject_GetAttrString(mod, "SGDOptimizer");
-  if (!opt_cls) { set_error_from_python(); return -1; }
-  PyObject *okw = Py_BuildValue("{s:f}", "lr", lr);
-  PyObject *oargs = PyTuple_New(0);
-  PyObject *opt = PyObject_Call(opt_cls, oargs, okw);
-  Py_DECREF(opt_cls);
-  Py_DECREF(oargs);
-  Py_DECREF(okw);
-  if (!opt) { set_error_from_python(); return -1; }
+  const char *an = aggr == FFC_AGGR_SUM ? "SUM"
+                   : aggr == FFC_AGGR_AVG ? "AVG" : "NONE";
+  const char *dn = dtype == FFC_DT_INT32 ? "INT32"
+                   : dtype == FFC_DT_BFLOAT16 ? "BFLOAT16" : "FLOAT";
+  PyObject *aggr_obj = enum_member("AggrMode", an);
+  PyObject *dt_obj = enum_member("DataType", dn);
+  if (!aggr_obj || !dt_obj) {
+    Py_XDECREF(aggr_obj);
+    Py_XDECREF(dt_obj);
+    return nullptr;
+  }
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue(
+      "{s:i,s:i,s:O,s:O}", "num_entries", num_entries, "out_dim", out_dim,
+      "aggr", aggr_obj, "dtype", dt_obj);
+  PyObject *t = call_method(st->model, "embedding", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(aggr_obj);
+  Py_DECREF(dt_obj);
+  return t;
+}
+
+ffc_tensor_t ffc_model_multihead_attention(ffc_model_t handle, ffc_tensor_t q,
+                                           ffc_tensor_t k, ffc_tensor_t v,
+                                           int embed_dim, int num_heads,
+                                           int kv_heads, int causal, int rope,
+                                           float rope_theta) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(3, reinterpret_cast<PyObject *>(q),
+                                reinterpret_cast<PyObject *>(k),
+                                reinterpret_cast<PyObject *>(v));
+  PyObject *kwargs = Py_BuildValue(
+      "{s:i,s:i,s:O,s:O,s:O,s:f}", "embed_dim", embed_dim, "num_heads",
+      num_heads, "causal", causal ? Py_True : Py_False, "rope",
+      rope ? Py_True : Py_False, "bias", Py_False, "rope_theta", rope_theta);
+  if (kv_heads > 0) {
+    PyObject *kv = PyLong_FromLong(kv_heads);
+    PyDict_SetItemString(kwargs, "kv_heads", kv);
+    Py_DECREF(kv);
+  }
+  PyObject *t = call_method(st->model, "multihead_attention", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return t;
+}
+
+ffc_tensor_t ffc_model_rms_norm(ffc_model_t handle, ffc_tensor_t input,
+                                float eps) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue("{s:f}", "eps", eps);
+  PyObject *t = call_method(st->model, "rms_norm", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return t;
+}
+
+ffc_tensor_t ffc_model_layer_norm(ffc_model_t handle, ffc_tensor_t input,
+                                  float eps) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue("{s:f}", "eps", eps);
+  PyObject *t = call_method(st->model, "layer_norm", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return t;
+}
+
+// shared compile tail: consumes a NEW reference to `opt`
+static int compile_with_optimizer(ModelState *st, PyObject *opt,
+                                  ffc_loss_t loss) {
   const char *ln = loss == FFC_LOSS_CCE ? "CATEGORICAL_CROSSENTROPY"
                    : loss == FFC_LOSS_MSE ? "MEAN_SQUARED_ERROR_AVG_REDUCE"
                    : "SPARSE_CATEGORICAL_CROSSENTROPY";
   PyObject *loss_obj = enum_member("LossType", ln);
   PyObject *acc = enum_member("MetricsType", "ACCURACY");
-  if (!loss_obj || !acc) { Py_DECREF(opt); return -1; }
+  if (!loss_obj || !acc) {
+    Py_XDECREF(loss_obj);
+    Py_XDECREF(acc);
+    Py_DECREF(opt);
+    return -1;
+  }
   PyObject *metrics = PyList_New(1);
   Py_INCREF(acc);
   PyList_SetItem(metrics, 0, acc);
@@ -393,6 +468,42 @@ int ffc_model_compile(ffc_model_t handle, ffc_loss_t loss, float lr) {
   return 0;
 }
 
+int ffc_model_compile(ffc_model_t handle, ffc_loss_t loss, float lr) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *mod = ff_module();
+  PyObject *opt_cls = PyObject_GetAttrString(mod, "SGDOptimizer");
+  if (!opt_cls) { set_error_from_python(); return -1; }
+  PyObject *okw = Py_BuildValue("{s:f}", "lr", lr);
+  PyObject *oargs = PyTuple_New(0);
+  PyObject *opt = PyObject_Call(opt_cls, oargs, okw);
+  Py_DECREF(opt_cls);
+  Py_DECREF(oargs);
+  Py_DECREF(okw);
+  if (!opt) { set_error_from_python(); return -1; }
+  return compile_with_optimizer(st, opt, loss);
+}
+
+
+int ffc_model_compile_adam(ffc_model_t handle, ffc_loss_t loss, float lr,
+                           float beta1, float beta2, float epsilon,
+                           float weight_decay) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *mod = ff_module();
+  PyObject *opt_cls = PyObject_GetAttrString(mod, "AdamOptimizer");
+  if (!opt_cls) { set_error_from_python(); return -1; }
+  PyObject *okw = Py_BuildValue("{s:f,s:f,s:f,s:f,s:f}", "lr", lr, "beta1",
+                                beta1, "beta2", beta2, "epsilon", epsilon,
+                                "weight_decay", weight_decay);
+  PyObject *oargs = PyTuple_New(0);
+  PyObject *opt = PyObject_Call(opt_cls, oargs, okw);
+  Py_DECREF(opt_cls);
+  Py_DECREF(oargs);
+  Py_DECREF(okw);
+  if (!opt) { set_error_from_python(); return -1; }
+  return compile_with_optimizer(st, opt, loss);
+}
 
 // reshape a flat (n, row_elems) buffer to the model's first input tensor
 // dims (n, d1, d2, ...) when the input is >2-D; consumes `xa` on failure
@@ -572,4 +683,119 @@ double ffc_model_eval(ffc_model_t handle, const float *x, const int32_t *y,
   return res;
 }
 
-}  // extern "C" (checkpoint/strategy/eval additions)
+int64_t ffc_model_fit_tokens(ffc_model_t handle, const int32_t *x,
+                             const int32_t *y, int64_t n, int64_t seq,
+                             int epochs) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *xa = np_from_buffer(x, n * seq, "int32", n, seq, true);
+  if (!xa) return -1;
+  PyObject *ya = np_from_buffer(y, n * seq, "int32", n, seq, true);
+  if (!ya) { Py_DECREF(xa); return -1; }
+  PyObject *args = PyTuple_Pack(2, xa, ya);
+  PyObject *kwargs = Py_BuildValue("{s:i,s:O}", "epochs", epochs, "verbose",
+                                   Py_False);
+  PyObject *metrics = call_method(st->model, "fit", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(xa);
+  Py_DECREF(ya);
+  if (!metrics) return -1;
+  Py_XDECREF(st->last_metrics);
+  st->last_metrics = metrics;
+  PyObject *ta = PyObject_GetAttrString(metrics, "train_all");
+  int64_t out = ta ? PyLong_AsLongLong(ta) : -1;
+  Py_XDECREF(ta);
+  return out;
+}
+
+int64_t ffc_model_fit_dataloader(ffc_model_t handle, const float *x,
+                                 const int32_t *y, int64_t n,
+                                 int64_t x_row_elems, int epochs,
+                                 int shuffle) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *xa = np_from_buffer(x, n * x_row_elems, "float32", n, x_row_elems);
+  if (!xa) return -1;
+  xa = reshape_to_input_dims(st, xa, n);
+  if (!xa) return -1;
+  PyObject *ya = np_from_buffer(y, n, "int32", n, 1);
+  if (!ya) { Py_DECREF(xa); return -1; }
+  PyObject *sh = shuffle ? Py_True : Py_False;
+  PyObject *dlx_args = PyTuple_Pack(2, Py_None, xa);
+  PyObject *dlx_kw = Py_BuildValue("{s:O}", "shuffle", sh);
+  PyObject *dlx = call_method(st->model, "create_data_loader", dlx_args,
+                              dlx_kw);
+  Py_DECREF(dlx_args);
+  Py_DECREF(dlx_kw);
+  Py_DECREF(xa);
+  if (!dlx) { Py_DECREF(ya); return -1; }
+  // the label loader must shuffle in LOCKSTEP with the input loader:
+  // same seed + shuffle flag (SingleDataLoader is seed-deterministic)
+  PyObject *dly_args = PyTuple_Pack(2, Py_None, ya);
+  PyObject *dly_kw = Py_BuildValue("{s:O}", "shuffle", sh);
+  PyObject *dly = call_method(st->model, "create_data_loader", dly_args,
+                              dly_kw);
+  Py_DECREF(dly_args);
+  Py_DECREF(dly_kw);
+  Py_DECREF(ya);
+  if (!dly) { Py_DECREF(dlx); return -1; }
+  PyObject *loaders = PyList_New(2);
+  PyList_SetItem(loaders, 0, dlx);  // steals refs
+  PyList_SetItem(loaders, 1, dly);
+  PyObject *args = PyTuple_New(0);
+  PyObject *kwargs = Py_BuildValue("{s:O,s:i,s:O}", "dataloaders", loaders,
+                                   "epochs", epochs, "verbose", Py_False);
+  PyObject *metrics = call_method(st->model, "fit", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(loaders);
+  if (!metrics) return -1;
+  Py_XDECREF(st->last_metrics);
+  st->last_metrics = metrics;
+  PyObject *ta = PyObject_GetAttrString(metrics, "train_all");
+  int64_t out = ta ? PyLong_AsLongLong(ta) : -1;
+  Py_XDECREF(ta);
+  return out;
+}
+
+int ffc_model_generate(ffc_model_t handle, const int32_t *prompt,
+                       int64_t batch, int64_t prompt_len,
+                       int max_new_tokens, int32_t *out) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *pa = np_from_buffer(prompt, batch * prompt_len, "int32", batch,
+                                prompt_len, true);
+  if (!pa) return -1;
+  PyObject *args = PyTuple_Pack(1, pa);
+  PyObject *kwargs = Py_BuildValue("{s:i}", "max_new_tokens", max_new_tokens);
+  PyObject *toks = call_method(st->model, "generate", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(pa);
+  if (!toks) return -1;
+  PyObject *np = np_module();
+  PyObject *flat = PyObject_CallMethod(
+      np, "ascontiguousarray", "Os", toks, "int32");
+  Py_DECREF(toks);
+  if (!flat) { set_error_from_python(); return -1; }
+  Py_buffer view;
+  if (PyObject_GetBuffer(flat, &view, PyBUF_SIMPLE) != 0) {
+    set_error_from_python();
+    Py_DECREF(flat);
+    return -1;
+  }
+  int64_t want = batch * max_new_tokens * (int64_t)sizeof(int32_t);
+  if ((int64_t)view.len != want) {
+    g_error = "generate returned an unexpected token-buffer size";
+    PyBuffer_Release(&view);
+    Py_DECREF(flat);
+    return -1;
+  }
+  memcpy(out, view.buf, (size_t)want);
+  PyBuffer_Release(&view);
+  Py_DECREF(flat);
+  return 0;
+}
+
+}  // extern "C" (checkpoint/strategy/eval/transformer additions)

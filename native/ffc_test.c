@@ -50,6 +50,11 @@ int main(void) {
     fprintf(stderr, "fit: %s\n", ffc_last_error());
     return 1;
   }
+  /* one extra epoch through the prefetching dataloaders (shuffled) */
+  if (ffc_model_fit_dataloader(model, xd, yd, n, 16, 1, 1) < 0) {
+    fprintf(stderr, "fit_dataloader: %s\n", ffc_last_error());
+    return 1;
+  }
   double acc = ffc_model_last_accuracy(model);
   printf("trained=%lld acc=%.3f\n", (long long)trained, acc);
   if (acc < 0.9) {
@@ -118,5 +123,69 @@ int main(void) {
   ffc_tensor_destroy(sm);
   ffc_model_destroy(model);
   ffc_config_destroy(cfg);
+
+  /* ---- transformer path: tiny decoder trained with Adam from C, then
+   * 4 tokens generated through the KV-cache decode (the surface the
+   * reference's flexflow_c.cc never had) ---- */
+  {
+    enum { B = 4, S = 16, V = 64, E = 32, NTOK = 4 };
+    ffc_config_t tcfg = ffc_config_create(B, 0);
+    ffc_model_t tm = ffc_model_create(tcfg);
+    int64_t tdims[2] = {B, S};
+    ffc_tensor_t ids = ffc_model_create_tensor(tm, 2, tdims, FFC_DT_INT32);
+    ffc_tensor_t emb = ffc_model_embedding_aggr(tm, ids, V, E, FFC_AGGR_NONE,
+                                                FFC_DT_BFLOAT16);
+    ffc_tensor_t nrm = ffc_model_rms_norm(tm, emb, 1e-5f);
+    ffc_tensor_t att = ffc_model_multihead_attention(tm, nrm, nrm, nrm, E, 4,
+                                                     2, 1, 1, 10000.0f);
+    ffc_tensor_t res = ffc_model_add(tm, emb, att);
+    ffc_tensor_t nrm2 = ffc_model_rms_norm(tm, res, 1e-5f);
+    ffc_tensor_t ffn = ffc_model_dense(tm, nrm2, 64, FFC_AC_GELU, 0);
+    ffc_tensor_t down = ffc_model_dense(tm, ffn, E, FFC_AC_NONE, 0);
+    ffc_tensor_t res2 = ffc_model_add(tm, res, down);
+    ffc_tensor_t head = ffc_model_dense(tm, res2, V, FFC_AC_NONE, 0);
+    ffc_tensor_t psm = ffc_model_softmax(tm, head);
+    if (!psm) { fprintf(stderr, "tlayers: %s\n", ffc_last_error()); return 1; }
+    if (ffc_model_compile_adam(tm, FFC_LOSS_SPARSE_CCE, 1e-3f, 0.9f, 0.999f,
+                               1e-8f, 0.0f) != 0) {
+      fprintf(stderr, "compile_adam: %s\n", ffc_last_error());
+      return 1;
+    }
+    int64_t tn = 32;
+    int32_t *tx = malloc(tn * S * sizeof(int32_t));
+    int32_t *ty = malloc(tn * S * sizeof(int32_t));
+    for (int64_t i = 0; i < tn * S; i++) {
+      tx[i] = rand() % (V - 1);
+      ty[i] = (tx[i] + 1) % V; /* learnable next-token rule */
+    }
+    if (ffc_model_fit_tokens(tm, tx, ty, tn, S, 2) < 0) {
+      fprintf(stderr, "fit_tokens: %s\n", ffc_last_error());
+      return 1;
+    }
+    int32_t prompt[2 * 4] = {3, 5, 7, 9, 11, 13, 15, 17};
+    int32_t toks[2 * NTOK];
+    if (ffc_model_generate(tm, prompt, 2, 4, NTOK, toks) != 0) {
+      fprintf(stderr, "generate: %s\n", ffc_last_error());
+      return 1;
+    }
+    for (int i = 0; i < 2 * NTOK; i++) {
+      if (toks[i] < 0 || toks[i] >= V) {
+        fprintf(stderr, "generated token out of range: %d\n", toks[i]);
+        return 1;
+      }
+    }
+    printf("generated: %d %d %d %d\n", toks[0], toks[1], toks[2], toks[3]);
+    free(tx);
+    free(ty);
+    ffc_tensor_destroy(ids); ffc_tensor_destroy(emb);
+    ffc_tensor_destroy(nrm); ffc_tensor_destroy(att);
+    ffc_tensor_destroy(res); ffc_tensor_destroy(nrm2);
+    ffc_tensor_destroy(ffn); ffc_tensor_destroy(down);
+    ffc_tensor_destroy(res2); ffc_tensor_destroy(head);
+    ffc_tensor_destroy(psm);
+    ffc_model_destroy(tm);
+    ffc_config_destroy(tcfg);
+    printf("C_API_TRANSFORMER_OK\n");
+  }
   return 0;
 }

@@ -52,6 +52,12 @@ typedef enum {
   FFC_LOSS_MSE = 2,
 } ffc_loss_t;
 
+typedef enum {
+  FFC_AGGR_NONE = 0,
+  FFC_AGGR_SUM = 1,
+  FFC_AGGR_AVG = 2,
+} ffc_aggr_t;
+
 /* interpreter + framework bootstrap; argv carries reference-style flags
  * ("-b", "--devices", "--budget", ...). Returns 0 on success. */
 int ffc_init(int argc, char **argv);
@@ -80,6 +86,22 @@ ffc_tensor_t ffc_model_pool2d(ffc_model_t model, ffc_tensor_t input,
                               int is_max);
 ffc_tensor_t ffc_model_embedding(ffc_model_t model, ffc_tensor_t input,
                                  int num_entries, int out_dim);
+/* embedding with an aggregation mode + output dtype (reference
+ * flexflow_c.cc embedding's AggrMode argument) */
+ffc_tensor_t ffc_model_embedding_aggr(ffc_model_t model, ffc_tensor_t input,
+                                      int num_entries, int out_dim,
+                                      ffc_aggr_t aggr, ffc_dtype_t dtype);
+/* GQA multi-head self/cross attention with optional causal mask + RoPE
+ * (reference flexflow_c.cc multihead_attention; kv_heads=0 means MHA) */
+ffc_tensor_t ffc_model_multihead_attention(ffc_model_t model, ffc_tensor_t q,
+                                           ffc_tensor_t k, ffc_tensor_t v,
+                                           int embed_dim, int num_heads,
+                                           int kv_heads, int causal, int rope,
+                                           float rope_theta);
+ffc_tensor_t ffc_model_rms_norm(ffc_model_t model, ffc_tensor_t input,
+                                float eps);
+ffc_tensor_t ffc_model_layer_norm(ffc_model_t model, ffc_tensor_t input,
+                                  float eps);
 ffc_tensor_t ffc_model_relu(ffc_model_t model, ffc_tensor_t input);
 ffc_tensor_t ffc_model_softmax(ffc_model_t model, ffc_tensor_t input);
 ffc_tensor_t ffc_model_flat(ffc_model_t model, ffc_tensor_t input);
@@ -90,6 +112,12 @@ void ffc_tensor_destroy(ffc_tensor_t t);
 
 /* compile with SGD(lr); returns 0 on success */
 int ffc_model_compile(ffc_model_t model, ffc_loss_t loss, float lr);
+
+/* compile with a configured Adam(W) (reference flexflow_c.cc
+ * ffc_adam_optimizer_create); returns 0 on success */
+int ffc_model_compile_adam(ffc_model_t model, ffc_loss_t loss, float lr,
+                           float beta1, float beta2, float epsilon,
+                           float weight_decay);
 
 /* x: float32 [n, feature...] flattened; y: int32 [n]; returns samples
  * trained, or -1 on error */
@@ -116,6 +144,27 @@ int ffc_model_export_strategy(ffc_model_t model, const char *path);
  * when n < batch_size (ffc_last_error explains) */
 double ffc_model_eval(ffc_model_t model, const float *x, const int32_t *y,
                       int64_t n, int64_t x_row_elems);
+
+/* LM training: x,y int32 [n, seq] token ids (per-token labels). Returns
+ * samples trained or -1 (the int-input analog of ffc_model_fit) */
+int64_t ffc_model_fit_tokens(ffc_model_t model, const int32_t *x,
+                             const int32_t *y, int64_t n, int64_t seq,
+                             int epochs);
+
+/* fit() through the framework's prefetching dataloaders (reference
+ * SingleDataLoader, python/flexflow_dataloader.cc) with optional
+ * shuffling; returns samples trained or -1 */
+int64_t ffc_model_fit_dataloader(ffc_model_t model, const float *x,
+                                 const int32_t *y, int64_t n,
+                                 int64_t x_row_elems, int epochs,
+                                 int shuffle);
+
+/* KV-cache autoregressive generation (net-new vs the reference):
+ * prompt int32 [batch, prompt_len] -> writes batch*max_new_tokens ids
+ * into `out` (row-major); returns 0/-1 */
+int ffc_model_generate(ffc_model_t model, const int32_t *prompt,
+                       int64_t batch, int64_t prompt_len,
+                       int max_new_tokens, int32_t *out);
 
 
 #ifdef __cplusplus

@@ -35,6 +35,9 @@ def test_c_api_trains_mlp(tmp_path):
     env["FFC_PLATFORM"] = "cpu"
     env["FFC_CPU_DEVICES"] = "8"
     r = subprocess.run([exe], capture_output=True, text=True, env=env,
-                       timeout=420)
+                       timeout=600)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "C_API_OK" in r.stdout, r.stdout
+    # the widened surface: Adam compile, attention/norm layers,
+    # fit_tokens, and KV-cache generation all drove from C
+    assert "C_API_TRANSFORMER_OK" in r.stdout, r.stdout
