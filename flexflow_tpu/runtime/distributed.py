@@ -138,6 +138,44 @@ def broadcast_graph(graph, strategy: Optional[Dict]):
     return graph_from_dict(d["graph"]), _strategy_from_jsonable(d["strategy"])
 
 
+def broadcast_candidates(candidates):
+    """Ship process 0's playoff candidate pool [(modeled_cost, graph,
+    strategy), ...] to every host so the timed playoff can run in LOCKSTEP
+    across processes (every host compiles and times the identical
+    candidate sequence — the per-candidate SPMD programs span all hosts).
+    Non-zero processes pass anything (ignored)."""
+    if not is_multi_host():
+        return candidates
+
+    from flexflow_tpu.pcg.serialize import graph_from_dict, graph_to_dict
+
+    payload = b""
+    if process_index() == 0:
+        payload = json.dumps([
+            {"cost": c, "graph": graph_to_dict(g),
+             "strategy": _strategy_to_jsonable(s)}
+            for (c, g, s) in candidates
+        ]).encode()
+    got = _broadcast_payload(payload)
+    if got is None:
+        return []
+    out = []
+    for d in json.loads(got.decode()):
+        out.append((d["cost"], graph_from_dict(d["graph"]),
+                    _strategy_from_jsonable(d["strategy"])))
+    return out
+
+
+def broadcast_winner_index(index: int) -> int:
+    """All hosts adopt process 0's playoff winner (rankings may differ by
+    per-host timer noise; the choice must not)."""
+    if not is_multi_host():
+        return index
+    from jax.experimental import multihost_utils
+
+    return int(multihost_utils.broadcast_one_to_all(np.int32(index)))
+
+
 def host_local_batch(global_batch_arrays, mesh, shardings):
     """Assemble logical global arrays from per-host shards.
 

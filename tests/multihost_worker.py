@@ -79,6 +79,32 @@ def main():
         names = ",".join(sorted(n.name for n in ff.graph.nodes))
         print(f"proc {pid}: unity OK correct={m.train_correct} "
               f"graph=[{names}]")
+    elif model == "playoff":
+        # multi-host TIMED PLAYOFF (VERDICT r2 weakness 7): process 0's
+        # candidate pool broadcasts to every host, all hosts time the
+        # identical candidate sequence in lockstep, and process 0's
+        # ranking picks one winner everywhere
+        cfg = FFConfig(batch_size=16, mesh_shape={"data": 4, "model": 2},
+                       search_budget=8, validate_top_k=2, seed=11)
+        ff = FFModel(cfg)
+        x = ff.create_tensor((16, 256), name="x")
+        t = ff.dense(x, 256, use_bias=False, name="d0")
+        t = ff.relu(t, name="r0")
+        t = ff.dense(t, 8, name="d1")
+        ff.softmax(t, name="sm")
+        ff.compile(optimizer=AdamOptimizer(lr=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[MetricsType.ACCURACY])
+        assert ff.strategy_validation is not None, "playoff did not run"
+        picked = ff.strategy_validation["picked_modeled_rank"]
+        rs = np.random.RandomState(5)
+        xs = rs.randn(64, 256).astype(np.float32)
+        ys = rs.randint(0, 8, 64).astype(np.int32)
+        m = ff.fit(xs, ys, epochs=1, verbose=False)
+        assert m.train_all == 64
+        names = ",".join(sorted(n.name for n in ff.graph.nodes))
+        print(f"proc {pid}: playoff OK picked={picked} "
+              f"correct={m.train_correct} graph=[{names}]")
     else:  # llama
         from flexflow_tpu.models.llama import (
             LlamaConfig, build_llama, llama_tp_strategy,

@@ -77,3 +77,16 @@ def test_multihost_unity_search_graph_broadcast():
     g1 = [l for l in outs[1].splitlines() if "graph=[" in l][0]
     assert g0.split("graph=")[1] == g1.split("graph=")[1]
     assert g0.split("correct=")[1] == g1.split("correct=")[1]
+
+
+def test_multihost_timed_playoff_agrees():
+    """The timed playoff runs ON multi-host (r2 skipped it with a
+    warning): the candidate pool broadcasts, every host times the same
+    sequence, and process 0's pick is adopted by all."""
+    outs = _run_workers("playoff")
+    for i, out in enumerate(outs):
+        assert f"proc {i}: playoff OK" in out, out
+    l0 = [l for l in outs[0].splitlines() if "picked=" in l][0]
+    l1 = [l for l in outs[1].splitlines() if "picked=" in l][0]
+    # same winner, same graph, identical subsequent training
+    assert l0.split("picked=")[1] == l1.split("picked=")[1]
