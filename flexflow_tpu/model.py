@@ -82,6 +82,11 @@ class FFModel:
         # strategy validation: {"timed_ms", "modeled_ms",
         # "picked_modeled_rank"}
         self.strategy_validation: Optional[Dict] = None
+        # set by compile() when the strategy search ran: the modeled
+        # candidate pool [(cost, graph, strategy)] and search-cost stats
+        # {"wall_s", "expansions", "baseline_cost", ...}
+        self.searched_candidates: List = []
+        self.search_stats: Dict = {}
         self._step_count = 0
         self._fit_calls = 0
         self.current_metrics: Optional[PerfMetrics] = None
@@ -568,6 +573,7 @@ class FFModel:
                     k: view_from_json(v) for k, v in _json.load(f).items()
                 }
         search_candidates: List = []
+        self.search_stats = {}
         if strategy is None and not cfg.only_data_parallel and cfg.search_budget > 0:
             from flexflow_tpu.runtime import distributed as dist
 
@@ -585,15 +591,20 @@ class FFModel:
                 if not dist.is_multi_host():
                     self.graph, strategy = graph_optimize(
                         self.graph, self._mesh, cfg, candidates_out=collect,
+                        stats_out=self.search_stats,
                     )
                 else:
                     if dist.process_index() == 0:
                         self.graph, strategy = graph_optimize(
                             self.graph, self._mesh, cfg,
                             candidates_out=collect,
+                            stats_out=self.search_stats,
                         )
                     self.graph, strategy = dist.broadcast_graph(
                         self.graph, strategy
+                    )
+                    self.search_stats = dist.broadcast_stats(
+                        self.search_stats
                     )
                     if collect is not None:
                         search_candidates[:] = dist.broadcast_candidates(
@@ -615,10 +626,25 @@ class FFModel:
                             search_candidates
                         )
 
+        # the full modeled pool (top-k + best-per-structural-class + the
+        # unrewritten baseline) stays inspectable after compile
+        self.searched_candidates = list(search_candidates)
         validated_executor = None
         if len(search_candidates) > 1:
+            from flexflow_tpu.search.substitution import structural_class
+
+            # timed playoff pool: top validate_top_k by modeled cost PLUS
+            # every retained structural candidate past the cutoff — a
+            # structural rewrite's small modeled margin must not exclude it
+            # from the empirical playoff (r03 MULTICHIP failure mode)
+            picked = list(search_candidates[: cfg.validate_top_k])
+            have = {id(g) for _, g, _ in picked}
+            for cand in search_candidates[cfg.validate_top_k:]:
+                if structural_class(cand[1]) and id(cand[1]) not in have:
+                    picked.append(cand)
+                    have.add(id(cand[1]))
             self.graph, strategy, validated_executor = self._validate_candidates(
-                search_candidates[: cfg.validate_top_k]
+                picked
             )
 
         # default DP: shard every INPUT's batch dim over "data"; explicit
@@ -779,26 +805,31 @@ class FFModel:
             _, g, s = candidates[0]
             return g, s, None
         results.sort(key=lambda r: r[0])
+        win = results[0]
         if dist.is_multi_host():
             # per-host wall clocks may rank differently by timer noise;
             # every host must adopt THE SAME winner — process 0 decides
             # (the same discipline as broadcast_graph). Failed candidates
             # are deterministic across hosts (identical programs), so the
             # surviving modeled ranks align and broadcasting one suffices.
-            win_rank = dist.broadcast_winner_index(results[0][1])
-            results.sort(key=lambda r: 0 if r[1] == win_rank else 1)
+            # `results` stays in THIS host's time order (the recorded
+            # timings must not misrepresent local measurements); only the
+            # adopted winner changes.
+            win_rank = dist.broadcast_winner_index(win[1])
+            win = next((r for r in results if r[1] == win_rank), win)
         self.strategy_validation = {
             "timed_ms": [r[0] * 1e3 for r in results],
             # modeled rank (0 = the model's own pick) per timed entry —
             # honest even when some candidates failed to compile
             "modeled_ranks": [r[1] for r in results],
             "modeled_ms": [candidates[r[1]][0] * 1e3 for r in results],
-            "picked_modeled_rank": results[0][1],
+            "picked_modeled_rank": win[1],
+            "picked_timed_index": results.index(win),
         }
         if self.config.profiling:
             timed = ", ".join(f"{r[0]*1e3:.2f}" for r in results)
             print(f"[search] top-{len(results)} validated (ms/step): {timed}")
-        return results[0][2], results[0][3], results[0][4]
+        return win[2], win[3], win[4]
 
     def _synth_labels(self, graph):
         """Zero labels for the timed playoff (values never matter). Shaped

@@ -166,6 +166,19 @@ def broadcast_candidates(candidates):
     return out
 
 
+def broadcast_stats(stats: Dict) -> Dict:
+    """Ship process 0's search-stats dict (plain JSON scalars) to every
+    host so per-host introspection (model.search_stats) agrees — the
+    search itself only ran on process 0."""
+    if not is_multi_host():
+        return stats
+    payload = b""
+    if process_index() == 0:
+        payload = json.dumps(stats).encode()
+    got = _broadcast_payload(payload)
+    return {} if got is None else json.loads(got.decode())
+
+
 def broadcast_winner_index(index: int) -> int:
     """All hosts adopt process 0's playoff winner (rankings may differ by
     per-host timer noise; the choice must not)."""

@@ -69,6 +69,16 @@ def _maybe_measure(cost, graph, config, mesh=None) -> None:
     cheap)."""
     from flexflow_tpu.search.measured import MeasuredCostModel
 
+    if mesh is not None:
+        from flexflow_tpu.runtime import distributed as dist
+
+        if dist.is_multi_host():
+            # the search runs on process 0 only (model.py), but the
+            # collective sweep jit-executes shard_map programs over the
+            # FULL multi-host mesh — a multi-host SPMD program launched by
+            # one process deadlocks every host at compile time. Op
+            # microbenchmarks below are single-device and stay on.
+            mesh = None
     if isinstance(cost, MeasuredCostModel):
         cost.measure_graph(graph, {}, training=True)
         knobs = cost.calibrate(graph, {}, mesh=mesh)
@@ -170,19 +180,22 @@ def search_strategy(graph, mesh, config,
     return strategy
 
 
-def graph_optimize(graph: Graph, mesh, config,
-                   candidates_out=None) -> Tuple[Graph, Dict[str, ShardingView]]:
+def graph_optimize(graph: Graph, mesh, config, candidates_out=None,
+                   stats_out=None) -> Tuple[Graph, Dict[str, ShardingView]]:
     """Full Unity search: substitutions + view DP. Returns (possibly
     rewritten graph, strategy). `candidates_out`: optional list receiving
     the top-k modeled candidates for empirical whole-step validation. The
     flat best-first path fills it with its k best distinct candidates;
     the sequence-DP stitched path contributes a winner-vs-unrewritten-
     baseline pair instead; only the memory-λ path skips collection."""
+    import time as _time
+
     from flexflow_tpu.search.substitution import (
         memory_lambda_search,
         pick_search_fn,
     )
 
+    _t0 = _time.perf_counter()
     cost = _cost_model(mesh, config)
     _maybe_measure(cost, graph, config, mesh=mesh)
     if config.memory_search:
@@ -206,6 +219,8 @@ def graph_optimize(graph: Graph, mesh, config,
     if candidates_out is not None:
         kw["candidates_out"] = candidates_out
         kw["candidates_k"] = max(getattr(config, "validate_top_k", 0), 2)
+    if stats_out is not None:
+        kw["stats_out"] = stats_out
     best_graph, strategy, best_time = fn(
         graph,
         cost,
@@ -213,6 +228,12 @@ def graph_optimize(graph: Graph, mesh, config,
         alpha=config.search_alpha,
         **kw,
     )
+    if stats_out is not None:
+        # search-cost observability: regressions in corpus size / pattern
+        # matching show up here (and in the gates that record this)
+        stats_out["wall_s"] = _time.perf_counter() - _t0
+        stats_out["best_cost"] = best_time
+        stats_out["graph_nodes"] = len(graph)
     if candidates_out is not None and not candidates_out:
         # the sequence-DP path stitched per-module results and built no
         # whole-graph pool; give the playoff the next-best pair — the

@@ -239,8 +239,13 @@ class CostModel:
                 hd = a.kdim
                 q_bytes = b * s * a.num_heads * hd * dt
                 kv_bytes = 2 * b * s * a.num_kv * hd * dt
+                # training doubles every seq-parallel leg: the backward of
+                # an all-gather is a reduce-scatter of the same bytes, the
+                # backward of an all-to-all is its mirror, and the ring's
+                # backward pass re-permutes k/v AND accumulates dk/dv
+                bwd = 2.0 if training else 1.0
                 if node.op_type == OpType.MULTIHEAD_ATTENTION:
-                    attn_comm += self.machine.all_gather_time(
+                    attn_comm += bwd * self.machine.all_gather_time(
                         q_bytes + kv_bytes, deg, axes=seq_axes
                     )
                 elif getattr(a, "seq_mode", "ring") == "ulysses":
@@ -248,19 +253,33 @@ class CostModel:
                     # GQA KV to num_heads before the exchange); leg 2
                     # moves only the attention output (q-sized)
                     kv_full = 2 * b * s * a.num_heads * hd * dt
-                    attn_comm += self.machine.all_to_all_time(
+                    attn_comm += bwd * (self.machine.all_to_all_time(
                         q_bytes + kv_full, deg, axes=seq_axes
                     ) + self.machine.all_to_all_time(
                         q_bytes, deg, axes=seq_axes
-                    )
+                    ))
                 else:
+                    # ring: per-direction unhidden remainder. Forward
+                    # ppermutes k/v behind the forward blocks; backward
+                    # ppermutes k/v + accumulating dk/dv (2x bytes) behind
+                    # the backward blocks (backward_factor x forward
+                    # compute) — each leg is latency-bound unless the
+                    # transfer outruns its own phase's compute.
                     transfer = self.machine.all_gather_time(
                         kv_bytes, deg, axes=seq_axes
                     )
                     compute = self.node_compute_time(graph, node, view,
                                                      training=training)
-                    attn_comm += max((deg - 1) * self.machine.ici_latency,
-                                     transfer - compute)
+                    lat_floor = (deg - 1) * self.machine.ici_latency
+                    if training:
+                        fwd_c = compute / (1.0 + self.backward_factor)
+                        bwd_c = compute - fwd_c
+                        attn_comm += (
+                            max(lat_floor, transfer - fwd_c)
+                            + max(lat_floor, 2.0 * transfer - bwd_c)
+                        )
+                    else:
+                        attn_comm += max(lat_floor, transfer - compute)
             if attn_comm > 0.0:
                 return attn_comm
         # pipeline: each of the (M+P-1) schedule ticks ppermutes one

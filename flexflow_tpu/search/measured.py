@@ -288,7 +288,10 @@ class MeasuredCostModel(CostModel):
                     rec_bytes = (arr.size * 4 // n
                                  if kind in ("psum", "ppermute")
                                  else arr.size * 4)
-                    ck = f"coll|{kind}|{n}|{rec_bytes}"
+                    # axis name is part of the key: two mesh axes of equal
+                    # degree can ride different links (intra- vs inter-
+                    # slice), so their samples must stay distinct
+                    ck = f"coll|{kind}|{axis}|{n}|{rec_bytes}"
                     if ck in self._measured:
                         self._coll_samples.append(
                             (kind, axis, n, rec_bytes, self._measured[ck]))

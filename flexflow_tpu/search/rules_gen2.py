@@ -267,7 +267,11 @@ def _distribute_family() -> List[Dict]:
                        ["b", "b2", 0], ["d", "b2", 1]],
             "outputs": [["cat", 0]],
         },
-        "where": [{"kind": "attrs_equal", "args": ["b1", "b2", "kind"]}],
+        # inputs_same_shape: with a broadcasting operand (e.g. a (1,d)
+        # bias) the hoisted concat would stack the broadcast pieces as if
+        # they were full tensors — only equal-shape operands hoist
+        "where": [{"kind": "attrs_equal", "args": ["b1", "b2", "kind"]},
+                  {"kind": "inputs_same_shape", "args": ["b1", "b2"]}],
         "dst": {
             "nodes": [
                 _copy("cat1", "cat", "CONCAT", name="{cat}"),

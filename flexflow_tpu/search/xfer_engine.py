@@ -262,6 +262,22 @@ def _where_inputs_same_dtype(nodes: Dict[str, Node], args) -> bool:
     return all(d == dts[0] for d in dts)
 
 
+def _where_inputs_same_shape(nodes: Dict[str, Node], args) -> bool:
+    """Every listed node's inputs all share ONE shape — i.e. no numpy
+    broadcasting between its operands. Guards piecewise rewrites (hoist
+    over concat) whose per-piece semantics silently change when an operand
+    is a broadcast (e.g. (1,d) bias) rather than a full tensor."""
+    for a in args:
+        n = nodes[a]
+        if not n.in_shapes or len(n.in_shapes) < 2:
+            return False
+        d0 = tuple(d.size for d in n.in_shapes[0].dims)
+        for s in n.in_shapes[1:]:
+            if tuple(d.size for d in s.dims) != d0:
+                return False
+    return True
+
+
 def _where_reverse_axis_not_last(nodes: Dict[str, Node], args) -> bool:
     n = nodes[args[0]]
     if not n.in_shapes:
@@ -272,6 +288,7 @@ def _where_reverse_axis_not_last(nodes: Dict[str, Node], args) -> bool:
 
 WHERE_PREDICATES: Dict[str, Callable[[Dict[str, Node], Any], bool]] = {
     "inputs_same_dtype": _where_inputs_same_dtype,
+    "inputs_same_shape": _where_inputs_same_shape,
     "reverse_axis_not_last": _where_reverse_axis_not_last,
     "perms_inverse": _where_perms_inverse,
     "attrs_equal": _where_attrs_equal,

@@ -1,0 +1,42 @@
+"""The MULTICHIP gate itself: literally execute dryrun_multichip(8) the way
+the driver does. r03 shipped a gate config no test had ever run (the
+discovery assert fired only at the dryrun's exact shapes); this keeps the
+exact gate path covered. The dryrun re-execs itself in a clean CPU-backend
+subprocess, so the suite's own jax config doesn't matter here.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_dryrun_multichip_gate():
+    import __graft_entry__ as gate
+
+    gate.dryrun_multichip(8)
+
+
+def test_entry_compiles():
+    """entry() returns a jittable forward + example args (driver contract).
+    Run in a subprocess so the suite's 8-device CPU config stays intact and
+    the single-chip compile check uses a clean backend like the driver."""
+    import subprocess
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import __graft_entry__ as gate\n"
+        "fn, args = gate.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "print('entry OK', getattr(out, 'shape', None))\n"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "entry OK" in proc.stdout

@@ -58,38 +58,65 @@ def test_blocks_to_pipeline_xfer_rewrites():
 
 
 def test_search_discovers_ring_attention_and_beats_dp():
-    """graph_optimize on a data x seq mesh rewrites plain-MHA Llama into
-    ring attention and models faster than the plain-DP baseline."""
+    """graph_optimize on a data x seq mesh DISCOVERS the ring-attention
+    rewrite: the candidate pool retains a seq-parallel graph that models
+    faster than both the unrewritten baseline at its optimal views and the
+    plain-DP default strategy. (The r03 form — asserting the overall
+    WINNER contains ring — was ranking noise: unrelated algebraic rewrites
+    can legitimately model a few percent faster.)"""
     from flexflow_tpu.search.api import _cost_model
     from flexflow_tpu.search.space import default_dp_strategy
 
     ff = _plain_llama(batch=8, seq=512, layers=2)
     cfg = FFConfig(batch_size=8, mesh_shape={"data": 2, "seq": 4},
-                   search_budget=12)
+                   search_budget=12, validate_top_k=2)
     mesh = __import__("flexflow_tpu.parallel.mesh", fromlist=["make_mesh"]) \
         .make_mesh({"data": 2, "seq": 4}, jax.devices())
-    best_graph, strategy = graph_optimize(ff.graph, mesh, cfg)
-    rings = [n for n in best_graph.nodes
-             if n.op_type == OpType.RING_ATTENTION]
-    assert rings, "search did not discover ring attention"
+    pool, stats = [], {}
+    best_graph, strategy = graph_optimize(ff.graph, mesh, cfg,
+                                          candidates_out=pool,
+                                          stats_out=stats)
+    ring_entries = [
+        (c, g, s) for c, g, s in pool
+        if any(n.op_type == OpType.RING_ATTENTION for n in g.nodes)
+    ]
+    assert ring_entries, "pool retained no ring-attention candidate"
+    ring_cost, ring_graph, ring_strategy = min(ring_entries,
+                                               key=lambda t: t[0])
+    assert ring_cost <= stats["baseline_cost"], (
+        f"ring candidate {ring_cost} models worse than the unrewritten "
+        f"baseline {stats['baseline_cost']}"
+    )
     cost = _cost_model(mesh, cfg)
     dp = default_dp_strategy(ff.graph, cost.axis_sizes)
-    t_best = graph_cost(best_graph, strategy, cost).time
+    t_ring = graph_cost(ring_graph, ring_strategy, cost).time
     t_dp = graph_cost(ff.graph, dp, cost).time
-    assert t_best < t_dp, f"searched {t_best} not faster than DP {t_dp}"
+    assert t_ring < t_dp, f"ring {t_ring} not faster than DP {t_dp}"
+    # observability fields the gates record
+    assert stats["expansions"] > 0 and stats["wall_s"] > 0
 
 
 def test_discovered_ring_graph_compiles_and_trains():
-    """End to end: compile() with search enabled on a data x seq mesh picks
-    up the rewritten graph and the jitted step runs."""
+    """End to end: compile() with search retains the discovered ring
+    candidate in the playoff pool, its REAL train step compiles and runs
+    (via the same path the timed playoff uses), and the adopted winner —
+    whichever candidate won on real timings — trains."""
     cfg = LlamaConfig(vocab_size=128, dim=64, layers=2, heads=4,
                       kv_heads=2, hidden=128, rope_theta=10000.0)
     ff = FFModel(FFConfig(batch_size=8, mesh_shape={"data": 2, "seq": 4},
-                          search_budget=12))
+                          search_budget=12, validate_top_k=2))
     build_llama(ff, cfg, seq_len=512)
     ff.compile(optimizer=AdamOptimizer(lr=1e-3),
                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
-    assert any(n.op_type == OpType.RING_ATTENTION for n in ff.graph.nodes)
+    ring_entries = [
+        t for t in ff.searched_candidates
+        if any(n.op_type == OpType.RING_ATTENTION for n in t[1].nodes)
+    ]
+    assert ring_entries, "compile() pool retained no ring candidate"
+    # the ring candidate's real jitted train step must compile and run
+    _, _, ex = ff._validate_candidates([min(ring_entries,
+                                            key=lambda t: t[0])])
+    assert ex is not None, "ring candidate failed real-step validation"
     rs = np.random.RandomState(0)
     x = rs.randint(0, 128, (8, 64)).astype(np.int32)
     y = rs.randint(0, 128, (8, 64)).astype(np.int32)
