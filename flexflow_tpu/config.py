@@ -64,10 +64,13 @@ class FFConfig:
     # not modeled). 0/1 = off; costs k-1 extra compiles at compile() time.
     validate_top_k: int = 0
     measure_cache_file: Optional[str] = None
-    # cost strategies with the native event-driven task-graph simulator
-    # (ffsim_simulate — Simulator::simulate_runtime analog) instead of the
-    # summed-table estimate; MCMC path only, needs libffsim
-    use_simulator: bool = False
+    # cost strategies with the native event-driven simulator instead of the
+    # summed-table estimate (Simulator::simulate_runtime analog): the Unity
+    # search ranks every candidate with the PER-DEVICE task simulator
+    # (search/eventsim.py -> ffsim_tasksim_*), and the playoff pool re-rank
+    # / MCMC objective use it too. Default ON; degrades to the serial sum
+    # when libffsim is unavailable. --no-simulator disables.
+    use_simulator: bool = True
     import_strategy_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
     export_strategy_computation_graph_file: Optional[str] = None
@@ -173,6 +176,8 @@ class FFConfig:
                 cfg.enable_attribute_parallel = True
             elif a == "--simulator":
                 cfg.use_simulator = True
+            elif a == "--no-simulator":
+                cfg.use_simulator = False
             elif a == "--profiler-trace":
                 cfg.profiler_trace_dir = take()
             elif a == "--transfer-guard":

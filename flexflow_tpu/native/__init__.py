@@ -71,6 +71,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ffsim_mcmc.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_double,
                                ctypes.c_uint64, ctypes.c_double, ctypes.c_double,
                                ctypes.c_int, ip, dp]
+    lib.ffsim_tasksim_build.restype = ctypes.c_void_p
+    lib.ffsim_tasksim_build.argtypes = [ctypes.c_int, ctypes.c_int, ip, dp,
+                                        ctypes.c_int, ip, ip]
+    lib.ffsim_tasksim_destroy.argtypes = [ctypes.c_void_p]
+    lib.ffsim_tasksim_run.restype = ctypes.c_double
+    lib.ffsim_tasksim_run.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -150,6 +156,29 @@ class NativeSimGraph:
             1 if use_simulate else 0, arr, ctypes.byref(best_cost),
         )
         return list(arr), best_cost.value, accepted
+
+
+def run_task_dag(n_channels: int, channels, durations, dep_src, dep_dst):
+    """List-schedule a task DAG on `n_channels` serial channels (per-chip
+    compute + per-axis ICI — see native/ffsim.cc ffsim_tasksim_build) and
+    return the makespan, or None when the native engine is unavailable.
+    `channels`/`durations`/`dep_*` are flat sequences (numpy arrays fine);
+    the whole DAG ships in one call to keep ctypes off the hot loop."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(channels)
+    nd = len(dep_src)
+    ch = (ctypes.c_int * n)(*[int(c) for c in channels])
+    du = (ctypes.c_double * n)(*[float(d) for d in durations])
+    ds = (ctypes.c_int * nd)(*[int(i) for i in dep_src])
+    dd = (ctypes.c_int * nd)(*[int(i) for i in dep_dst])
+    h = lib.ffsim_tasksim_build(n_channels, n, ch, du, nd, ds, dd)
+    try:
+        t = lib.ffsim_tasksim_run(h)
+    finally:
+        lib.ffsim_tasksim_destroy(h)
+    return None if t < 0 else t
 
 
 # ---------------------------------------------------------------------------

@@ -52,13 +52,17 @@ def _cost_model(mesh, config) -> CostModel:
     if getattr(config, "measure_costs", False):
         from flexflow_tpu.search.measured import MeasuredCostModel
 
-        m = MeasuredCostModel(
+        cm = MeasuredCostModel(
             machine, axis_sizes,
             cache_path=config.measure_cache_file, **kw,
         )
-        m.load_cache()
-        return m
-    return CostModel(machine, axis_sizes, **kw)
+        cm.load_cache()
+    else:
+        cm = CostModel(machine, axis_sizes, **kw)
+    # rank candidates with the per-device event simulator when enabled
+    # (unity_search.evaluate checks this attribute; harmless elsewhere)
+    cm.event_sim = bool(getattr(config, "use_simulator", False))
+    return cm
 
 
 def _maybe_measure(cost, graph, config, mesh=None) -> None:
@@ -247,12 +251,14 @@ def graph_optimize(graph: Graph, mesh, config, candidates_out=None,
             winner=strategy, baseline=ViewDP(cost).optimize(graph),
             winner_graph=best_graph, baseline_graph=graph,
         )
-    if getattr(config, "use_simulator", False):
+    if getattr(config, "use_simulator", False) and candidates_out:
         # re-rank the playoff pool with the event simulator's overlap-
         # aware list scheduler: a candidate whose grad allreduces hide
         # behind later compute can beat one the serial sum prefers. The
         # simulator's pick becomes the modeled winner (the timed playoff,
-        # when enabled, still gets the final word on hardware).
+        # when enabled, still gets the final word on hardware). With no
+        # pool (validate_top_k<2) the search result is ALREADY simulator-
+        # ranked via evaluate()'s event_sim path — nothing to re-rank.
         head = _simulate_rerank(candidates_out, cost, config)
         if head is not None:
             best_time, best_graph, strategy = head

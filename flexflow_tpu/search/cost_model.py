@@ -124,7 +124,19 @@ class CostModel:
     def node_comm_time(self, graph: Graph, node: Node,
                        view: Optional[ShardingView],
                        training: bool = True) -> float:
-        """Collective cost attributable to the node itself:
+        """Collective cost attributable to the node itself (sum of
+        node_comm_events)."""
+        return sum(t for _, t in
+                   self.node_comm_events(graph, node, view, training))
+
+    def node_comm_events(self, graph: Graph, node: Node,
+                         view: Optional[ShardingView],
+                         training: bool = True):
+        """Collective cost attributable to the node itself, as a list of
+        (mesh_axes, seconds) events — the per-axis breakdown the per-device
+        event simulator schedules onto ICI channels (the reference expands
+        comm into routed per-link SimTasks, simulator.h:810; summing the
+        events gives node_comm_time):
         - parallel ops (Reduction/Combine/Repartition/AllToAll) price the
           collective GSPMD will emit for them;
         - a linear/conv whose contraction dim is sharded produces a partial
@@ -139,26 +151,27 @@ class CostModel:
 
         if node.op_type == OpType.REDUCTION and ins:
             axes = getattr(node.attrs, "axes", ()) or ("model",)
-            return self.machine.all_reduce_time(
+            return [(tuple(axes), self.machine.all_reduce_time(
                 ins[0].global_bytes(), axes_degree(axes), axes=tuple(axes)
-            )
+            ))]
         if node.op_type == OpType.COMBINE and ins:
             axes = getattr(node.attrs, "axes", ()) or ("model",)
             deg = max(axes_degree(axes), 2)
-            return self.machine.all_gather_time(
+            return [(tuple(axes), self.machine.all_gather_time(
                 ins[0].global_bytes(), deg, axes=tuple(axes)
-            )
+            ))]
         if node.op_type == OpType.ALL_TO_ALL and ins:
             axes = getattr(node.attrs, "axes", ())
             deg = max(axes_degree(axes), 2)
-            return self.machine.all_to_all_time(
+            return [(tuple(axes), self.machine.all_to_all_time(
                 ins[0].global_bytes(), deg, axes=tuple(axes)
-            )
+            ))]
         if node.op_type == OpType.FUSED_PARALLEL and ins:
             # fused chain: pay each step's bandwidth but ONE latency term
             # (the reference fuses the chain into a single task,
             # fused_parallel_op.cc)
             total, lat = 0.0, 0.0
+            used_axes = []
             nbytes = ins[0].global_bytes()
             for kind, _dim, axes in node.attrs.steps:
                 # same degrees AND axis names as the unfused node branches
@@ -185,11 +198,14 @@ class CostModel:
                     t = 0.0
                 if deg <= 1:
                     continue
+                used_axes.extend(a for a in axes if a not in used_axes)
                 lat = max(lat, self.machine.ici_latency * deg)
                 total += max(t - self.machine.ici_latency * deg, 0.0)
-            return total + lat
+            if total + lat <= 0.0:
+                return []
+            return [(tuple(used_axes), total + lat)]
         if node.op_type in PARALLEL_OP_TYPES:
-            return 0.0
+            return []
         # expert parallelism: an EXPERTS op whose weight stack is sharded
         # over the expert axis pays a token all-to-all each way (dispatch +
         # combine) — the reference prices Group_by/Aggregate data movement
@@ -199,9 +215,12 @@ class CostModel:
             if w1 and w1[0]:
                 deg = axes_degree(w1[0])
                 if deg > 1:
-                    return 2.0 * self.machine.all_to_all_time(
+                    each = self.machine.all_to_all_time(
                         ins[0].global_bytes(), deg, axes=tuple(w1[0])
                     )
+                    # dispatch + combine: two distinct all-to-alls that can
+                    # each contend on the expert axis's links
+                    return [(tuple(w1[0]), each), (tuple(w1[0]), each)]
         # sequence-parallel attention: the comm that makes ring attention
         # win. A plain MULTIHEAD_ATTENTION under a seq-sharded view is
         # executable (the shard_map flash wrapper keeps S local, so GSPMD
@@ -219,15 +238,16 @@ class CostModel:
             # reference prices attention head parallelism's merge the same
             # way through its comm tasks). ADDITIVE with the seq-parallel
             # term below: a head+seq combined view pays both collectives.
-            attn_comm = 0.0
+            attn_events = []
             wo = view.weight_specs.get("wo")
             if wo and len(wo) >= 1 and wo[0]:
                 deg_wo = axes_degree(wo[0])
                 if deg_wo > 1:
-                    attn_comm += self.machine.all_reduce_time(
+                    attn_events.append((tuple(wo[0]),
+                                        self.machine.all_reduce_time(
                         node.outputs[0].global_bytes(), deg_wo,
                         axes=tuple(wo[0]),
-                    )
+                    )))
             spec = view.output_spec(0)
             seq_axes = tuple(spec[1]) if spec and len(spec) > 1 and spec[1] else ()
             deg = axes_degree(seq_axes)
@@ -245,19 +265,29 @@ class CostModel:
                 # backward pass re-permutes k/v AND accumulates dk/dv
                 bwd = 2.0 if training else 1.0
                 if node.op_type == OpType.MULTIHEAD_ATTENTION:
-                    attn_comm += bwd * self.machine.all_gather_time(
+                    gather = self.machine.all_gather_time(
                         q_bytes + kv_bytes, deg, axes=seq_axes
                     )
+                    attn_events.append((seq_axes, gather))  # fwd all-gather
+                    if training:
+                        # bwd: reduce-scatter of dq/dk/dv, same bytes
+                        attn_events.append((seq_axes, (bwd - 1.0) * gather))
                 elif getattr(a, "seq_mode", "ring") == "ulysses":
                     # leg 1 moves q + full-head KV (the lowering repeats
                     # GQA KV to num_heads before the exchange); leg 2
                     # moves only the attention output (q-sized)
                     kv_full = 2 * b * s * a.num_heads * hd * dt
-                    attn_comm += bwd * (self.machine.all_to_all_time(
+                    leg1 = self.machine.all_to_all_time(
                         q_bytes + kv_full, deg, axes=seq_axes
-                    ) + self.machine.all_to_all_time(
+                    )
+                    leg2 = self.machine.all_to_all_time(
                         q_bytes, deg, axes=seq_axes
-                    ))
+                    )
+                    attn_events.append((seq_axes, leg1))
+                    attn_events.append((seq_axes, leg2))
+                    if training:  # backward mirrors both exchanges
+                        attn_events.append((seq_axes, (bwd - 1.0) * leg1))
+                        attn_events.append((seq_axes, (bwd - 1.0) * leg2))
                 else:
                     # ring: per-direction unhidden remainder. Forward
                     # ppermutes k/v behind the forward blocks; backward
@@ -274,14 +304,17 @@ class CostModel:
                     if training:
                         fwd_c = compute / (1.0 + self.backward_factor)
                         bwd_c = compute - fwd_c
-                        attn_comm += (
-                            max(lat_floor, transfer - fwd_c)
-                            + max(lat_floor, 2.0 * transfer - bwd_c)
-                        )
+                        attn_events.append(
+                            (seq_axes, max(lat_floor, transfer - fwd_c)))
+                        attn_events.append(
+                            (seq_axes,
+                             max(lat_floor, 2.0 * transfer - bwd_c)))
                     else:
-                        attn_comm += max(lat_floor, transfer - compute)
-            if attn_comm > 0.0:
-                return attn_comm
+                        attn_events.append(
+                            (seq_axes, max(lat_floor, transfer - compute)))
+            attn_events = [(ax, t) for ax, t in attn_events if t > 0.0]
+            if attn_events:
+                return attn_events
         # pipeline: each of the (M+P-1) schedule ticks ppermutes one
         # microbatch activation to the next stage (one ICI hop)
         if is_pipe_sharded(node, view) and ins:
@@ -297,7 +330,7 @@ class CostModel:
                     micro_bytes / self.machine._axis_bw(2, ("pipe",))
                     + self.machine.ici_latency
                 )
-                return (m + p - 1) * per_hop
+                return [(("pipe",), (m + p - 1) * per_hop)]
         # contraction-dim sharding => partial-sum all-reduce of the output
         if view is not None and node.outputs:
             contraction_specs = {
@@ -312,19 +345,26 @@ class CostModel:
                     for a in wspec[cdim]:
                         deg *= self.axis_sizes.get(a, 1)
                     if deg > 1:
-                        return self.machine.all_reduce_time(
+                        return [(tuple(wspec[cdim]),
+                                 self.machine.all_reduce_time(
                             node.outputs[0].global_bytes(), deg,
                             axes=tuple(wspec[cdim]),
-                        )
-        return 0.0
+                        ))]
+        return []
 
     def weight_sync_time(self, graph: Graph, node: Node,
                          view: Optional[ShardingView]) -> float:
         """Gradient all-reduce over the replicated (data) axes of each weight
         (reference: NCCL allreduce in the optimizer, optimizer_kernel.cu:88)."""
+        return sum(t for _, t in self.weight_sync_events(graph, node, view))
+
+    def weight_sync_events(self, graph: Graph, node: Node,
+                           view: Optional[ShardingView]):
+        """Per-weight gradient-sync collectives as (mesh_axes, seconds)
+        events (sum = weight_sync_time)."""
         if node.attrs is None:
-            return 0.0
-        total = 0.0
+            return []
+        events = []
         ws = node.attrs.weights(*_in_shapes(graph, node))
         for name, spec_decl in ws.items():
             if not spec_decl.trainable:
@@ -348,18 +388,25 @@ class CostModel:
                     sync_degree *= s
                     if s > 1:
                         sync_axes.append(a)
-            total += self.machine.all_reduce_time(
+            t = self.machine.all_reduce_time(
                 nbytes / shard_degree, sync_degree, axes=tuple(sync_axes)
             )
-        return total
+            if t > 0.0:
+                events.append((tuple(sync_axes), t))
+        return events
 
     def edge_xfer_time(self, shape, src_spec: Optional[Spec],
                        dst_spec: Optional[Spec]) -> float:
+        return self.edge_xfer_event(shape, src_spec, dst_spec)[1]
+
+    def edge_xfer_event(self, shape, src_spec: Optional[Spec],
+                        dst_spec: Optional[Spec]):
         """Resharding cost between the producer's output spec and the
-        consumer's *input* spec (reference estimate_xfer_cost graph.cc:1438).
-        Specs are compared dim-by-dim on the dims of the edge tensor itself
-        (trailing replicated entries trimmed), so a rank-changing consumer's
-        own output spec is never misread as its input layout."""
+        consumer's *input* spec, as one (mesh_axes, seconds) event
+        (reference estimate_xfer_cost graph.cc:1438). Specs are compared
+        dim-by-dim on the dims of the edge tensor itself (trailing
+        replicated entries trimmed), so a rank-changing consumer's own
+        output spec is never misread as its input layout."""
         ndim = len(shape.dims)
 
         def norm(spec):
@@ -374,20 +421,20 @@ class CostModel:
         src = norm(src_spec)
         dst = norm(dst_spec)
         if src == dst:
-            return 0.0
+            return ((), 0.0)
         nbytes = shape.global_bytes()
         src_deg = spec_degree(src or None, self.axis_sizes)
         dst_deg = spec_degree(dst or None, self.axis_sizes)
         if src_deg == dst_deg == 1:
-            return 0.0
+            return ((), 0.0)
         axes = tuple({a for spec in (src, dst) for entry in spec for a in entry})
         parts = max(src_deg, dst_deg, 2)
         if src_deg > 1 and dst_deg > 1:
-            return self.machine.all_to_all_time(nbytes, parts, axes=axes)
+            return (axes, self.machine.all_to_all_time(nbytes, parts, axes=axes))
         if src_deg > 1 and dst_deg == 1:
-            return self.machine.all_gather_time(nbytes, src_deg, axes=axes)
+            return (axes, self.machine.all_gather_time(nbytes, src_deg, axes=axes))
         # partitioning replicated data is a local slice
-        return 0.0
+        return ((), 0.0)
 
     # ------------------------------------------------------------------
 

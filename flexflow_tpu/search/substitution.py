@@ -922,9 +922,18 @@ def unity_search(
     def evaluate(g: Graph) -> Tuple[float, Dict[str, ShardingView]]:
         s = views_of(g)
         gc = graph_cost(g, s, cost, training)
-        if objective is not None:
-            return objective(gc.time, gc.memory_per_chip), s
         t = gc.time
+        if getattr(cost, "event_sim", False):
+            # rank by the per-device task simulator (overlap, pipeline
+            # bubbles, per-axis ICI contention); the serial sum stays the
+            # fallback when the native engine is unavailable
+            from flexflow_tpu.search.eventsim import simulate_graph
+
+            sim = simulate_graph(g, s, cost, training)
+            if sim is not None:
+                t = sim
+        if objective is not None:
+            return objective(t, gc.memory_per_chip), s
         if memory_limit is not None and gc.memory_per_chip > memory_limit:
             t += 1e3 * (gc.memory_per_chip / memory_limit)
         return t, s

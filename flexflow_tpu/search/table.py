@@ -71,15 +71,20 @@ def simulated_strategy_cost(graph: Graph, cost: CostModel,
                             strategy: Dict[str, ShardingView],
                             training: bool = True) -> Optional[float]:
     """Overlap-aware step time of ONE fixed strategy through the native
-    event simulator's two-channel list scheduler (ffsim_simulate —
-    the reference's simulate_runtime, simulator.cc:822): grad allreduces
-    ride the ICI channel asynchronously and can hide behind later compute,
-    which the serial table sum cannot express. Returns None when the
-    native engine is unavailable."""
+    event simulator (the reference's simulate_runtime, simulator.cc:822).
+    Prefers the PER-DEVICE task simulator (search/eventsim.py: per-chip
+    compute channels, per-axis ICI channels, pipeline/ring wave expansion);
+    falls back to the two-channel list scheduler (ffsim_simulate) for
+    oversized meshes, and to None when the native engine is unavailable."""
     from flexflow_tpu import native
 
     if not native.available():
         return None
+    from flexflow_tpu.search.eventsim import simulate_graph
+
+    sim = simulate_graph(graph, strategy, cost, training)
+    if sim is not None:
+        return sim
     table = build_table(graph, cost, {}, strategy, training)
     return table.to_native().simulate([0] * len(table.nodes))
 

@@ -208,3 +208,88 @@ int ffsim_mcmc(const SimGraph* g, int budget, double alpha, uint64_t seed,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Per-device task-DAG simulator (reference Simulator::simulate_runtime,
+// simulator.cc:822, with per-device SimTask queues and routed comm paths;
+// ring expansion simulator.h:810).
+//
+// Channels are serial resources: one per chip (compute) plus one per
+// mesh-axis ICI ring group (all rings of one axis carry identical traffic
+// in an SPMD program, so one channel per axis captures both the axis's
+// serialization and cross-collective contention on its links). Python
+// expands a (graph, strategy) into tasks (flexflow_tpu/search/eventsim.py):
+// lockstep ops become one task per chip, PIPELINE becomes stage x
+// microbatch waves with ppermute hop tasks, ring attention becomes
+// per-step block tasks chained by permute tasks. The whole DAG ships in
+// one call (flat arrays) to keep ctypes overhead off the search loop.
+
+namespace {
+
+struct TaskSim {
+  int n_channels = 0;
+  std::vector<int> channel;        // per task; -1 = no resource (barrier)
+  std::vector<double> duration;    // per task
+  std::vector<std::vector<int>> succs;
+  std::vector<int> indeg;
+};
+
+}  // namespace
+
+extern "C" {
+
+TaskSim* ffsim_tasksim_build(int n_channels, int n_tasks,
+                             const int* channels, const double* durations,
+                             int n_deps, const int* dep_src,
+                             const int* dep_dst) {
+  auto* s = new TaskSim();
+  s->n_channels = n_channels;
+  s->channel.assign(channels, channels + n_tasks);
+  s->duration.assign(durations, durations + n_tasks);
+  s->succs.resize(n_tasks);
+  s->indeg.assign(n_tasks, 0);
+  for (int i = 0; i < n_deps; ++i) {
+    s->succs[dep_src[i]].push_back(dep_dst[i]);
+    s->indeg[dep_dst[i]]++;
+  }
+  return s;
+}
+
+void ffsim_tasksim_destroy(TaskSim* s) { delete s; }
+
+// Event-driven list scheduling: a task becomes ready when all deps
+// finished; among ready tasks the earliest-ready runs first; each channel
+// serializes its tasks. Returns the makespan (negative on a dependency
+// cycle — tasks never all completed).
+double ffsim_tasksim_run(TaskSim* s) {
+  const int n = static_cast<int>(s->duration.size());
+  std::vector<double> ready(n, 0.0);
+  std::vector<int> indeg(s->indeg);
+  using Item = std::pair<double, int>;
+  std::priority_queue<Item, std::vector<Item>, std::greater<Item>> q;
+  for (int i = 0; i < n; ++i)
+    if (indeg[i] == 0) q.push({0.0, i});
+  std::vector<double> chan_free(std::max(s->n_channels, 1), 0.0);
+  double makespan = 0.0;
+  int done = 0;
+  while (!q.empty()) {
+    auto [t, u] = q.top();
+    q.pop();
+    double start = t;
+    const int c = s->channel[u];
+    if (c >= 0) {
+      start = std::max(start, chan_free[c]);
+    }
+    double end = start + s->duration[u];
+    if (c >= 0) chan_free[c] = end;
+    makespan = std::max(makespan, end);
+    ++done;
+    for (int v : s->succs[u]) {
+      ready[v] = std::max(ready[v], end);
+      if (--indeg[v] == 0) q.push({ready[v], v});
+    }
+  }
+  return done == n ? makespan : -1.0;
+}
+
+}  // extern "C"
