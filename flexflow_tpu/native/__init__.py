@@ -167,13 +167,19 @@ def run_task_dag(n_channels: int, channels, durations, dep_src, dep_dst):
     lib = get_lib()
     if lib is None:
         return None
-    n = len(channels)
-    nd = len(dep_src)
-    ch = (ctypes.c_int * n)(*[int(c) for c in channels])
-    du = (ctypes.c_double * n)(*[float(d) for d in durations])
-    ds = (ctypes.c_int * nd)(*[int(i) for i in dep_src])
-    dd = (ctypes.c_int * nd)(*[int(i) for i in dep_dst])
-    h = lib.ffsim_tasksim_build(n_channels, n, ch, du, nd, ds, dd)
+    import numpy as np
+
+    # one bulk conversion per array — per-element ctypes marshalling would
+    # dominate the C scheduler on the search hot path
+    ch = np.ascontiguousarray(channels, dtype=np.int32)
+    du = np.ascontiguousarray(durations, dtype=np.float64)
+    ds = np.ascontiguousarray(dep_src, dtype=np.int32)
+    dd = np.ascontiguousarray(dep_dst, dtype=np.int32)
+    ip = ctypes.POINTER(ctypes.c_int)
+    dp = ctypes.POINTER(ctypes.c_double)
+    h = lib.ffsim_tasksim_build(
+        n_channels, len(ch), ch.ctypes.data_as(ip), du.ctypes.data_as(dp),
+        len(ds), ds.ctypes.data_as(ip), dd.ctypes.data_as(ip))
     try:
         t = lib.ffsim_tasksim_run(h)
     finally:

@@ -1,0 +1,1130 @@
+"""Round-4 rule-corpus extension (reference: the 640-rule TASO corpus,
+substitutions/graph_subst_3_v2.json; loader src/runtime/substitution_loader.cc).
+
+New families over the round-2/3 templates:
+  * monotone-unary x max/min distribution (both directions)
+  * max-pool commutation with monotone unaries; avg-pool commutation with
+    affine scalar unaries; 1x1-conv x avg-pool commutation
+  * reduce linearity (scalar mul/div through sum/mean; shift through mean)
+  * softmax / layer-norm shift invariance
+  * binary algebra: distribute/factor multiply & divide over add/subtract,
+    exp product/quotient fusion, x^2 <-> x*x, rsqrt <-> pow(-1/2),
+    subtract/divide canonicalization, sin/cos addition formulas, silu
+    definition fusion, trig negation symmetries
+  * scalar-chain reordering ((x+a)*m = x*m + a*m via $prod)
+  * gather / top-k commutation with (strictly) monotone unaries and exact
+    widening casts
+  * batch-matmul block algebra: distribute/hoist over concat on the batch,
+    row (M), column (N), and contraction (K) axes; (AB)^T = B^T A^T
+  * weight-bijective merges: add(linear(a), linear(b)) = linear(concat)
+    with row-concatenated kernels (and the conv channel analog)
+  * CSE for reduce/pool/gather/topk/bmm
+
+Every rule is function-preserving in real arithmetic (float reassociation
+aside) and is machine-verified by flexflow_tpu.search.soundness on benign
+AND adversarial instantiations. The same weight discipline as rules_gen2
+applies: weighted nodes only cross via reuse or a declared weight_map
+bijection.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from flexflow_tpu.search.rules_gen2 import (
+    _copy,
+    _fresh,
+    _rule_commute2,
+    _unary_node,
+)
+
+# nondecreasing elementwise kinds: u(max(a,b)) == max(u(a), u(b))
+MONOTONE = ("relu", "sigmoid", "tanh", "exp", "elu",
+            "scalar_add", "scalar_sub")
+# strictly increasing: also preserves top-k ORDER (values and indices)
+STRICT_MONOTONE = ("sigmoid", "tanh", "exp", "scalar_add", "scalar_sub")
+
+
+def _uk(kind: str) -> Dict:
+    return {"unary_kind": [kind]}
+
+
+# ---------------------------------------------------------------------------
+# family A: monotone unary x max/min
+
+
+def _monotone_minmax_family() -> List[Dict]:
+    rules: List[Dict] = []
+    for kind in MONOTONE:
+        for bk in ("max", "min"):
+            if kind != "relu":  # distribute_relu_over_{max,min} ship in gen2
+                rules.append({
+                    "name": f"distribute_{kind}_over_{bk}",
+                    "src": {
+                        "nodes": [{"id": "b", "type": "ELEMENT_BINARY",
+                                   "when": {"attr_eq": ["kind", bk]}},
+                                  _unary_node("u", [kind])],
+                        "edges": [["b", 0, "u", 0]],
+                        "inputs": [["a", "b", 0], ["c", "b", 1]],
+                        "outputs": [["u", 0]],
+                    },
+                    "dst": {
+                        "nodes": [_copy("u1", "u", "ELEMENT_UNARY"),
+                                  _fresh("u2", "u", "ELEMENT_UNARY", "r"),
+                                  _copy("b2", "b", "ELEMENT_BINARY")],
+                        "edges": [["u1", 0, "b2", 0], ["u2", 0, "b2", 1]],
+                        "inputs": [["a", "u1", 0], ["c", "u2", 0]],
+                        "outputs": [["b2", 0]],
+                    },
+                })
+            rules.append({
+                "name": f"hoist_{kind}_over_{bk}",
+                "src": {
+                    "nodes": [_unary_node("u1", [kind]),
+                              _unary_node("u2", [kind]),
+                              {"id": "b", "type": "ELEMENT_BINARY",
+                               "when": {"attr_eq": ["kind", bk]}}],
+                    "edges": [["u1", 0, "b", 0], ["u2", 0, "b", 1]],
+                    "inputs": [["a", "u1", 0], ["c", "u2", 0]],
+                    "outputs": [["b", 0]],
+                },
+                "where": [{"kind": "attrs_equal",
+                           "args": ["u1", "u2", "scalar"]}],
+                "dst": {
+                    "nodes": [_copy("b2", "b", "ELEMENT_BINARY"),
+                              _copy("u", "u1", "ELEMENT_UNARY")],
+                    "edges": [["b2", 0, "u", 0]],
+                    "inputs": [["a", "b2", 0], ["c", "b2", 1]],
+                    "outputs": [["u", 0]],
+                },
+            })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family B: pool commutations (VERDICT r3 #5: conv/pool commutations)
+
+
+def _pool_commute_family() -> List[Dict]:
+    rules: List[Dict] = []
+    # max pool is an elementwise max over windows: any nondecreasing unary
+    # commutes. Padding pinned to (0,0): a pad element would be transformed
+    # on one side only.
+    maxpool = {"attr_eq": [["pool_type", "max"], ["activation", "none"],
+                           ["padding", [0, 0]]]}
+    for kind in MONOTONE:
+        rules.append(_rule_commute2(
+            "ELEMENT_UNARY", "POOL2D", f"commute_maxpool_before_{kind}",
+            when_first=_uk(kind), when_second=dict(maxpool)))
+        rules.append(_rule_commute2(
+            "POOL2D", "ELEMENT_UNARY", f"commute_{kind}_before_maxpool",
+            when_first=dict(maxpool), when_second=_uk(kind)))
+    # avg pool is linear: scalar mul/div slide through with any padding
+    # (zeros scale to zeros); shift (add/sub) additionally needs no padding
+    # (a pad zero would become c on one side only)
+    avgpool = {"attr_eq": [["pool_type", "avg"], ["activation", "none"]]}
+    avgpool_nopad = {"attr_eq": [["pool_type", "avg"], ["activation", "none"],
+                                 ["padding", [0, 0]]]}
+    for kind in ("scalar_multiply", "scalar_truediv"):
+        rules.append(_rule_commute2(
+            "ELEMENT_UNARY", "POOL2D", f"commute_avgpool_before_{kind}",
+            when_first=_uk(kind), when_second=dict(avgpool)))
+        rules.append(_rule_commute2(
+            "POOL2D", "ELEMENT_UNARY", f"commute_{kind}_before_avgpool",
+            when_first=dict(avgpool), when_second=_uk(kind)))
+    for kind in ("scalar_add", "scalar_sub"):
+        rules.append(_rule_commute2(
+            "ELEMENT_UNARY", "POOL2D", f"commute_avgpool_before_{kind}",
+            when_first=_uk(kind), when_second=dict(avgpool_nopad)))
+        rules.append(_rule_commute2(
+            "POOL2D", "ELEMENT_UNARY", f"commute_{kind}_before_avgpool",
+            when_first=dict(avgpool_nopad), when_second=_uk(kind)))
+    # 1x1 conv mixes channels pointwise; avg pool averages spatially —
+    # linear maps commute
+    conv1x1 = {"attr_eq": [["kernel", [1, 1]], ["stride", [1, 1]],
+                           ["padding", [0, 0]], ["groups", 1],
+                           ["use_bias", False], ["activation", "none"]]}
+    rules.append(_rule_commute2(
+        "CONV2D", "POOL2D", "commute_avgpool_before_conv1x1",
+        when_first=dict(conv1x1), when_second=dict(avgpool_nopad)))
+    rules.append(_rule_commute2(
+        "POOL2D", "CONV2D", "commute_conv1x1_before_avgpool",
+        when_first=dict(avgpool_nopad), when_second=dict(conv1x1)))
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family C: reduce linearity + reverse elimination
+
+
+def _reduce_family() -> List[Dict]:
+    rules: List[Dict] = []
+    for red in ("REDUCE_SUM", "MEAN"):
+        rl = red.lower()
+        for kind in ("scalar_multiply", "scalar_truediv"):
+            rules.append(_rule_commute2(
+                "ELEMENT_UNARY", red, f"commute_{rl}_before_{kind}",
+                when_first=_uk(kind)))
+            rules.append(_rule_commute2(
+                red, "ELEMENT_UNARY", f"commute_{kind}_before_{rl}",
+                when_second=_uk(kind)))
+    # mean(x + c) == mean(x) + c (sum does NOT: it scales by the count)
+    for kind in ("scalar_add", "scalar_sub"):
+        rules.append(_rule_commute2(
+            "ELEMENT_UNARY", "MEAN", f"commute_mean_before_{kind}",
+            when_first=_uk(kind)))
+        rules.append(_rule_commute2(
+            "MEAN", "ELEMENT_UNARY", f"commute_{kind}_before_mean",
+            when_second=_uk(kind)))
+    # sum/mean over a reversed axis: the reversal is a permutation of the
+    # reduced elements — drop it (guard: the reversed axis IS reduced)
+    for red in ("REDUCE_SUM", "MEAN"):
+        rules.append({
+            "name": f"elim_reverse_before_{red.lower()}",
+            "src": {
+                "nodes": [{"id": "rv", "type": "REVERSE",
+                           "when": {"attr_eq": ["axis", -1]}},
+                          {"id": "rd", "type": red}],
+                "edges": [["rv", 0, "rd", 0]],
+                "inputs": [["x", "rv", 0]],
+                "outputs": [["rd", 0]],
+            },
+            "where": [{"kind": "reverse_axis_reduced", "args": ["rv", "rd"]}],
+            "dst": {
+                "nodes": [_copy("rd2", "rd", red)],
+                "inputs": [["x", "rd2", 0]],
+                "outputs": [["rd2", 0]],
+            },
+        })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family D: softmax / layer-norm shift invariance
+
+
+def _shift_invariance_family() -> List[Dict]:
+    rules: List[Dict] = []
+    for op, oname in (("SOFTMAX", "softmax"), ("LAYER_NORM", "layernorm")):
+        for kind in ("scalar_add", "scalar_sub"):
+            rules.append({
+                # softmax(x+c) == softmax(x); LN(x+c) == LN(x): a uniform
+                # shift cancels in the max-subtraction / mean-subtraction
+                "name": f"elim_{kind}_before_{oname}",
+                "src": {
+                    "nodes": [_unary_node("u", [kind]),
+                              {"id": "n", "type": op}],
+                    "edges": [["u", 0, "n", 0]],
+                    "inputs": [["x", "u", 0]],
+                    "outputs": [["n", 0]],
+                },
+                "dst": {
+                    "nodes": [_copy("n2", "n", op)],
+                    "inputs": [["x", "n2", 0]],
+                    "outputs": [["n2", 0]],
+                },
+            })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family E: binary algebra
+
+
+def _binary_algebra_family() -> List[Dict]:
+    rules: List[Dict] = []
+    # multiply distributes over add/subtract (shared left operand);
+    # divide distributes from the left numerator: (b ± c)/a = b/a ± c/a
+    for outer, lane in (("multiply", "right"), ("divide", "left")):
+        for inner in ("add", "subtract"):
+            base = {"id": "i", "type": "ELEMENT_BINARY",
+                    "when": {"attr_eq": ["kind", inner]}}
+            ob = {"id": "o", "type": "ELEMENT_BINARY",
+                  "when": {"attr_eq": ["kind", outer]}}
+            if lane == "right":  # multiply(a, add(b, c))
+                src_edges = [["i", 0, "o", 1]]
+                src_inputs = [["a", "o", 0], ["b", "i", 0], ["c", "i", 1]]
+                dst_inputs = [["a", "m1", 0], ["b", "m1", 1],
+                              ["a", "m2", 0], ["c", "m2", 1]]
+            else:  # divide(add(b, c), a)
+                src_edges = [["i", 0, "o", 0]]
+                src_inputs = [["a", "o", 1], ["b", "i", 0], ["c", "i", 1]]
+                dst_inputs = [["b", "m1", 0], ["a", "m1", 1],
+                              ["c", "m2", 0], ["a", "m2", 1]]
+            rules.append({
+                "name": f"distribute_{outer}_over_{inner}",
+                "src": {"nodes": [base, ob], "edges": src_edges,
+                        "inputs": src_inputs, "outputs": [["o", 0]]},
+                "dst": {
+                    "nodes": [_copy("m1", "o", "ELEMENT_BINARY"),
+                              _fresh("m2", "o", "ELEMENT_BINARY", "r"),
+                              _copy("s", "i", "ELEMENT_BINARY")],
+                    "edges": [["m1", 0, "s", 0], ["m2", 0, "s", 1]],
+                    "inputs": dst_inputs,
+                    "outputs": [["s", 0]],
+                },
+            })
+            # factor direction: shared operand `a` across both members
+            if lane == "right":
+                f_inputs = [["a", "m1", 0], ["b", "m1", 1],
+                            ["a", "m2", 0], ["c", "m2", 1]]
+                d_inputs = [["a", "o2", 0], ["b", "s2", 0], ["c", "s2", 1]]
+                d_edges = [["s2", 0, "o2", 1]]
+            else:
+                f_inputs = [["b", "m1", 0], ["a", "m1", 1],
+                            ["c", "m2", 0], ["a", "m2", 1]]
+                d_inputs = [["a", "o2", 1], ["b", "s2", 0], ["c", "s2", 1]]
+                d_edges = [["s2", 0, "o2", 0]]
+            rules.append({
+                "name": f"factor_{outer}_from_{inner}",
+                "src": {
+                    "nodes": [{"id": "m1", "type": "ELEMENT_BINARY",
+                               "when": {"attr_eq": ["kind", outer]}},
+                              {"id": "m2", "type": "ELEMENT_BINARY",
+                               "when": {"attr_eq": ["kind", outer]}},
+                              {"id": "s", "type": "ELEMENT_BINARY",
+                               "when": {"attr_eq": ["kind", inner]}}],
+                    "edges": [["m1", 0, "s", 0], ["m2", 0, "s", 1]],
+                    "inputs": f_inputs,
+                    "outputs": [["s", 0]],
+                },
+                "dst": {
+                    "nodes": [_copy("s2", "s", "ELEMENT_BINARY"),
+                              _copy("o2", "m1", "ELEMENT_BINARY")],
+                    "edges": d_edges,
+                    "inputs": d_inputs,
+                    "outputs": [["o2", 0]],
+                },
+            })
+    # exp(a) * exp(b) == exp(a + b); exp(a) / exp(b) == exp(a - b)
+    for bk, ik, tag in (("multiply", "add", "product"),
+                        ("divide", "subtract", "quotient")):
+        rules.append({
+            "name": f"fuse_exp_{tag}",
+            "src": {
+                "nodes": [_unary_node("e1", ["exp"]),
+                          _unary_node("e2", ["exp"]),
+                          {"id": "b", "type": "ELEMENT_BINARY",
+                           "when": {"attr_eq": ["kind", bk]}}],
+                "edges": [["e1", 0, "b", 0], ["e2", 0, "b", 1]],
+                "inputs": [["a", "e1", 0], ["c", "e2", 0]],
+                "outputs": [["b", 0]],
+            },
+            "dst": {
+                "nodes": [{"id": "s", "type": "ELEMENT_BINARY",
+                           "name": "{b}", "reuse": "b",
+                           "attrs": {"kind": ik}},
+                          _copy("e", "e1", "ELEMENT_UNARY")],
+                "edges": [["s", 0, "e", 0]],
+                "inputs": [["a", "s", 0], ["c", "s", 1]],
+                "outputs": [["e", 0]],
+            },
+        })
+        rules.append({
+            "name": f"split_exp_{tag}",
+            "src": {
+                "nodes": [{"id": "s", "type": "ELEMENT_BINARY",
+                           "when": {"attr_eq": ["kind", ik]}},
+                          _unary_node("e", ["exp"])],
+                "edges": [["s", 0, "e", 0]],
+                "inputs": [["a", "s", 0], ["c", "s", 1]],
+                "outputs": [["e", 0]],
+            },
+            "dst": {
+                "nodes": [_copy("e1", "e", "ELEMENT_UNARY"),
+                          _fresh("e2", "e", "ELEMENT_UNARY", "r"),
+                          {"id": "b", "type": "ELEMENT_BINARY",
+                           "name": "{s}", "reuse": "s",
+                           "attrs": {"kind": bk}}],
+                "edges": [["e1", 0, "b", 0], ["e2", 0, "b", 1]],
+                "inputs": [["a", "e1", 0], ["c", "e2", 0]],
+                "outputs": [["b", 0]],
+            },
+        })
+    # x^2 == x * x
+    rules.append({
+        "name": "square_to_self_multiply",
+        "src": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["pow"],
+                                "attr_eq": ["scalar", 2.0]}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "m", "type": "ELEMENT_BINARY", "name": "{u}",
+                       "reuse": "u", "attrs": {"kind": "multiply"}}],
+            "inputs": [["x", "m", 0], ["x", "m", 1]],
+            "outputs": [["m", 0]],
+        },
+    })
+    rules.append({
+        "name": "self_multiply_to_square",
+        "src": {
+            "nodes": [{"id": "m", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "multiply"]}}],
+            "inputs": [["x", "m", 0], ["x", "m", 1]],  # SHARED operand
+            "outputs": [["m", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY", "name": "{m}",
+                       "reuse": "m",
+                       "attrs": {"kind": "pow", "scalar": 2.0}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+    })
+    # rsqrt(x) == x^(-1/2)
+    rules.append({
+        "name": "rsqrt_to_pow",
+        "src": {
+            "nodes": [_unary_node("u", ["rsqrt"])],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "p", "type": "ELEMENT_UNARY", "name": "{u}",
+                       "reuse": "u",
+                       "attrs": {"kind": "pow", "scalar": -0.5}}],
+            "inputs": [["x", "p", 0]],
+            "outputs": [["p", 0]],
+        },
+    })
+    rules.append({
+        "name": "pow_to_rsqrt",
+        "src": {
+            "nodes": [{"id": "p", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["pow"],
+                                "attr_eq": ["scalar", -0.5]}}],
+            "inputs": [["x", "p", 0]],
+            "outputs": [["p", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY", "name": "{p}",
+                       "reuse": "p",
+                       "attrs": {"kind": "rsqrt", "scalar": 0.0}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+    })
+    # a - b == a + (b * -1)
+    rules.append({
+        "name": "subtract_to_add_negate",
+        "src": {
+            "nodes": [{"id": "s", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "subtract"]}}],
+            "inputs": [["a", "s", 0], ["b", "s", 1]],
+            "outputs": [["s", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "n", "type": "ELEMENT_UNARY",
+                       "name": "{s}_neg",
+                       "attrs": {"kind": "scalar_multiply",
+                                 "scalar": -1.0}},
+                      {"id": "a2", "type": "ELEMENT_BINARY", "name": "{s}",
+                       "reuse": "s", "attrs": {"kind": "add"}}],
+            "edges": [["n", 0, "a2", 1]],
+            "inputs": [["a", "a2", 0], ["b", "n", 0]],
+            "outputs": [["a2", 0]],
+        },
+    })
+    rules.append({
+        "name": "add_negate_to_subtract",
+        "src": {
+            "nodes": [{"id": "n", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["scalar_multiply"],
+                                "attr_eq": ["scalar", -1.0]}},
+                      {"id": "a", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "add"]}}],
+            "edges": [["n", 0, "a", 1]],
+            "inputs": [["x", "a", 0], ["b", "n", 0]],
+            "outputs": [["a", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "s", "type": "ELEMENT_BINARY", "name": "{a}",
+                       "reuse": "a", "attrs": {"kind": "subtract"}}],
+            "inputs": [["x", "s", 0], ["b", "s", 1]],
+            "outputs": [["s", 0]],
+        },
+    })
+    # a / b == a * b^(-1)
+    rules.append({
+        "name": "divide_to_multiply_reciprocal",
+        "src": {
+            "nodes": [{"id": "d", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "divide"]}}],
+            "inputs": [["a", "d", 0], ["b", "d", 1]],
+            "outputs": [["d", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "r", "type": "ELEMENT_UNARY",
+                       "name": "{d}_recip",
+                       "attrs": {"kind": "pow", "scalar": -1.0}},
+                      {"id": "m", "type": "ELEMENT_BINARY", "name": "{d}",
+                       "reuse": "d", "attrs": {"kind": "multiply"}}],
+            "edges": [["r", 0, "m", 1]],
+            "inputs": [["a", "m", 0], ["b", "r", 0]],
+            "outputs": [["m", 0]],
+        },
+    })
+    rules.append({
+        "name": "multiply_reciprocal_to_divide",
+        "src": {
+            "nodes": [{"id": "r", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["pow"],
+                                "attr_eq": ["scalar", -1.0]}},
+                      {"id": "m", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "multiply"]}}],
+            "edges": [["r", 0, "m", 1]],
+            "inputs": [["a", "m", 0], ["b", "r", 0]],
+            "outputs": [["m", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "d", "type": "ELEMENT_BINARY", "name": "{m}",
+                       "reuse": "m", "attrs": {"kind": "divide"}}],
+            "inputs": [["a", "d", 0], ["b", "d", 1]],
+            "outputs": [["d", 0]],
+        },
+    })
+    # sin(a)cos(b) + cos(a)sin(b) == sin(a+b);
+    # cos(a)cos(b) - sin(a)sin(b) == cos(a+b)
+    for tag, f1a, f1b, f2a, f2b, bk, out in (
+            ("sin", "sin", "cos", "cos", "sin", "add", "sin"),
+            ("cos", "cos", "cos", "sin", "sin", "subtract", "cos")):
+        rules.append({
+            "name": f"fuse_{tag}_sum_formula",
+            "src": {
+                "nodes": [_unary_node("p1", [f1a]), _unary_node("p2", [f1b]),
+                          _unary_node("p3", [f2a]), _unary_node("p4", [f2b]),
+                          {"id": "m1", "type": "ELEMENT_BINARY",
+                           "when": {"attr_eq": ["kind", "multiply"]}},
+                          {"id": "m2", "type": "ELEMENT_BINARY",
+                           "when": {"attr_eq": ["kind", "multiply"]}},
+                          {"id": "s", "type": "ELEMENT_BINARY",
+                           "when": {"attr_eq": ["kind", bk]}}],
+                "edges": [["p1", 0, "m1", 0], ["p2", 0, "m1", 1],
+                          ["p3", 0, "m2", 0], ["p4", 0, "m2", 1],
+                          ["m1", 0, "s", 0], ["m2", 0, "s", 1]],
+                "inputs": [["a", "p1", 0], ["b", "p2", 0],
+                           ["a", "p3", 0], ["b", "p4", 0]],
+                "outputs": [["s", 0]],
+            },
+            "dst": {
+                "nodes": [{"id": "ad", "type": "ELEMENT_BINARY",
+                           "name": "{s}", "reuse": "s",
+                           "attrs": {"kind": "add"}},
+                          {"id": "t", "type": "ELEMENT_UNARY",
+                           "name": "{s}_fused",
+                           "attrs": {"kind": out, "scalar": 0.0}}],
+                "edges": [["ad", 0, "t", 0]],
+                "inputs": [["a", "ad", 0], ["b", "ad", 1]],
+                "outputs": [["t", 0]],
+            },
+        })
+    # silu(x) == x * sigmoid(x)
+    rules.append({
+        "name": "fuse_self_gate_to_silu",
+        "src": {
+            "nodes": [_unary_node("g", ["sigmoid"]),
+                      {"id": "m", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "multiply"]}}],
+            "edges": [["g", 0, "m", 1]],
+            "inputs": [["x", "m", 0], ["x", "g", 0]],  # SHARED x
+            "outputs": [["m", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "s", "type": "ELEMENT_UNARY", "name": "{m}",
+                       "reuse": "m", "attrs": {"kind": "silu",
+                                               "scalar": 0.0}}],
+            "inputs": [["x", "s", 0]],
+            "outputs": [["s", 0]],
+        },
+    })
+    rules.append({
+        "name": "unfuse_silu_to_self_gate",
+        "src": {
+            "nodes": [_unary_node("s", ["silu"])],
+            "inputs": [["x", "s", 0]],
+            "outputs": [["s", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "g", "type": "ELEMENT_UNARY",
+                       "name": "{s}_gate",
+                       "attrs": {"kind": "sigmoid", "scalar": 0.0}},
+                      {"id": "m", "type": "ELEMENT_BINARY", "name": "{s}",
+                       "reuse": "s", "attrs": {"kind": "multiply"}}],
+            "edges": [["g", 0, "m", 1]],
+            "inputs": [["x", "m", 0], ["x", "g", 0]],
+            "outputs": [["m", 0]],
+        },
+    })
+    # trig negation symmetries: sin(-x) = -sin(x), tanh(-x) = -tanh(x),
+    # cos(-x) = cos(x)
+    neg = {"unary_kind": ["scalar_multiply"], "attr_eq": ["scalar", -1.0]}
+    for fk in ("sin", "tanh"):
+        rules.append({
+            "name": f"commute_{fk}_negate",
+            "src": {
+                "nodes": [{"id": "n", "type": "ELEMENT_UNARY",
+                           "when": dict(neg)},
+                          _unary_node("f", [fk])],
+                "edges": [["n", 0, "f", 0]],
+                "inputs": [["x", "n", 0]],
+                "outputs": [["f", 0]],
+            },
+            "dst": {
+                "nodes": [_copy("f2", "f", "ELEMENT_UNARY"),
+                          _copy("n2", "n", "ELEMENT_UNARY")],
+                "edges": [["f2", 0, "n2", 0]],
+                "inputs": [["x", "f2", 0]],
+                "outputs": [["n2", 0]],
+            },
+        })
+        rules.append({
+            "name": f"commute_negate_{fk}",
+            "src": {
+                "nodes": [_unary_node("f", [fk]),
+                          {"id": "n", "type": "ELEMENT_UNARY",
+                           "when": dict(neg)}],
+                "edges": [["f", 0, "n", 0]],
+                "inputs": [["x", "f", 0]],
+                "outputs": [["n", 0]],
+            },
+            "dst": {
+                "nodes": [_copy("n2", "n", "ELEMENT_UNARY"),
+                          _copy("f2", "f", "ELEMENT_UNARY")],
+                "edges": [["n2", 0, "f2", 0]],
+                "inputs": [["x", "n2", 0]],
+                "outputs": [["f2", 0]],
+            },
+        })
+    rules.append({
+        "name": "elim_negate_before_cos",
+        "src": {
+            "nodes": [{"id": "n", "type": "ELEMENT_UNARY",
+                       "when": dict(neg)},
+                      _unary_node("f", ["cos"])],
+            "edges": [["n", 0, "f", 0]],
+            "inputs": [["x", "n", 0]],
+            "outputs": [["f", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("f2", "f", "ELEMENT_UNARY")],
+            "inputs": [["x", "f2", 0]],
+            "outputs": [["f2", 0]],
+        },
+    })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family F: scalar-chain reordering & folding
+
+
+def _scalar_chain_family() -> List[Dict]:
+    rules: List[Dict] = []
+    # (x ± a) * m == x*m ± a*m (attrs fold via $prod)
+    for kind in ("scalar_add", "scalar_sub"):
+        rules.append({
+            "name": f"slide_{kind}_out_of_scalar_multiply",
+            "src": {
+                "nodes": [_unary_node("u1", [kind]),
+                          _unary_node("u2", ["scalar_multiply"])],
+                "edges": [["u1", 0, "u2", 0]],
+                "inputs": [["x", "u1", 0]],
+                "outputs": [["u2", 0]],
+            },
+            "dst": {
+                "nodes": [_copy("m2", "u2", "ELEMENT_UNARY"),
+                          {"id": "a2", "type": "ELEMENT_UNARY",
+                           "name": "{u1}", "reuse": "u1",
+                           "attrs": {"kind": kind,
+                                     "scalar": {"$prod": [
+                                         {"$attr": ["u1", "scalar"]},
+                                         {"$attr": ["u2", "scalar"]}]}}}],
+                "edges": [["m2", 0, "a2", 0]],
+                "inputs": [["x", "m2", 0]],
+                "outputs": [["a2", 0]],
+            },
+        })
+    # scalar_sub chains fold: (x - a) - b == x - (a + b)
+    rules.append({
+        "name": "compose_scalar_sub",
+        "src": {
+            "nodes": [_unary_node("u1", ["scalar_sub"]),
+                      _unary_node("u2", ["scalar_sub"])],
+            "edges": [["u1", 0, "u2", 0]],
+            "inputs": [["x", "u1", 0]],
+            "outputs": [["u2", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY", "name": "{u1}",
+                       "reuse": "u1",
+                       "attrs": {"kind": "scalar_sub",
+                                 "scalar": {"$sum": [
+                                     {"$attr": ["u1", "scalar"]},
+                                     {"$attr": ["u2", "scalar"]}]}}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+    })
+    rules.append({
+        "name": "drop_scalar_sub_zero",
+        "src": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["scalar_sub"],
+                                "attr_eq": ["scalar", 0.0]}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "i", "type": "ELEMENT_UNARY", "name": "{u}",
+                       "reuse": "u", "attrs": {"kind": "identity",
+                                               "scalar": 0.0}}],
+            "inputs": [["x", "i", 0]],
+            "outputs": [["i", 0]],
+        },
+    })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family G: gather / top-k commutation
+
+
+def _gather_topk_family() -> List[Dict]:
+    rules: List[Dict] = []
+
+    def commute_gather(name, first_gather: bool, ukinds=None, where=None):
+        u = _unary_node("u", ukinds) if ukinds else _unary_node("u")
+        g = {"id": "g", "type": "GATHER"}
+        if first_gather:
+            # u(gather(x, i)) -> gather(u(x), i)
+            return {
+                "name": name,
+                "src": {
+                    "nodes": [g, u],
+                    "edges": [["g", 0, "u", 0]],
+                    "inputs": [["x", "g", 0], ["i", "g", 1]],
+                    "outputs": [["u", 0]],
+                },
+                "where": list(where or ()),
+                "dst": {
+                    "nodes": [_copy("u2", "u", "ELEMENT_UNARY"),
+                              _copy("g2", "g", "GATHER")],
+                    "edges": [["u2", 0, "g2", 0]],
+                    "inputs": [["x", "u2", 0], ["i", "g2", 1]],
+                    "outputs": [["g2", 0]],
+                },
+            }
+        # gather(u(x), i) -> u(gather(x, i))
+        return {
+            "name": name,
+            "src": {
+                "nodes": [u, g],
+                "edges": [["u", 0, "g", 0]],
+                "inputs": [["x", "u", 0], ["i", "g", 1]],
+                "outputs": [["g", 0]],
+            },
+            "where": list(where or ()),
+            "dst": {
+                "nodes": [_copy("g2", "g", "GATHER"),
+                          _copy("u2", "u", "ELEMENT_UNARY")],
+                "edges": [["g2", 0, "u2", 0]],
+                "inputs": [["x", "g2", 0], ["i", "g2", 1]],
+                "outputs": [["u2", 0]],
+            },
+        }
+
+    # any elementwise unary commutes with gather (pure indexing)
+    rules.append(commute_gather("commute_gather_before_unary", True))
+    rules.append(commute_gather("commute_unary_before_gather", False))
+    # a STRICTLY increasing unary commutes with top-k: values transform,
+    # order — and therefore the indices output — is preserved
+    for kind in STRICT_MONOTONE:
+        rules.append({
+            "name": f"commute_topk_before_{kind}",
+            "src": {
+                "nodes": [_unary_node("u", [kind]),
+                          {"id": "t", "type": "TOPK"}],
+                "edges": [["u", 0, "t", 0]],
+                "inputs": [["x", "u", 0]],
+                "outputs": [["t", 0], ["t", 1]],
+            },
+            "dst": {
+                "nodes": [_copy("t2", "t", "TOPK"),
+                          _copy("u2", "u", "ELEMENT_UNARY")],
+                "edges": [["t2", 0, "u2", 0]],
+                "inputs": [["x", "t2", 0]],
+                "outputs": [["u2", 0], ["t2", 1]],
+            },
+        })
+        rules.append({
+            "name": f"commute_{kind}_before_topk",
+            "src": {
+                "nodes": [{"id": "t", "type": "TOPK"},
+                          _unary_node("u", [kind])],
+                "edges": [["t", 0, "u", 0]],
+                "inputs": [["x", "t", 0]],
+                "outputs": [["u", 0], ["t", 1]],
+            },
+            "dst": {
+                "nodes": [_copy("u2", "u", "ELEMENT_UNARY"),
+                          _copy("t2", "t", "TOPK")],
+                "edges": [["u2", 0, "t2", 0]],
+                "inputs": [["x", "u2", 0]],
+                "outputs": [["t2", 0], ["t2", 1]],
+            },
+        })
+    # exact widening casts are strictly monotone and injective
+    rules.append({
+        "name": "commute_topk_before_widening_cast",
+        "src": {
+            "nodes": [{"id": "c", "type": "CAST"},
+                      {"id": "t", "type": "TOPK"}],
+            "edges": [["c", 0, "t", 0]],
+            "inputs": [["x", "c", 0]],
+            "outputs": [["t", 0], ["t", 1]],
+        },
+        "where": [{"kind": "cast_widens_exact", "args": ["c"]}],
+        "dst": {
+            "nodes": [_copy("t2", "t", "TOPK"),
+                      _copy("c2", "c", "CAST")],
+            "edges": [["t2", 0, "c2", 0]],
+            "inputs": [["x", "t2", 0]],
+            "outputs": [["c2", 0], ["t2", 1]],
+        },
+    })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family H: batch-matmul block algebra
+
+
+def _bmm_when() -> Dict:
+    # seq-length truncation dims disable block rewrites
+    return {"attr_eq": [["a_seq_length_dim", -1], ["b_seq_length_dim", -1]]}
+
+
+def _bmm_concat_family() -> List[Dict]:
+    rules: List[Dict] = []
+    # axis roles on 3-d bmm operands: batch=0, M=1 (of a), N=2 (of b),
+    # K=2 (of a) = 1 (of b)
+    # batch: bmm(cat0(a,c), cat0(b,d)) == cat0(bmm(a,b), bmm(c,d))
+    # M:     bmm(cat1(a,c), b)         == cat1(bmm(a,b), bmm(c,b))
+    # N:     bmm(a, cat2(b,d))         == cat2(bmm(a,b), bmm(a,d))
+    # K:     bmm(cat2(a,c), cat1(b,d)) == bmm(a,b) + bmm(c,d)
+    specs = [
+        ("batch", 0, 0, True, "CONCAT"),
+        ("rows", 1, None, False, "CONCAT"),
+        ("cols", None, 2, False, "CONCAT"),
+        ("contraction", 2, 1, True, "ADD"),
+    ]
+    for tag, a_ax, b_ax, both, join in specs:
+        src_nodes = [{"id": "m", "type": "BATCH_MATMUL",
+                      "when": _bmm_when()}]
+        src_edges = []
+        src_inputs = []
+        where = []
+        if a_ax is not None:
+            src_nodes.append({"id": "ca", "type": "CONCAT",
+                              "when": {"attr_eq": ["axis", a_ax]}})
+            src_edges.append(["ca", 0, "m", 0])
+            src_inputs += [["a", "ca", 0], ["c", "ca", 1]]
+        else:
+            src_inputs.append(["a", "m", 0])
+        if b_ax is not None:
+            src_nodes.append({"id": "cb", "type": "CONCAT",
+                              "when": {"attr_eq": ["axis", b_ax]}})
+            src_edges.append(["cb", 0, "m", 1])
+            src_inputs += [["b", "cb", 0], ["d", "cb", 1]]
+        else:
+            src_inputs.append(["b", "m", 0 if a_ax is None else 1])
+        if both and a_ax is not None and b_ax is not None:
+            # the two concats split DIFFERENT axes (K lives on axis 2 of a,
+            # axis 1 of b) — compare piece sizes along each one's own axis
+            where.append({"kind": "concat_piece_sizes_match",
+                          "args": ["ca", "cb"]}
+                         if a_ax != b_ax else
+                         {"kind": "concat_sizes_match", "args": ["ca", "cb"]})
+        # dst: two bmms joined by concat (copying ca's axis) or an add
+        m1 = _copy("m1", "m", "BATCH_MATMUL")
+        m2 = _fresh("m2", "m", "BATCH_MATMUL", "r")
+        if join == "CONCAT":
+            jn = _copy("j", "ca" if a_ax is not None else "cb", "CONCAT")
+        else:
+            jn = {"id": "j", "type": "ELEMENT_BINARY",
+                  "name": "{m}_sum", "attrs": {"kind": "add"}}
+        dst_inputs = []
+        if tag == "batch":
+            dst_inputs = [["a", "m1", 0], ["b", "m1", 1],
+                          ["c", "m2", 0], ["d", "m2", 1]]
+        elif tag == "rows":
+            dst_inputs = [["a", "m1", 0], ["b", "m1", 1],
+                          ["c", "m2", 0], ["b", "m2", 1]]
+        elif tag == "cols":
+            dst_inputs = [["a", "m1", 0], ["b", "m1", 1],
+                          ["a", "m2", 0], ["d", "m2", 1]]
+        else:
+            dst_inputs = [["a", "m1", 0], ["b", "m1", 1],
+                          ["c", "m2", 0], ["d", "m2", 1]]
+        rules.append({
+            "name": f"distribute_bmm_over_concat_{tag}",
+            "src": {"nodes": src_nodes, "edges": src_edges,
+                    "inputs": src_inputs, "outputs": [["m", 0]]},
+            "where": where,
+            "dst": {
+                "nodes": [m1, m2, jn],
+                "edges": [["m1", 0, "j", 0], ["m2", 0, "j", 1]],
+                "inputs": dst_inputs,
+                "outputs": [["j", 0]],
+            },
+        })
+    # (A @ B)^T == B^T @ A^T on the last two axes (3-d)
+    swap = {"attr_eq": ["perm", [0, 2, 1]]}
+    rules.append({
+        "name": "transpose_of_bmm",
+        "src": {
+            "nodes": [{"id": "m", "type": "BATCH_MATMUL",
+                       "when": _bmm_when()},
+                      {"id": "t", "type": "TRANSPOSE", "when": swap}],
+            "edges": [["m", 0, "t", 0]],
+            "inputs": [["a", "m", 0], ["b", "m", 1]],
+            "outputs": [["t", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "ta", "type": "TRANSPOSE", "name": "{m}_ta",
+                       "attrs": {"perm": [0, 2, 1]}},
+                      {"id": "tb", "type": "TRANSPOSE", "name": "{m}_tb",
+                       "attrs": {"perm": [0, 2, 1]}},
+                      _copy("m2", "m", "BATCH_MATMUL")],
+            "edges": [["tb", 0, "m2", 0], ["ta", 0, "m2", 1]],
+            "inputs": [["a", "ta", 0], ["b", "tb", 0]],
+            "outputs": [["m2", 0]],
+        },
+    })
+    rules.append({
+        "name": "bmm_of_transposes",
+        "src": {
+            "nodes": [{"id": "ta", "type": "TRANSPOSE", "when": swap},
+                      {"id": "tb", "type": "TRANSPOSE", "when": swap},
+                      {"id": "m", "type": "BATCH_MATMUL",
+                       "when": _bmm_when()}],
+            "edges": [["tb", 0, "m", 0], ["ta", 0, "m", 1]],
+            "inputs": [["b", "tb", 0], ["a", "ta", 0]],
+            "outputs": [["m", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("m2", "m", "BATCH_MATMUL"),
+                      {"id": "t", "type": "TRANSPOSE", "name": "{m}_t",
+                       "attrs": {"perm": [0, 2, 1]}}],
+            "edges": [["m2", 0, "t", 0]],
+            "inputs": [["a", "m2", 0], ["b", "m2", 1]],
+            "outputs": [["t", 0]],
+        },
+    })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family I: weight-bijective merges (cross-op distributivity with kernels)
+
+
+def _weighted_merge_family() -> List[Dict]:
+    rules: List[Dict] = []
+    # a @ K1 + b @ K2 == concat(a, b) @ [K1; K2] — the feature-concat
+    # merge; the kernel bijection (row concat) is declared for the
+    # soundness harness / checkpoint restructuring
+    lin_when = {"attr_eq": [["use_bias", False], ["activation", "none"]]}
+    rules.append({
+        "name": "merge_added_linears_to_concat",
+        "src": {
+            "nodes": [{"id": "l1", "type": "LINEAR", "when": dict(lin_when)},
+                      {"id": "l2", "type": "LINEAR", "when": dict(lin_when)},
+                      {"id": "s", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "add"]}}],
+            "edges": [["l1", 0, "s", 0], ["l2", 0, "s", 1]],
+            "inputs": [["a", "l1", 0], ["b", "l2", 0]],
+            "outputs": [["s", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["l1", "l2", "out_dim"]},
+                  {"kind": "attrs_equal", "args": ["l1", "l2", "dtype"]}],
+        "weight_map": {"op": "concat_kernels", "axis": 0},
+        "dst": {
+            "nodes": [{"id": "cat", "type": "CONCAT", "name": "{s}_in",
+                       "attrs": {"axis": -1}},
+                      {"id": "l", "type": "LINEAR", "reuse": "l1",
+                       "name": "{l1}", "attrs": {"$copy": "l1"}}],
+            "edges": [["cat", 0, "l", 0]],
+            "inputs": [["a", "cat", 0], ["b", "cat", 1]],
+            "outputs": [["l", 0]],
+        },
+    })
+    # conv analog over input channels: conv(a;K1) + conv(b;K2) ==
+    # conv(concat_c(a,b); concat(K1,K2, axis=1))
+    cv_when = {"attr_eq": [["use_bias", False], ["activation", "none"],
+                           ["groups", 1]]}
+    rules.append({
+        "name": "merge_added_convs_to_concat",
+        "src": {
+            "nodes": [{"id": "c1", "type": "CONV2D", "when": dict(cv_when)},
+                      {"id": "c2", "type": "CONV2D", "when": dict(cv_when)},
+                      {"id": "s", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "add"]}}],
+            "edges": [["c1", 0, "s", 0], ["c2", 0, "s", 1]],
+            "inputs": [["a", "c1", 0], ["b", "c2", 0]],
+            "outputs": [["s", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["c1", "c2", f]}
+                  for f in ("out_channels", "kernel", "stride", "padding")],
+        "weight_map": {"op": "concat_kernels", "axis": 1},
+        "dst": {
+            "nodes": [{"id": "cat", "type": "CONCAT", "name": "{s}_in",
+                       "attrs": {"axis": 1}},
+                      {"id": "c", "type": "CONV2D", "reuse": "c1",
+                       "name": "{c1}", "attrs": {"$copy": "c1"}}],
+            "edges": [["cat", 0, "c", 0]],
+            "inputs": [["a", "cat", 0], ["b", "cat", 1]],
+            "outputs": [["c", 0]],
+        },
+    })
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# family J: layout/binary + CSE + cast extensions
+
+
+def _misc_family() -> List[Dict]:
+    rules: List[Dict] = []
+    # binary over reverse (same axis, no broadcasting)
+    rules.append({
+        "name": "hoist_binary_over_reverse",
+        "src": {
+            "nodes": [{"id": "r1", "type": "REVERSE"},
+                      {"id": "r2", "type": "REVERSE"},
+                      {"id": "b", "type": "ELEMENT_BINARY"}],
+            "edges": [["r1", 0, "b", 0], ["r2", 0, "b", 1]],
+            "inputs": [["x", "r1", 0], ["y", "r2", 0]],
+            "outputs": [["b", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["r1", "r2", "axis"]},
+                  {"kind": "inputs_same_shape", "args": ["b"]}],
+        "dst": {
+            "nodes": [_copy("b2", "b", "ELEMENT_BINARY"),
+                      _copy("r", "r1", "REVERSE")],
+            "edges": [["b2", 0, "r", 0]],
+            "inputs": [["x", "b2", 0], ["y", "b2", 1]],
+            "outputs": [["r", 0]],
+        },
+    })
+    rules.append({
+        "name": "distribute_reverse_over_binary",
+        "src": {
+            "nodes": [{"id": "b", "type": "ELEMENT_BINARY"},
+                      {"id": "r", "type": "REVERSE"}],
+            "edges": [["b", 0, "r", 0]],
+            "inputs": [["x", "b", 0], ["y", "b", 1]],
+            "outputs": [["r", 0]],
+        },
+        "where": [{"kind": "inputs_same_shape", "args": ["b"]}],
+        "dst": {
+            "nodes": [_copy("r1", "r", "REVERSE"),
+                      _fresh("r2", "r", "REVERSE", "b"),
+                      _copy("b2", "b", "ELEMENT_BINARY")],
+            "edges": [["r1", 0, "b2", 0], ["r2", 0, "b2", 1]],
+            "inputs": [["x", "r1", 0], ["y", "r2", 0]],
+            "outputs": [["b2", 0]],
+        },
+    })
+    # exact widening cast through max/min (monotone + injective)
+    for bk in ("max", "min"):
+        rules.append({
+            "name": f"hoist_widening_cast_over_{bk}",
+            "src": {
+                "nodes": [{"id": "c1", "type": "CAST"},
+                          {"id": "c2", "type": "CAST"},
+                          {"id": "b", "type": "ELEMENT_BINARY",
+                           "when": {"attr_eq": ["kind", bk]}}],
+                "edges": [["c1", 0, "b", 0], ["c2", 0, "b", 1]],
+                "inputs": [["x", "c1", 0], ["y", "c2", 0]],
+                "outputs": [["b", 0]],
+            },
+            # BOTH casts must be exact-widening: a lossy second cast would
+            # make src compare rounded values while dst compares exact ones
+            "where": [{"kind": "attrs_equal", "args": ["c1", "c2", "dtype"]},
+                      {"kind": "cast_widens_exact", "args": ["c1"]},
+                      {"kind": "cast_widens_exact", "args": ["c2"]}],
+            "dst": {
+                "nodes": [_copy("b2", "b", "ELEMENT_BINARY"),
+                          _copy("c", "c1", "CAST")],
+                "edges": [["b2", 0, "c", 0]],
+                "inputs": [["x", "b2", 0], ["y", "b2", 1]],
+                "outputs": [["c", 0]],
+            },
+        })
+    # CSE for weightless multi-output / multi-input ops
+    def cse2(op: str, name: str, fields, two_inputs=False, n_out=1):
+        src_inputs = [["x", "a", 0], ["x", "b", 0]]
+        if two_inputs:
+            src_inputs += [["y", "a", 1], ["y", "b", 1]]
+        outs = []
+        douts = []
+        for i in range(n_out):
+            outs += [["a", i], ["b", i]]
+            douts += [["n", i], ["n", i]]
+        return {
+            "name": name,
+            "src": {
+                "nodes": [{"id": "a", "type": op}, {"id": "b", "type": op}],
+                "edges": [],
+                "inputs": src_inputs,
+                "outputs": outs,
+            },
+            "where": [{"kind": "attrs_equal", "args": ["a", "b", f]}
+                      for f in fields],
+            "dst": {
+                "nodes": [{"id": "n", "type": op, "reuse": "a",
+                           "name": "{a}", "attrs": {"$copy": "a"}}],
+                "inputs": ([["x", "n", 0], ["y", "n", 1]] if two_inputs
+                           else [["x", "n", 0]]),
+                "outputs": douts,
+            },
+        }
+
+    rules.append(cse2("REDUCE_SUM", "cse_reduce_sum",
+                      ("axes", "keepdims")))
+    rules.append(cse2("MEAN", "cse_mean", ("axes", "keepdims")))
+    rules.append(cse2("POOL2D", "cse_pool2d",
+                      ("kernel", "stride", "padding", "pool_type",
+                       "activation")))
+    rules.append(cse2("GATHER", "cse_gather", ("axis",), two_inputs=True))
+    rules.append(cse2("TOPK", "cse_topk", ("k", "sorted"), n_out=2))
+    rules.append(cse2("BATCH_MATMUL", "cse_batch_matmul",
+                      ("a_seq_length_dim", "b_seq_length_dim"),
+                      two_inputs=True))
+    return rules
+
+
+# ---------------------------------------------------------------------------
+
+
+def extra_rules3() -> List[Dict]:
+    """All round-4 additions; names globally unique (asserted by the
+    corpus generator against rounds 2-3)."""
+    rules = (
+        _monotone_minmax_family()
+        + _pool_commute_family()
+        + _reduce_family()
+        + _shift_invariance_family()
+        + _binary_algebra_family()
+        + _scalar_chain_family()
+        + _gather_topk_family()
+        + _bmm_concat_family()
+        + _weighted_merge_family()
+        + _misc_family()
+    )
+    names = [r["name"] for r in rules]
+    assert len(names) == len(set(names)), "duplicate rule names in gen3"
+    return rules

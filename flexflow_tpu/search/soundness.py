@@ -160,6 +160,10 @@ def _default_attrs(op: OpType, in_shapes: List, ov: Dict,
         return A.BatchNormAttrs(get("relu", False))
     if op == OpType.DROPOUT:
         return A.DropoutAttrs(float(get("rate", 0.0)))
+    if op == OpType.GATHER:
+        return A.GatherAttrs(int(get("axis", -1)))
+    if op == OpType.TOPK:
+        return A.TopKAttrs(int(get("k", 3)), bool(get("sorted", True)))
     if op in (OpType.REDUCE_SUM, OpType.MEAN):
         kind = "sum" if op == OpType.REDUCE_SUM else "mean"
         # reduce the LAST axis by default; rules that relate the axes to a
@@ -188,6 +192,13 @@ def _input_shape_for(op: OpType, dst_idx: int, profile_nd: int,
         return (2, 6, 8), f32
     if op == OpType.EXPERTS:
         return ((6, 8), f32) if dst_idx == 0 else ((6, 4), f32)
+    if op == OpType.GATHER and dst_idx == 1:
+        # gather index tensor: same rank/dims as the data input
+        if profile_nd == 3:
+            return (2, 4, 6), DataType.INT32
+        if profile_nd == 4:
+            return (2, 3, 4, 6), DataType.INT32
+        return (4, 6), DataType.INT32
     if profile_nd == 3:
         return (2, 4, 6), f32
     if profile_nd == 4:
@@ -201,6 +212,9 @@ _BMM_SHAPES = {
     "assoc_bmm_right": {"a": (2, 3, 4), "b": (2, 4, 5), "c": (2, 5, 6)},
     "slide_scalar_mul_out_of_bmm": {"a": (2, 3, 4), "b": (2, 4, 5)},
     "slide_scalar_mul_into_bmm": {"a": (2, 3, 4), "b": (2, 4, 5)},
+    "transpose_of_bmm": {"a": (2, 3, 4), "b": (2, 4, 5)},
+    "bmm_of_transposes": {"a": (2, 3, 4), "b": (2, 4, 5)},
+    "cse_batch_matmul": {"x": (2, 3, 4), "y": (2, 4, 5)},
 }
 
 
@@ -211,6 +225,9 @@ def _bmm_rule_shapes(name: str):
         nd = 5 if name.endswith("_5d") else 4 if name.endswith("_4d") else 3
         lead = (2,) * (nd - 2)
         return {"a": lead + (3, 4), "b": lead + (4, 5)}
+    if name.startswith("distribute_bmm_over_concat"):
+        return {"a": (2, 3, 4), "c": (2, 3, 4),
+                "b": (2, 4, 5), "d": (2, 4, 5)}
     return None
 
 
@@ -246,7 +263,10 @@ def instantiate_rule(rule: Dict, profile_nd: int = 2,
         n.outputs = tuple(n.attrs.infer())
         input_nodes[iid] = n
         if dt == DataType.INT32:
-            feed[iid] = rs.randint(0, 10, shape).astype(np.int32)
+            # gather indices must stay in range of the data's axis (other
+            # INT32 consumers — embedding — use num_entries=10)
+            hi = 4 if op == OpType.GATHER else 10
+            feed[iid] = rs.randint(0, hi, shape).astype(np.int32)
         else:
             feed[iid] = rs.randn(*shape).astype(np.float32)
 

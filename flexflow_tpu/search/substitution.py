@@ -988,7 +988,12 @@ def unity_search(
             continue
         expansions += 1
         for xfer in xfers:
-            for cand in xfer.apply_all(g):
+            cands = xfer.apply_all(g)
+            if stats_out is not None and cands:
+                # rule-coverage observability: which rules ever fire
+                fires = stats_out.setdefault("rule_fires", {})
+                fires[xfer.name] = fires.get(xfer.name, 0) + len(cands)
+            for cand in cands:
                 h = cand.structure_hash()
                 if h in seen:
                     continue

@@ -262,6 +262,33 @@ def _where_inputs_same_dtype(nodes: Dict[str, Node], args) -> bool:
     return all(d == dts[0] for d in dts)
 
 
+def _where_concat_piece_sizes_match(nodes: Dict[str, Node], args) -> bool:
+    """Two concats (possibly on DIFFERENT axes) split into pairwise
+    equal-sized pieces along each one's own axis — block rewrites (bmm
+    over K-concat) need the blocks to pair up."""
+    a, b = nodes[args[0]], nodes[args[1]]
+    if (not a.in_shapes or not b.in_shapes
+            or len(a.in_shapes) != len(b.in_shapes)):
+        return False
+    ax_a = a.attrs.axis % a.in_shapes[0].ndim
+    ax_b = b.attrs.axis % b.in_shapes[0].ndim
+    return ([s.dims[ax_a].size for s in a.in_shapes]
+            == [s.dims[ax_b].size for s in b.in_shapes])
+
+
+def _where_reverse_axis_reduced(nodes: Dict[str, Node], args) -> bool:
+    """The REVERSE's axis is among the downstream reduction's axes — the
+    reversal permutes only elements the reduction collapses."""
+    rev, red = nodes[args[0]], nodes[args[1]]
+    if not rev.in_shapes:
+        return False
+    nd = rev.in_shapes[0].ndim
+    axes = getattr(red.attrs, "axes", None)
+    if axes is None:
+        return False
+    return (rev.attrs.axis % nd) in {a % nd for a in axes}
+
+
 def _where_inputs_same_shape(nodes: Dict[str, Node], args) -> bool:
     """Every listed node's inputs all share ONE shape — i.e. no numpy
     broadcasting between its operands. Guards piecewise rewrites (hoist
@@ -289,6 +316,8 @@ def _where_reverse_axis_not_last(nodes: Dict[str, Node], args) -> bool:
 WHERE_PREDICATES: Dict[str, Callable[[Dict[str, Node], Any], bool]] = {
     "inputs_same_dtype": _where_inputs_same_dtype,
     "inputs_same_shape": _where_inputs_same_shape,
+    "reverse_axis_reduced": _where_reverse_axis_reduced,
+    "concat_piece_sizes_match": _where_concat_piece_sizes_match,
     "reverse_axis_not_last": _where_reverse_axis_not_last,
     "perms_inverse": _where_perms_inverse,
     "attrs_equal": _where_attrs_equal,
@@ -1396,6 +1425,12 @@ def gen_default_rules() -> List[Dict]:
     from flexflow_tpu.search.rules_gen2 import extra_rules
 
     rules += extra_rules()
+    # --- round-4 families (monotone min/max, pool commutations, reduce
+    # linearity, shift invariance, binary/trig algebra, gather/topk,
+    # bmm block algebra, weight-bijective merges) ------------------------
+    from flexflow_tpu.search.rules_gen3 import extra_rules3
+
+    rules += extra_rules3()
     names = [r["name"] for r in rules]
     assert len(names) == len(set(names)), "duplicate rule names in corpus"
     return rules

@@ -1,0 +1,27 @@
+"""Rule-fire observability: the search records which corpus rules produce
+candidates (stats_out["rule_fires"]), and the known structural/TP rules
+fire on their natural configs. The full five-config report lives in
+tools/rule_coverage.py (output snapshot: docs/rule_coverage.json)."""
+
+import jax
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.models.mixtral import MixtralConfig, build_mixtral
+from flexflow_tpu.parallel.mesh import make_mesh
+from flexflow_tpu.search.api import graph_optimize
+
+
+def test_search_records_rule_fires_mixtral_ep():
+    mesh_shape = {"data": 2, "expert": 4}
+    cfg = FFConfig(batch_size=8, mesh_shape=mesh_shape, search_budget=8)
+    ff = FFModel(cfg)
+    build_mixtral(ff, MixtralConfig.tiny(), batch_size=8, seq_len=32)
+    ff.graph.infer_shapes()
+    mesh = make_mesh(mesh_shape, jax.devices())
+    stats = {}
+    graph_optimize(ff.graph, mesh, cfg, stats_out=stats)
+    fires = stats.get("rule_fires", {})
+    assert fires, "search recorded no rule fires"
+    # the expert-parallel partition rule must fire on an expert mesh
+    assert any("expert" in name for name in fires), fires
+    assert stats["expansions"] > 0 and stats["wall_s"] > 0
