@@ -798,4 +798,253 @@ int ffc_model_generate(ffc_model_t handle, const int32_t *prompt,
   return 0;
 }
 
+// ---- vision / structural / MoE ops + config knobs (round 4: the
+// remaining reference C surface, python/flexflow_c.cc:181-1751) ----------
+
+extern "C" {
+
+ffc_tensor_t ffc_model_transpose(ffc_model_t handle, ffc_tensor_t input,
+                                 int ndims, const int *perm) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *pl = PyList_New(ndims);
+  for (int i = 0; i < ndims; i++) {
+    PyList_SetItem(pl, i, PyLong_FromLong(perm[i]));
+  }
+  PyObject *args = PyTuple_Pack(2, reinterpret_cast<PyObject *>(input), pl);
+  PyObject *t = call_method(st->model, "transpose", args);
+  Py_DECREF(args);
+  Py_DECREF(pl);
+  return t;
+}
+
+ffc_tensor_t ffc_model_reshape(ffc_model_t handle, ffc_tensor_t input,
+                               int ndims, const int64_t *dims) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *pl = PyList_New(ndims);
+  for (int i = 0; i < ndims; i++) {
+    PyList_SetItem(pl, i, PyLong_FromLongLong(dims[i]));
+  }
+  PyObject *args = PyTuple_Pack(2, reinterpret_cast<PyObject *>(input), pl);
+  PyObject *t = call_method(st->model, "reshape", args);
+  Py_DECREF(args);
+  Py_DECREF(pl);
+  return t;
+}
+
+ffc_tensor_t ffc_model_dropout(ffc_model_t handle, ffc_tensor_t input,
+                               float rate) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue("{s:f}", "rate", rate);
+  PyObject *t = call_method(st->model, "dropout", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return t;
+}
+
+ffc_tensor_t ffc_model_cast(ffc_model_t handle, ffc_tensor_t input,
+                            ffc_dtype_t dtype) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  const char *dn = dtype == FFC_DT_INT32 ? "INT32"
+                   : dtype == FFC_DT_BFLOAT16 ? "BFLOAT16" : "FLOAT";
+  PyObject *dt = enum_member("DataType", dn);
+  if (!dt) return nullptr;
+  PyObject *args = PyTuple_Pack(2, reinterpret_cast<PyObject *>(input), dt);
+  PyObject *t = call_method(st->model, "cast", args);
+  Py_DECREF(args);
+  Py_DECREF(dt);
+  return t;
+}
+
+ffc_tensor_t ffc_model_batch_norm(ffc_model_t handle, ffc_tensor_t input,
+                                  int relu) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue("{s:O}", "relu",
+                                   relu ? Py_True : Py_False);
+  PyObject *t = call_method(st->model, "batch_norm", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return t;
+}
+
+static ffc_tensor_t binary2(ffc_model_t handle, ffc_tensor_t a,
+                            ffc_tensor_t b, const char *name) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(2, reinterpret_cast<PyObject *>(a),
+                                reinterpret_cast<PyObject *>(b));
+  PyObject *t = call_method(st->model, name, args);
+  Py_DECREF(args);
+  return t;
+}
+
+ffc_tensor_t ffc_model_multiply(ffc_model_t m, ffc_tensor_t a,
+                                ffc_tensor_t b) {
+  return binary2(m, a, b, "multiply");
+}
+ffc_tensor_t ffc_model_subtract(ffc_model_t m, ffc_tensor_t a,
+                                ffc_tensor_t b) {
+  return binary2(m, a, b, "subtract");
+}
+ffc_tensor_t ffc_model_sigmoid(ffc_model_t m, ffc_tensor_t x) {
+  return unary(m, x, "sigmoid");
+}
+ffc_tensor_t ffc_model_tanh(ffc_model_t m, ffc_tensor_t x) {
+  return unary(m, x, "tanh");
+}
+ffc_tensor_t ffc_model_gelu(ffc_model_t m, ffc_tensor_t x) {
+  return unary(m, x, "gelu");
+}
+
+// copy the elements of a Python list/tuple of tensors into `out`
+// (new references); returns 0/-1
+static int unpack_tensor_seq(PyObject *seq, int expected, ffc_tensor_t *out) {
+  PyObject *fast = PySequence_Fast(seq, "expected a tensor sequence");
+  if (!fast) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  if (n != expected) {
+    g_error = "unexpected number of output tensors";
+    Py_DECREF(fast);
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *t = PySequence_Fast_GET_ITEM(fast, i);
+    Py_INCREF(t);
+    out[i] = t;
+  }
+  Py_DECREF(fast);
+  return 0;
+}
+
+int ffc_model_split(ffc_model_t handle, ffc_tensor_t input, int n,
+                    const int *sizes, int axis, ffc_tensor_t *out) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *sl = PyList_New(n);
+  for (int i = 0; i < n; i++) {
+    PyList_SetItem(sl, i, PyLong_FromLong(sizes[i]));
+  }
+  PyObject *args = PyTuple_Pack(2, reinterpret_cast<PyObject *>(input), sl);
+  PyObject *kwargs = Py_BuildValue("{s:i}", "axis", axis);
+  PyObject *parts = call_method(st->model, "split", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(sl);
+  if (!parts) return -1;
+  int rc = unpack_tensor_seq(parts, n, out);
+  Py_DECREF(parts);
+  return rc;
+}
+
+int ffc_model_top_k(ffc_model_t handle, ffc_tensor_t input, int k,
+                    int sorted_, ffc_tensor_t *values,
+                    ffc_tensor_t *indices) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue("{s:i,s:O}", "k", k, "sorted",
+                                   sorted_ ? Py_True : Py_False);
+  PyObject *pair = call_method(st->model, "top_k", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  if (!pair) return -1;
+  ffc_tensor_t out[2] = {nullptr, nullptr};
+  int rc = unpack_tensor_seq(pair, 2, out);
+  Py_DECREF(pair);
+  if (rc == 0) {
+    *values = out[0];
+    *indices = out[1];
+  }
+  return rc;
+}
+
+int ffc_model_group_by(ffc_model_t handle, ffc_tensor_t input,
+                       ffc_tensor_t assign, int n, float alpha,
+                       ffc_tensor_t *out) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = Py_BuildValue("(OOif)",
+                                 reinterpret_cast<PyObject *>(input),
+                                 reinterpret_cast<PyObject *>(assign),
+                                 n, alpha);
+  if (!args) { set_error_from_python(); return -1; }
+  PyObject *groups = call_method(st->model, "group_by", args);
+  Py_DECREF(args);
+  if (!groups) return -1;
+  int rc = unpack_tensor_seq(groups, n, out);
+  Py_DECREF(groups);
+  return rc;
+}
+
+ffc_tensor_t ffc_model_aggregate(ffc_model_t handle, int n_inputs,
+                                 const ffc_tensor_t *inputs, int n,
+                                 float lambda_bal) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *lst = PyList_New(n_inputs);
+  for (int i = 0; i < n_inputs; i++) {
+    PyObject *t = reinterpret_cast<PyObject *>(inputs[i]);
+    Py_INCREF(t);
+    PyList_SetItem(lst, i, t);
+  }
+  PyObject *args = Py_BuildValue("(Oi)", lst, n);
+  PyObject *kwargs = Py_BuildValue("{s:f}", "lambda_bal", lambda_bal);
+  PyObject *t = call_method(st->model, "aggregate", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(lst);
+  return t;
+}
+
+ffc_tensor_t ffc_model_moe(ffc_model_t handle, ffc_tensor_t input,
+                           int num_exp, int num_select, int expert_hidden,
+                           float alpha, float lambda_bal) {
+  g_error.clear();
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue(
+      "{s:i,s:i,s:i,s:f,s:f}", "num_exp", num_exp, "num_select", num_select,
+      "expert_hidden_size", expert_hidden, "alpha", alpha, "lambda_bal",
+      lambda_bal);
+  PyObject *t = call_method(st->model, "moe", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  return t;
+}
+
+// FFConfig is a plain dataclass: setattr on a misspelled field would
+// silently create a NEW attribute and the knob would never take effect —
+// reject unknown fields instead
+static int config_set(ffc_config_t cfg, const char *field, PyObject *v) {
+  PyObject *c = reinterpret_cast<PyObject *>(cfg);
+  if (PyObject_HasAttrString(c, field) != 1) {
+    g_error = std::string("FFConfig has no field '") + field + "'";
+    Py_DECREF(v);
+    return -1;
+  }
+  int rc = PyObject_SetAttrString(c, field, v);
+  Py_DECREF(v);
+  if (rc != 0) set_error_from_python();
+  return rc;
+}
+
+int ffc_config_set_int(ffc_config_t cfg, const char *field, int64_t value) {
+  g_error.clear();
+  return config_set(cfg, field, PyLong_FromLongLong(value));
+}
+
+int ffc_config_set_str(ffc_config_t cfg, const char *field,
+                       const char *value) {
+  g_error.clear();
+  return config_set(cfg, field, PyUnicode_FromString(value));
+}
+
+}  // extern "C" (vision/MoE/config additions)
+
 }  // extern "C" (checkpoint/strategy/eval/transformer additions)

@@ -187,5 +187,248 @@ int main(void) {
     ffc_config_destroy(tcfg);
     printf("C_API_TRANSFORMER_OK\n");
   }
+
+  /* ---- vision path: a small CNN (conv/pool/batch-norm/dropout/flat)
+   * trained from C — the reference's AlexNet-style C surface ---- */
+  {
+    enum { B = 8, C = 3, H = 8, W = 8, CLASSES = 4 };
+    ffc_config_t vcfg = ffc_config_create(B, 0);
+    ffc_model_t vm = ffc_model_create(vcfg);
+    int64_t vdims[4] = {B, C, H, W};
+    ffc_tensor_t vx = ffc_model_create_tensor(vm, 4, vdims, FFC_DT_FLOAT);
+    ffc_tensor_t c1 = ffc_model_conv2d(vm, vx, 8, 3, 3, 1, 1, 1, 1,
+                                       FFC_AC_RELU);
+    ffc_tensor_t bn = ffc_model_batch_norm(vm, c1, 0);
+    ffc_tensor_t p1 = ffc_model_pool2d(vm, bn, 2, 2, 2, 2, 0, 0, 1);
+    ffc_tensor_t c2 = ffc_model_conv2d(vm, p1, 16, 3, 3, 1, 1, 1, 1,
+                                       FFC_AC_RELU);
+    ffc_tensor_t p2 = ffc_model_pool2d(vm, c2, 2, 2, 2, 2, 0, 0, 0);
+    ffc_tensor_t fl = ffc_model_flat(vm, p2);
+    ffc_tensor_t dr = ffc_model_dropout(vm, fl, 0.1f);
+    ffc_tensor_t d1 = ffc_model_dense(vm, dr, 32, FFC_AC_RELU, 1);
+    ffc_tensor_t d2 = ffc_model_dense(vm, d1, CLASSES, FFC_AC_NONE, 1);
+    ffc_tensor_t vs = ffc_model_softmax(vm, d2);
+    if (!vs) { fprintf(stderr, "cnn layers: %s\n", ffc_last_error()); return 1; }
+    if (ffc_model_compile(vm, FFC_LOSS_SPARSE_CCE, 0.05f) != 0) {
+      fprintf(stderr, "cnn compile: %s\n", ffc_last_error());
+      return 1;
+    }
+    int64_t vn = 64, row = C * H * W;
+    float *vxd = malloc(vn * row * sizeof(float));
+    int32_t *vyd = malloc(vn * sizeof(int32_t));
+    for (int64_t i = 0; i < vn; i++) {
+      int32_t cls = rand() % CLASSES;
+      vyd[i] = cls;
+      for (int64_t j = 0; j < row; j++) {
+        float noise = (float)rand() / RAND_MAX - 0.5f;
+        /* class-dependent channel bias makes the task learnable */
+        vxd[i * row + j] = noise + ((j / (H * W)) == (cls % C) ? 1.5f : 0.0f)
+                           + (cls == 3 ? 1.0f : 0.0f);
+      }
+    }
+    if (ffc_model_fit(vm, vxd, vyd, vn, row, 6) < 0) {
+      fprintf(stderr, "cnn fit: %s\n", ffc_last_error());
+      return 1;
+    }
+    double vacc = ffc_model_last_accuracy(vm);
+    printf("cnn acc=%.3f\n", vacc);
+    if (vacc < 0.6) {
+      fprintf(stderr, "cnn accuracy too low: %.3f\n", vacc);
+      return 1;
+    }
+    /* strategy import round trip: export this model's strategy, then
+     * compile an identical model WITH it (the --import-strategy flow) */
+    if (ffc_model_export_strategy(vm, "/tmp/ffc_cnn_strategy.json") != 0) {
+      fprintf(stderr, "cnn export_strategy: %s\n", ffc_last_error());
+      return 1;
+    }
+    ffc_config_t icfg = ffc_config_create(B, 0);
+    if (ffc_config_set_str(icfg, "import_strategy_file",
+                           "/tmp/ffc_cnn_strategy.json") != 0) {
+      fprintf(stderr, "config_set_str: %s\n", ffc_last_error());
+      return 1;
+    }
+    ffc_model_t im = ffc_model_create(icfg);
+    ffc_tensor_t ix = ffc_model_create_tensor(im, 4, vdims, FFC_DT_FLOAT);
+    ffc_tensor_t ic1 = ffc_model_conv2d(im, ix, 8, 3, 3, 1, 1, 1, 1,
+                                        FFC_AC_RELU);
+    ffc_tensor_t ibn = ffc_model_batch_norm(im, ic1, 0);
+    ffc_tensor_t ip1 = ffc_model_pool2d(im, ibn, 2, 2, 2, 2, 0, 0, 1);
+    ffc_tensor_t ic2 = ffc_model_conv2d(im, ip1, 16, 3, 3, 1, 1, 1, 1,
+                                        FFC_AC_RELU);
+    ffc_tensor_t ip2 = ffc_model_pool2d(im, ic2, 2, 2, 2, 2, 0, 0, 0);
+    ffc_tensor_t ifl = ffc_model_flat(im, ip2);
+    ffc_tensor_t idr = ffc_model_dropout(im, ifl, 0.1f);
+    ffc_tensor_t id1 = ffc_model_dense(im, idr, 32, FFC_AC_RELU, 1);
+    ffc_tensor_t id2 = ffc_model_dense(im, id1, CLASSES, FFC_AC_NONE, 1);
+    ffc_tensor_t ivs = ffc_model_softmax(im, id2);
+    if (!ivs || ffc_model_compile(im, FFC_LOSS_SPARSE_CCE, 0.05f) != 0) {
+      fprintf(stderr, "import compile: %s\n", ffc_last_error());
+      return 1;
+    }
+    if (ffc_model_fit(im, vxd, vyd, vn, row, 1) < 0) {
+      fprintf(stderr, "import fit: %s\n", ffc_last_error());
+      return 1;
+    }
+    free(vxd);
+    free(vyd);
+    ffc_tensor_destroy(vx); ffc_tensor_destroy(c1); ffc_tensor_destroy(bn);
+    ffc_tensor_destroy(p1); ffc_tensor_destroy(c2); ffc_tensor_destroy(p2);
+    ffc_tensor_destroy(fl); ffc_tensor_destroy(dr); ffc_tensor_destroy(d1);
+    ffc_tensor_destroy(d2); ffc_tensor_destroy(vs);
+    ffc_tensor_destroy(ix); ffc_tensor_destroy(ic1); ffc_tensor_destroy(ibn);
+    ffc_tensor_destroy(ip1); ffc_tensor_destroy(ic2); ffc_tensor_destroy(ip2);
+    ffc_tensor_destroy(ifl); ffc_tensor_destroy(idr); ffc_tensor_destroy(id1);
+    ffc_tensor_destroy(id2); ffc_tensor_destroy(ivs);
+    ffc_model_destroy(vm); ffc_config_destroy(vcfg);
+    ffc_model_destroy(im); ffc_config_destroy(icfg);
+    printf("C_API_CNN_OK\n");
+  }
+
+  /* ---- structural primitives: split / multiply / subtract / concat /
+   * transpose from C ---- */
+  {
+    enum { B = 16, D = 16 };
+    ffc_config_t scfg = ffc_config_create(B, 0);
+    ffc_model_t sm2 = ffc_model_create(scfg);
+    int64_t sdims[2] = {B, D};
+    ffc_tensor_t sx = ffc_model_create_tensor(sm2, 2, sdims, FFC_DT_FLOAT);
+    int sizes[2] = {8, 8};
+    ffc_tensor_t parts[2] = {NULL, NULL};
+    if (ffc_model_split(sm2, sx, 2, sizes, 1, parts) != 0) {
+      fprintf(stderr, "split: %s\n", ffc_last_error());
+      return 1;
+    }
+    ffc_tensor_t mu = ffc_model_multiply(sm2, parts[0], parts[1]);
+    ffc_tensor_t su = ffc_model_subtract(sm2, parts[0], parts[1]);
+    ffc_tensor_t pair[2];
+    pair[0] = mu;
+    pair[1] = su;
+    ffc_tensor_t cat = ffc_model_concat(sm2, 2, pair, 1);
+    ffc_tensor_t th = ffc_model_tanh(sm2, cat);
+    /* transpose twice (a no-op round trip) exercises the perm plumbing */
+    int perm[2] = {1, 0};
+    ffc_tensor_t tr = ffc_model_transpose(sm2, th, 2, perm);
+    ffc_tensor_t tr2 = ffc_model_transpose(sm2, tr, 2, perm);
+    ffc_tensor_t sd = ffc_model_dense(sm2, tr2, 4, FFC_AC_NONE, 1);
+    ffc_tensor_t ssm = ffc_model_softmax(sm2, sd);
+    if (!ssm) { fprintf(stderr, "struct layers: %s\n", ffc_last_error()); return 1; }
+    if (ffc_model_compile(sm2, FFC_LOSS_SPARSE_CCE, 0.05f) != 0) {
+      fprintf(stderr, "struct compile: %s\n", ffc_last_error());
+      return 1;
+    }
+    float sxd[B * D];
+    int32_t syd[B];
+    for (int i = 0; i < B; i++) {
+      syd[i] = i % 4;
+      for (int j = 0; j < D; j++) {
+        sxd[i * D + j] = (float)rand() / RAND_MAX - 0.5f;
+      }
+    }
+    if (ffc_model_fit(sm2, sxd, syd, B, D, 1) < 0) {
+      fprintf(stderr, "struct fit: %s\n", ffc_last_error());
+      return 1;
+    }
+    ffc_tensor_destroy(sx); ffc_tensor_destroy(parts[0]);
+    ffc_tensor_destroy(parts[1]); ffc_tensor_destroy(mu);
+    ffc_tensor_destroy(su); ffc_tensor_destroy(cat);
+    ffc_tensor_destroy(th); ffc_tensor_destroy(tr);
+    ffc_tensor_destroy(tr2); ffc_tensor_destroy(sd);
+    ffc_tensor_destroy(ssm);
+    ffc_model_destroy(sm2); ffc_config_destroy(scfg);
+    printf("C_API_STRUCT_OK\n");
+  }
+
+  /* ---- MoE path: mixture-of-experts classifier from the RAW primitives
+   * (gate -> top-k -> group_by -> per-expert dense -> aggregate), the
+   * reference's moe.cc composition driven entirely from C ---- */
+  {
+    enum { B = 8, D = 16, CLASSES = 4, NEXP = 4 };
+    ffc_config_t mcfg = ffc_config_create(B, 0);
+    ffc_model_t mm = ffc_model_create(mcfg);
+    int64_t mdims[2] = {B, D};
+    ffc_tensor_t mx = ffc_model_create_tensor(mm, 2, mdims, FFC_DT_FLOAT);
+    ffc_tensor_t gate = ffc_model_dense(mm, mx, NEXP, FFC_AC_NONE, 1);
+    ffc_tensor_t gsm = ffc_model_softmax(mm, gate);
+    ffc_tensor_t tv = NULL, ti = NULL;
+    if (ffc_model_top_k(mm, gsm, 2, 1, &tv, &ti) != 0) {
+      fprintf(stderr, "top_k: %s\n", ffc_last_error());
+      return 1;
+    }
+    ffc_tensor_t groups[NEXP];
+    if (ffc_model_group_by(mm, mx, ti, NEXP, 2.0f, groups) != 0) {
+      fprintf(stderr, "group_by: %s\n", ffc_last_error());
+      return 1;
+    }
+    ffc_tensor_t experts[NEXP];
+    for (int e = 0; e < NEXP; e++) {
+      experts[e] = ffc_model_dense(mm, groups[e], 32, FFC_AC_RELU, 1);
+      if (!experts[e]) {
+        fprintf(stderr, "expert %d: %s\n", e, ffc_last_error());
+        return 1;
+      }
+    }
+    ffc_tensor_t agg_in[4 + NEXP];
+    agg_in[0] = tv;
+    agg_in[1] = ti;
+    agg_in[2] = ti;
+    agg_in[3] = gsm;
+    for (int e = 0; e < NEXP; e++) agg_in[4 + e] = experts[e];
+    ffc_tensor_t mo = ffc_model_aggregate(mm, 4 + NEXP, agg_in, NEXP, 0.04f);
+    ffc_tensor_t mh = ffc_model_dense(mm, mo, CLASSES, FFC_AC_NONE, 1);
+    ffc_tensor_t ms = ffc_model_softmax(mm, mh);
+    if (!ms) { fprintf(stderr, "moe layers: %s\n", ffc_last_error()); return 1; }
+    if (ffc_model_compile(mm, FFC_LOSS_SPARSE_CCE, 0.05f) != 0) {
+      fprintf(stderr, "moe compile: %s\n", ffc_last_error());
+      return 1;
+    }
+    int64_t mn = 128;
+    float *mxd = malloc(mn * D * sizeof(float));
+    int32_t *myd = malloc(mn * sizeof(int32_t));
+    for (int64_t i = 0; i < mn; i++) {
+      int32_t cls = rand() % CLASSES;
+      myd[i] = cls;
+      for (int j = 0; j < D; j++) {
+        float noise = (float)rand() / RAND_MAX - 0.5f;
+        mxd[i * D + j] = noise + (j % CLASSES == cls ? 2.0f : 0.0f);
+      }
+    }
+    if (ffc_model_fit(mm, mxd, myd, mn, D, 8) < 0) {
+      fprintf(stderr, "moe fit: %s\n", ffc_last_error());
+      return 1;
+    }
+    double macc = ffc_model_last_accuracy(mm);
+    printf("moe acc=%.3f\n", macc);
+    if (macc < 0.7) {
+      fprintf(stderr, "moe accuracy too low: %.3f\n", macc);
+      return 1;
+    }
+    free(mxd);
+    free(myd);
+    ffc_tensor_destroy(mx); ffc_tensor_destroy(gate);
+    ffc_tensor_destroy(gsm); ffc_tensor_destroy(tv); ffc_tensor_destroy(ti);
+    for (int e = 0; e < NEXP; e++) {
+      ffc_tensor_destroy(groups[e]);
+      ffc_tensor_destroy(experts[e]);
+    }
+    ffc_tensor_destroy(mo); ffc_tensor_destroy(mh); ffc_tensor_destroy(ms);
+    ffc_model_destroy(mm); ffc_config_destroy(mcfg);
+
+    /* the composite wrapper builds the same structure in one call */
+    ffc_config_t ccfg = ffc_config_create(B, 0);
+    ffc_model_t cm = ffc_model_create(ccfg);
+    ffc_tensor_t cx = ffc_model_create_tensor(cm, 2, mdims, FFC_DT_FLOAT);
+    ffc_tensor_t co = ffc_model_moe(cm, cx, NEXP, 2, 32, 2.0f, 0.04f);
+    ffc_tensor_t ch = ffc_model_dense(cm, co, CLASSES, FFC_AC_NONE, 1);
+    ffc_tensor_t cs = ffc_model_softmax(cm, ch);
+    if (!cs || ffc_model_compile(cm, FFC_LOSS_SPARSE_CCE, 0.05f) != 0) {
+      fprintf(stderr, "moe composite: %s\n", ffc_last_error());
+      return 1;
+    }
+    ffc_tensor_destroy(cx); ffc_tensor_destroy(co);
+    ffc_tensor_destroy(ch); ffc_tensor_destroy(cs);
+    ffc_model_destroy(cm); ffc_config_destroy(ccfg);
+    printf("C_API_MOE_OK\n");
+  }
   return 0;
 }

@@ -166,6 +166,59 @@ int ffc_model_generate(ffc_model_t model, const int32_t *prompt,
                        int64_t batch, int64_t prompt_len,
                        int max_new_tokens, int32_t *out);
 
+/* ---- structural / vision ops (reference flexflow_c.cc:181-1751) ---- */
+ffc_tensor_t ffc_model_transpose(ffc_model_t model, ffc_tensor_t input,
+                                 int ndims, const int *perm);
+ffc_tensor_t ffc_model_reshape(ffc_model_t model, ffc_tensor_t input,
+                               int ndims, const int64_t *dims);
+ffc_tensor_t ffc_model_dropout(ffc_model_t model, ffc_tensor_t input,
+                               float rate);
+ffc_tensor_t ffc_model_cast(ffc_model_t model, ffc_tensor_t input,
+                            ffc_dtype_t dtype);
+ffc_tensor_t ffc_model_batch_norm(ffc_model_t model, ffc_tensor_t input,
+                                  int relu);
+ffc_tensor_t ffc_model_multiply(ffc_model_t model, ffc_tensor_t a,
+                                ffc_tensor_t b);
+ffc_tensor_t ffc_model_subtract(ffc_model_t model, ffc_tensor_t a,
+                                ffc_tensor_t b);
+ffc_tensor_t ffc_model_sigmoid(ffc_model_t model, ffc_tensor_t x);
+ffc_tensor_t ffc_model_tanh(ffc_model_t model, ffc_tensor_t x);
+ffc_tensor_t ffc_model_gelu(ffc_model_t model, ffc_tensor_t x);
+/* n-way split along `axis`; fills out[0..n-1]; returns 0/-1 */
+int ffc_model_split(ffc_model_t model, ffc_tensor_t input, int n,
+                    const int *sizes, int axis, ffc_tensor_t *out);
+
+/* ---- MoE ops (reference src/ops/{group_by,aggregate,topk}.cc) ---- */
+/* top-k along the last dim -> (values, indices); returns 0/-1 */
+int ffc_model_top_k(ffc_model_t model, ffc_tensor_t input, int k, int sorted_,
+                    ffc_tensor_t *values, ffc_tensor_t *indices);
+/* route rows to n expert groups by `assign` (int32 top-k indices);
+ * fills out[0..n-1] with per-expert batches; returns 0/-1 */
+int ffc_model_group_by(ffc_model_t model, ffc_tensor_t input,
+                       ffc_tensor_t assign, int n, float alpha,
+                       ffc_tensor_t *out);
+/* merge expert outputs back: inputs = [topk_values, topk_assign,
+ * topk_assign, gate_softmax, expert_0..expert_{n-1}] (the reference
+ * aggregate's operand convention, src/ops/aggregate.cc) */
+ffc_tensor_t ffc_model_aggregate(ffc_model_t model, int n_inputs,
+                                 const ffc_tensor_t *inputs, int n,
+                                 float lambda_bal);
+/* composite MoE layer (gate -> top-k -> group_by -> experts -> aggregate,
+ * reference src/ops/moe.cc example composition) */
+ffc_tensor_t ffc_model_moe(ffc_model_t model, ffc_tensor_t input,
+                           int num_exp, int num_select, int expert_hidden,
+                           float alpha, float lambda_bal);
+
+/* ---- config knobs ----
+ * Set any FFConfig field by name BEFORE ffc_model_create, e.g.
+ *   ffc_config_set_int(cfg, "search_budget", 12);
+ *   ffc_config_set_str(cfg, "import_strategy_file", "/path/s.json");
+ * (the import path is the reference's --import-strategy flow; the file
+ * comes from ffc_model_export_strategy). Returns 0/-1. */
+int ffc_config_set_int(ffc_config_t cfg, const char *field, int64_t value);
+int ffc_config_set_str(ffc_config_t cfg, const char *field,
+                       const char *value);
+
 
 #ifdef __cplusplus
 }

@@ -16,10 +16,12 @@ LIB = os.path.join(LIBDIR, "libflexflow_tpu_c.so")
 
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
 def test_c_api_trains_mlp(tmp_path):
-    if not os.path.exists(LIB):
-        r = subprocess.run(["make", "-C", NATIVE], capture_output=True,
-                           text=True)
-        assert r.returncode == 0, r.stdout + r.stderr
+    # always invoke make: it is timestamp-cheap when fresh, and a stale
+    # prebuilt .so would otherwise fail the link with confusing
+    # undefined-reference errors for newly added entry points
+    r = subprocess.run(["make", "-C", NATIVE], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
     exe = str(tmp_path / "ffc_test")
     cc = shutil.which("gcc") or "g++"
     r = subprocess.run(
@@ -41,3 +43,9 @@ def test_c_api_trains_mlp(tmp_path):
     # the widened surface: Adam compile, attention/norm layers,
     # fit_tokens, and KV-cache generation all drove from C
     assert "C_API_TRANSFORMER_OK" in r.stdout, r.stdout
+    # round 4: CNN (conv/pool/batch-norm/dropout) + strategy import,
+    # structural primitives (split/transpose/binary/concat), and MoE from
+    # the raw top_k/group_by/aggregate primitives + the composite
+    assert "C_API_CNN_OK" in r.stdout, r.stdout
+    assert "C_API_STRUCT_OK" in r.stdout, r.stdout
+    assert "C_API_MOE_OK" in r.stdout, r.stdout
