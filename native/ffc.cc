@@ -71,6 +71,14 @@ PyObject *call_method(PyObject *obj, const char *name, PyObject *args,
   return out;
 }
 
+const char *dt_name(ffc_dtype_t d) {
+  switch (d) {
+    case FFC_DT_INT32: return "INT32";
+    case FFC_DT_BFLOAT16: return "BFLOAT16";
+    default: return "FLOAT";
+  }
+}
+
 const char *act_name(ffc_activation_t a) {
   switch (a) {
     case FFC_AC_RELU: return "RELU";
@@ -220,9 +228,7 @@ ffc_tensor_t ffc_model_create_tensor(ffc_model_t handle, int ndims,
   for (int i = 0; i < ndims; i++) {
     PyTuple_SetItem(dim_tuple, i, PyLong_FromLongLong(dims[i]));
   }
-  const char *dt = dtype == FFC_DT_INT32 ? "INT32"
-                   : dtype == FFC_DT_BFLOAT16 ? "BFLOAT16" : "FLOAT";
-  PyObject *dt_obj = enum_member("DataType", dt);
+  PyObject *dt_obj = enum_member("DataType", dt_name(dtype));
   if (!dt_obj) { Py_DECREF(dim_tuple); return nullptr; }
   PyObject *args = PyTuple_Pack(2, dim_tuple, dt_obj);
   PyObject *t = call_method(st->model, "create_tensor", args);
@@ -366,10 +372,8 @@ ffc_tensor_t ffc_model_embedding_aggr(ffc_model_t handle, ffc_tensor_t input,
   auto *st = reinterpret_cast<ModelState *>(handle);
   const char *an = aggr == FFC_AGGR_SUM ? "SUM"
                    : aggr == FFC_AGGR_AVG ? "AVG" : "NONE";
-  const char *dn = dtype == FFC_DT_INT32 ? "INT32"
-                   : dtype == FFC_DT_BFLOAT16 ? "BFLOAT16" : "FLOAT";
   PyObject *aggr_obj = enum_member("AggrMode", an);
-  PyObject *dt_obj = enum_member("DataType", dn);
+  PyObject *dt_obj = enum_member("DataType", dt_name(dtype));
   if (!aggr_obj || !dt_obj) {
     Py_XDECREF(aggr_obj);
     Py_XDECREF(dt_obj);
@@ -849,9 +853,7 @@ ffc_tensor_t ffc_model_cast(ffc_model_t handle, ffc_tensor_t input,
                             ffc_dtype_t dtype) {
   g_error.clear();
   auto *st = reinterpret_cast<ModelState *>(handle);
-  const char *dn = dtype == FFC_DT_INT32 ? "INT32"
-                   : dtype == FFC_DT_BFLOAT16 ? "BFLOAT16" : "FLOAT";
-  PyObject *dt = enum_member("DataType", dn);
+  PyObject *dt = enum_member("DataType", dt_name(dtype));
   if (!dt) return nullptr;
   PyObject *args = PyTuple_Pack(2, reinterpret_cast<PyObject *>(input), dt);
   PyObject *t = call_method(st->model, "cast", args);

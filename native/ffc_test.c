@@ -300,15 +300,24 @@ int main(void) {
       return 1;
     }
     ffc_tensor_t mu = ffc_model_multiply(sm2, parts[0], parts[1]);
-    ffc_tensor_t su = ffc_model_subtract(sm2, parts[0], parts[1]);
+    ffc_tensor_t sg = ffc_model_sigmoid(sm2, parts[0]);
+    ffc_tensor_t gl = ffc_model_gelu(sm2, parts[1]);
+    ffc_tensor_t su = ffc_model_subtract(sm2, sg, gl);
     ffc_tensor_t pair[2];
     pair[0] = mu;
     pair[1] = su;
     ffc_tensor_t cat = ffc_model_concat(sm2, 2, pair, 1);
     ffc_tensor_t th = ffc_model_tanh(sm2, cat);
-    /* transpose twice (a no-op round trip) exercises the perm plumbing */
+    /* cast round trip (bf16 and back) + reshape fold/unfold + transpose
+     * round trip: the layout/dtype plumbing end to end */
+    ffc_tensor_t cbf = ffc_model_cast(sm2, th, FFC_DT_BFLOAT16);
+    ffc_tensor_t cfp = ffc_model_cast(sm2, cbf, FFC_DT_FLOAT);
+    int64_t fold[3] = {B, 2, D / 2};
+    ffc_tensor_t rs1 = ffc_model_reshape(sm2, cfp, 3, fold);
+    int64_t unfold[2] = {B, D};
+    ffc_tensor_t rs2 = ffc_model_reshape(sm2, rs1, 2, unfold);
     int perm[2] = {1, 0};
-    ffc_tensor_t tr = ffc_model_transpose(sm2, th, 2, perm);
+    ffc_tensor_t tr = ffc_model_transpose(sm2, rs2, 2, perm);
     ffc_tensor_t tr2 = ffc_model_transpose(sm2, tr, 2, perm);
     ffc_tensor_t sd = ffc_model_dense(sm2, tr2, 4, FFC_AC_NONE, 1);
     ffc_tensor_t ssm = ffc_model_softmax(sm2, sd);
@@ -331,8 +340,11 @@ int main(void) {
     }
     ffc_tensor_destroy(sx); ffc_tensor_destroy(parts[0]);
     ffc_tensor_destroy(parts[1]); ffc_tensor_destroy(mu);
+    ffc_tensor_destroy(sg); ffc_tensor_destroy(gl);
     ffc_tensor_destroy(su); ffc_tensor_destroy(cat);
-    ffc_tensor_destroy(th); ffc_tensor_destroy(tr);
+    ffc_tensor_destroy(th); ffc_tensor_destroy(cbf);
+    ffc_tensor_destroy(cfp); ffc_tensor_destroy(rs1);
+    ffc_tensor_destroy(rs2); ffc_tensor_destroy(tr);
     ffc_tensor_destroy(tr2); ffc_tensor_destroy(sd);
     ffc_tensor_destroy(ssm);
     ffc_model_destroy(sm2); ffc_config_destroy(scfg);

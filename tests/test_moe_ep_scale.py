@@ -1,0 +1,120 @@
+"""Mixtral-scale expert parallelism proof (VERDICT r3 #7): at realistic
+expert ratios (8 experts, k=2, capacity factor 1.25) the token-sort
+dispatch (a) actually lowers the expert-axis exchange to an all-to-all in
+the compiled HLO, and (b) keeps every intermediate O(tokens * dim) — the
+dense one-hot mask alone would be O(tokens * n * cap). The sort-vs-dense
+wall-clock comparison lives in tools/moe_ep_bench.py (timing is too noisy
+for CI; the memory/HLO properties here are the load-bearing ones).
+
+Reference analog: src/ops/group_by.cu / aggregate.cu scatter kernels +
+Repartition/Combine expert parallelism over NCCL
+(examples/cpp/mixture_of_experts/moe.cc)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+from flexflow_tpu.ops import attrs as A
+from flexflow_tpu.ops.jax_ops import _experts
+from flexflow_tpu.ops.registry import LowerCtx
+from flexflow_tpu.parallel.sharding import ShardingView
+
+N_EXPERTS, K, ALPHA = 8, 2, 1.25
+
+
+def _ep_model(batch=16, d=32, hidden=64):
+    """EXPERTS layer expert-sharded over all 8 devices."""
+    from flexflow_tpu.ffconst import DataType
+
+    ff = FFModel(FFConfig(batch_size=batch,
+                          mesh_shape={"expert": 8}))
+    x = ff.create_tensor((batch, d), DataType.FLOAT, name="x")
+    gate = ff.dense(x, N_EXPERTS, use_bias=False, name="gate")
+    y = ff.experts(x, gate, N_EXPERTS, K, hidden, d, alpha=ALPHA,
+                   name="experts")
+    out = ff.dense(y, 4, name="head")
+    ff.softmax(out, name="sm")
+    strategy = {"experts": ShardingView(weight_specs={
+        "w1": (("expert",), (), ()),
+        "w2": (("expert",), (), ()),
+    })}
+    ff.compile(optimizer=AdamOptimizer(lr=1e-3),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy=strategy)
+    return ff
+
+
+def test_ep_all_to_all_lowers_in_hlo():
+    """The expert-sharded scatter/gather must become a real ICI
+    all-to-all (plus expert-sliced matmuls), not a full replication."""
+    ff = _ep_model()
+    step = ff.executor.train_step()
+    tr, ntr = ff._params
+    rng = jax.random.key(0)
+    x = jnp.zeros((16, 32), jnp.float32)
+    y = jnp.zeros((16,), jnp.int32)
+    lowered = step.lower(tr, ntr, ff._opt_state, rng, y, x)
+    hlo = lowered.compile().as_text()
+    assert "all-to-all" in hlo, (
+        "expert-sharded EXPERTS compiled without an all-to-all:\n"
+        + hlo[:2000]
+    )
+
+
+def test_ep_trains_at_mixtral_ratio():
+    ff = _ep_model()
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 32).astype(np.float32)
+    y = (rs.rand(32) * 4).astype(np.int32)
+    m = ff.fit(x, y, epochs=1, verbose=False)
+    assert m.train_all == 32
+
+
+def _largest_intermediate(dispatch, t, d, n, k, h, alpha):
+    at = A.ExpertsAttrs(n, k, h, d, alpha, dispatch=dispatch)
+    ctx = LowerCtx(training=True, rng=None, mesh=None)
+
+    def f(x, gl, w1, w2):
+        return _experts(at, [x, gl], {"w1": w1, "w2": w2}, ctx)[0].sum()
+
+    jx = jax.make_jaxpr(jax.grad(f, argnums=(0, 1, 2, 3)))(
+        jnp.zeros((t, d)), jnp.zeros((t, n)),
+        jnp.zeros((n, d, h)), jnp.zeros((n, h, d)))
+    sizes = []
+
+    def walk(jaxpr):
+        for eq in jaxpr.eqns:
+            for v in eq.outvars:
+                if getattr(v, "aval", None) is not None and v.aval.size:
+                    sizes.append(v.aval.size * v.aval.dtype.itemsize)
+            for p in eq.params.values():
+                if hasattr(p, "jaxpr"):
+                    walk(p.jaxpr)
+                elif isinstance(p, (list, tuple)):
+                    for q in p:
+                        if hasattr(q, "jaxpr"):
+                            walk(q.jaxpr)
+
+    walk(jx.jaxpr)
+    return max(sizes)
+
+
+def test_ep_memory_stays_o_tokens_dim_at_mixtral_ratio():
+    """At t=4096 tokens, d=512, n=8, k=2, cap=1.25: every intermediate of
+    the sort dispatch stays within a small constant of tokens*dim bytes.
+    (The buffer itself is (n*cap, d) = 1.25*k*t rows; activations h are
+    the widest at hidden size.) The dense mask would be t*k*n*cap floats
+    = 32x the token buffer at these ratios."""
+    t, d, h = 4096, 512, 1024
+    peak = _largest_intermediate("sort", t, d, N_EXPERTS, K, h, ALPHA)
+    # widest legitimate tensor: the expert-buffer hidden activations,
+    # (n, cap, h) with n*cap = 1.25*k*t rows
+    budget = int(1.25 * K * t) * h * 4
+    assert peak <= budget * 1.1, (
+        f"sort dispatch peak intermediate {peak} exceeds O(tokens*dim) "
+        f"budget {budget}"
+    )
+    dense_mask = t * K * N_EXPERTS * A.ExpertsAttrs(
+        N_EXPERTS, K, h, d, ALPHA).capacity(t) * 4
+    assert dense_mask >= 8 * budget, "dense mask should dwarf the budget"

@@ -1,0 +1,88 @@
+"""Sort-vs-dense MoE dispatch micro-benchmark at Mixtral-like ratios.
+
+Times the fused EXPERTS forward+backward for the token-sort dispatch
+(O(t*k log(t*k)) sort + static-capacity scatter) against the dense
+one-hot oracle, at 8 experts / k=2 / capacity 1.25 and configurable
+token count. Prints one JSON line per dispatch.
+
+Usage: python tools/moe_ep_bench.py [--tokens 4096] [--dim 512]
+       [--hidden 1024] [--platform cpu|tpu] [--iters 10]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=4096)
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--hidden", type=int, default=1024)
+    ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--k", type=int, default=2)
+    ap.add_argument("--alpha", type=float, default=1.25)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flexflow_tpu.ops import attrs as A
+    from flexflow_tpu.ops.jax_ops import _experts
+    from flexflow_tpu.ops.registry import LowerCtx
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(args.tokens, args.dim), jnp.float32)
+    gl = jnp.asarray(rs.randn(args.tokens, args.experts), jnp.float32)
+    w1 = jnp.asarray(
+        rs.randn(args.experts, args.dim, args.hidden) * 0.05, jnp.float32)
+    w2 = jnp.asarray(
+        rs.randn(args.experts, args.hidden, args.dim) * 0.05, jnp.float32)
+
+    results = {}
+    for dispatch in ("sort", "dense"):
+        at = A.ExpertsAttrs(args.experts, args.k, args.hidden, args.dim,
+                            args.alpha, dispatch=dispatch)
+        ctx = LowerCtx(training=True, rng=None, mesh=None)
+
+        def f(x, gl, w1, w2):
+            return _experts(at, [x, gl], {"w1": w1, "w2": w2}, ctx)[0].sum()
+
+        step = jax.jit(jax.grad(f, argnums=(2, 3)))
+        try:
+            g = step(x, gl, w1, w2)  # compile + warm
+            jax.block_until_ready(g)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                g = step(x, gl, w1, w2)
+            jax.block_until_ready(g)
+            dt = (time.perf_counter() - t0) / args.iters
+        except Exception as e:  # dense OOMs at large token counts
+            print(json.dumps({"dispatch": dispatch, "error": str(e)[:200]}))
+            continue
+        results[dispatch] = dt
+        print(json.dumps({
+            "dispatch": dispatch,
+            "tokens": args.tokens, "dim": args.dim,
+            "experts": args.experts, "k": args.k, "alpha": args.alpha,
+            "ms_per_step": round(dt * 1e3, 3),
+        }))
+    if "sort" in results and "dense" in results:
+        print(json.dumps({
+            "metric": "moe_sort_vs_dense_speedup",
+            "value": round(results["dense"] / results["sort"], 3),
+        }))
+
+
+if __name__ == "__main__":
+    main()
