@@ -49,6 +49,13 @@ class FFConfig:
     # set False to restrict the search to sample parallelism.
     enable_parameter_parallel: bool = True
     enable_attribute_parallel: bool = True
+    # per-op submesh placement (reference MachineView{start_device_id,
+    # stride}, machine_view.h:14-96): split the data axis into
+    # data x data_sub so ops whose batch dim cannot divide the full data
+    # group shard over a DEVICE SUBSET (replicated across the rest)
+    # instead of degrading to full replication; the view space offers
+    # both the full-group and subset points (search/space.py)
+    enable_submesh: bool = False
     memory_search: bool = False
     # search for a machine bigger than the one running (reference
     # --search-num-workers, model.cc:3692); extra chips extend `data`
@@ -174,6 +181,8 @@ class FFConfig:
                 # the reference sets parameter-parallel here too (noted as an
                 # upstream bug in SURVEY.md §2.3); we keep them independent
                 cfg.enable_attribute_parallel = True
+            elif a == "--enable-submesh":
+                cfg.enable_submesh = True
             elif a == "--simulator":
                 cfg.use_simulator = True
             elif a == "--no-simulator":

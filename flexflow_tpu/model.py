@@ -28,7 +28,7 @@ from flexflow_tpu.ffconst import (
 )
 from flexflow_tpu.ops import attrs as A
 from flexflow_tpu.parallel.mesh import make_mesh
-from flexflow_tpu.parallel.sharding import ShardingView, batch_spec
+from flexflow_tpu.parallel.sharding import ShardingView, data_batch_spec
 from flexflow_tpu.pcg.graph import Graph, Node
 from flexflow_tpu.pcg.tensor import TensorShape
 from flexflow_tpu.runtime.executor import Executor, node_key
@@ -560,6 +560,14 @@ class FFModel:
             mesh_axes = dict(cfg.mesh_shape)
         else:
             mesh_axes = {"data": len(devices)}
+        if (cfg.enable_submesh and "data_sub" not in mesh_axes
+                and mesh_axes.get("data", 1) >= 4
+                and mesh_axes["data"] % 2 == 0):
+            # submesh placement: split data into data x data_sub so views
+            # can target a device subset (MachineView start/stride analog;
+            # see FFConfig.enable_submesh)
+            mesh_axes["data_sub"] = 2
+            mesh_axes["data"] //= 2
         self._mesh = make_mesh(mesh_axes, devices)
 
         if strategy is None and cfg.import_strategy_file:
@@ -685,17 +693,27 @@ class FFModel:
 
     def _apply_strategy(self, graph, strategy) -> None:
         """Attach strategy views to nodes; unnamed INPUTs default to
-        batch-over-data sharding."""
-        data_degree = dict(
+        batch-over-data sharding (over the full data x data_sub group
+        when the submesh split is active and the batch divides it)."""
+        axis_sizes = dict(
             zip(self._mesh.axis_names, self._mesh.devices.shape)
-        ).get("data", 1)
+        )
+        data_degree = axis_sizes.get("data", 1)
         for n in graph.nodes:
             if strategy and n.name in strategy:
                 n.sharding = strategy[n.name]
-            elif n.op_type == OpType.INPUT and data_degree > 1:
+            elif n.op_type == OpType.INPUT and (
+                    data_degree > 1 or axis_sizes.get("data_sub", 1) > 1):
                 shape = n.outputs[0]
-                if shape.dims[0].size % data_degree == 0:
-                    n.sharding = ShardingView((batch_spec(shape.ndim),))
+                spec = data_batch_spec(shape.ndim, shape.dims[0].size,
+                                       axis_sizes)
+                deg = 1
+                for a in spec[0]:
+                    deg *= axis_sizes.get(a, 1)
+                # shard over the widest divisible group (possibly the
+                # data_sub-only subset); indivisible stays replicated
+                if deg > 1 and shape.dims[0].size % deg == 0:
+                    n.sharding = ShardingView((spec,))
 
     def _build_executor(self, graph) -> Executor:
         cfg = self.config

@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-AXIS_ORDER = ("pipe", "data", "expert", "seq", "model")
+AXIS_ORDER = ("pipe", "data", "data_sub", "expert", "seq", "model")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -45,6 +45,35 @@ def batch_spec(ndim: int, axis: str = "data") -> Spec:
     return ((axis,),) + tuple(() for _ in range(ndim - 1))
 
 
+def data_axes_for(dim0: int, axis_sizes) -> tuple:
+    """The batch-dim mesh axes a tensor of leading size `dim0` can use.
+
+    With submesh placement (FFConfig.enable_submesh) the data axis is
+    split into data x data_sub — the GSPMD analog of the reference's
+    MachineView{start_device_id, stride} device subsets
+    (include/flexflow/machine_view.h:14-96): an op whose batch dim only
+    divides the outer factor shards over ("data",) and stays REPLICATED
+    over data_sub, i.e. it runs on a device subset instead of silently
+    degrading to full replication (prune_spec's fallback)."""
+    sub = axis_sizes.get("data_sub", 1)
+    d = axis_sizes.get("data", 1)
+    if sub > 1 and dim0 % (d * sub) == 0:
+        return ("data", "data_sub")
+    if d > 1 and dim0 % d == 0:
+        return ("data",)
+    if sub > 1 and dim0 % sub == 0:
+        return ("data_sub",)
+    return ("data",)  # prune_spec degrades it to replicated at execution
+
+
+def data_batch_spec(ndim: int, dim0: int, axis_sizes) -> Spec:
+    """batch_spec over the full data x data_sub group when divisible,
+    else the largest usable subset (submesh placement)."""
+    return (data_axes_for(dim0, axis_sizes),) + tuple(
+        () for _ in range(ndim - 1)
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardingView:
     """Per-node strategy record assigned by the search (or default-DP).

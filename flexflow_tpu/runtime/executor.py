@@ -315,9 +315,12 @@ class Executor:
     # optimizer state (ZeRO-1 sharding)
 
     def _data_degree(self) -> int:
-        if self.mesh is None or "data" not in self.mesh.axis_names:
+        """Full data-group degree: data x data_sub when the submesh split
+        is active (ZeRO state shards over the whole group)."""
+        if self.mesh is None:
             return 1
-        return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))["data"]
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        return sizes.get("data", 1) * sizes.get("data_sub", 1)
 
     def opt_state_shardings(self, params):
         """Per-leaf NamedShardings for optimizer state trees that mirror
@@ -337,18 +340,24 @@ class Executor:
             sh = tr_sh.get(nk, {}).get(wn)
             return sh.spec if sh is not None else PartitionSpec()
 
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        data_group = tuple(a for a in ("data", "data_sub")
+                           if sizes.get(a, 1) > 1)
+
         def leaf_sharding(nk, wn, shape):
             if not self.zero_sharded_opt or ddeg <= 1 or not shape:
                 return NamedSharding(mesh, param_spec(nk, wn))
             spec = list(param_spec(nk, wn))
             spec += [None] * (len(shape) - len(spec))
-            # pick the largest dim not already sharded and divisible by data
+            # pick the largest dim not already sharded and divisible by
+            # the full data group (data x data_sub under the submesh split)
             best, best_size = -1, 0
             for i, (entry, size) in enumerate(zip(spec, shape)):
                 if entry is None and size % ddeg == 0 and size > best_size:
                     best, best_size = i, size
             if best >= 0:
-                spec[best] = "data"
+                spec[best] = (data_group if len(data_group) > 1
+                              else "data")
             return NamedSharding(mesh, PartitionSpec(*spec))
 
         def shardings_like(params_tree):
@@ -683,15 +692,25 @@ class Executor:
 
     def batch_sharding(self, ndim: int, batch_size: Optional[int] = None):
         """Sharding for a host batch array; None when the batch dim is not
-        divisible by the data-axis degree (then it stays replicated, matching
-        compile()'s input-view rule)."""
+        divisible by the data group (then it stays replicated, matching
+        compile()'s input-view rule). Under the submesh split the batch
+        rides the widest divisible data x data_sub group — the same spec
+        _apply_strategy assigns to INPUT nodes."""
         from jax.sharding import NamedSharding
 
-        if self.mesh is None or "data" not in self.mesh.axis_names:
+        from flexflow_tpu.parallel.sharding import data_batch_spec
+
+        if self.mesh is None:
             return None
-        degree = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))["data"]
-        if degree <= 1:
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        if sizes.get("data", 1) * sizes.get("data_sub", 1) <= 1:
             return None
-        if batch_size is not None and batch_size % degree != 0:
-            return None
-        return NamedSharding(self.mesh, spec_to_partition_spec(batch_spec(ndim)))
+        spec = (batch_spec(ndim) if batch_size is None
+                else data_batch_spec(ndim, batch_size, sizes))
+        if batch_size is not None:
+            deg = 1
+            for a in spec[0]:
+                deg *= sizes.get(a, 1)
+            if deg <= 1 or batch_size % deg != 0:
+                return None
+        return NamedSharding(self.mesh, spec_to_partition_spec(spec))

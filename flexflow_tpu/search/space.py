@@ -17,7 +17,13 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from flexflow_tpu.ffconst import OpType
-from flexflow_tpu.parallel.sharding import ShardingView, Spec, batch_spec, replicated_spec
+from flexflow_tpu.parallel.sharding import (
+    ShardingView,
+    Spec,
+    batch_spec,
+    data_batch_spec,
+    replicated_spec,
+)
 from flexflow_tpu.pcg.graph import Graph, Node
 
 
@@ -65,10 +71,26 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
     has_model = axis_sizes.get("model", 1) > 1 and param_parallel
     has_attr = axis_sizes.get("model", 1) > 1 and attr_parallel
     has_seq = axis_sizes.get("seq", 1) > 1
+    has_sub = axis_sizes.get("data_sub", 1) > 1
     has_expert = axis_sizes.get("expert", 1) > 1
     out_ndim = node.outputs[0].ndim if node.outputs else 2
-    dp = ShardingView((batch_spec(out_ndim),))
-    views = [dp]
+    dim0 = (node.outputs[0].dims[0].size
+            if node.outputs and node.outputs[0].dims else 0)
+    if has_sub:
+        # submesh placement (MachineView start/stride analog): the dp
+        # point shards over the widest divisible data x data_sub group;
+        # when the full group divides, the ("data",)-only SUBSET view is
+        # also offered — a small op can prefer fewer devices (it pays
+        # shorter collectives and still divides)
+        dp = ShardingView((data_batch_spec(out_ndim, dim0, axis_sizes),))
+        views = [dp]
+        full = (axis_sizes.get("data", 1)
+                * axis_sizes.get("data_sub", 1))
+        if dim0 and axis_sizes.get("data", 1) > 1 and dim0 % full == 0:
+            views.append(ShardingView((batch_spec(out_ndim),)))
+    else:
+        dp = ShardingView((batch_spec(out_ndim),))
+        views = [dp]
     t = node.op_type
 
     if t == OpType.LINEAR and has_model:
@@ -189,5 +211,8 @@ def default_dp_strategy(graph: Graph, axis_sizes: Dict[str, int]) -> Dict[str, S
     out = {}
     for n in graph.nodes:
         if n.outputs:
-            out[n.name] = ShardingView((batch_spec(n.outputs[0].ndim),))
+            dim0 = n.outputs[0].dims[0].size if n.outputs[0].dims else 0
+            out[n.name] = ShardingView(
+                (data_batch_spec(n.outputs[0].ndim, dim0, axis_sizes),)
+            )
     return out

@@ -1,0 +1,87 @@
+"""Per-op submesh placement (VERDICT r3 #8): the GSPMD analog of the
+reference MachineView{start_device_id, stride} device subsets
+(include/flexflow/machine_view.h:14-96). With FFConfig.enable_submesh the
+data axis splits into data x data_sub; an op whose batch dim divides only
+the outer factor shards over ("data",) — a device SUBSET, replicated
+across data_sub — instead of silently degrading to full replication."""
+
+import jax
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.ffconst import DataType
+from flexflow_tpu.parallel.sharding import ShardingView, data_batch_spec
+from flexflow_tpu.pcg.tensor import TensorShape  # noqa: F401 (docs)
+from flexflow_tpu.search.space import default_dp_strategy, enumerate_views
+
+
+def _axis_sizes():
+    return {"data": 4, "data_sub": 2}
+
+
+def test_data_batch_spec_picks_widest_divisible_group():
+    ax = _axis_sizes()
+    assert data_batch_spec(2, 8, ax)[0] == ("data", "data_sub")
+    assert data_batch_spec(2, 4, ax)[0] == ("data",)   # subset placement
+    assert data_batch_spec(2, 2, ax)[0] == ("data_sub",)
+    # indivisible: prune_spec later degrades to replicated
+    assert data_batch_spec(2, 3, ax)[0] == ("data",)
+
+
+def test_enumerate_views_offers_subset_point():
+    """A full-group-divisible op gets BOTH the 8-way dp view and the
+    ("data",)-only 4-way subset view — the search can place a small op on
+    fewer devices."""
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 16), DataType.FLOAT, name="x")
+    h = ff.dense(x, 8, name="h")
+    ff.graph.infer_shapes()
+    node = next(n for n in ff.graph.nodes if n.name == "h")
+    views = enumerate_views(node, _axis_sizes())
+    specs = {v.output_spec(0)[0] for v in views}
+    assert ("data", "data_sub") in specs
+    assert ("data",) in specs
+
+
+def test_submesh_op_prefers_subset_over_replication():
+    """An op with batch dim 4 on an 8-device data group cannot 8-way
+    shard; with the submesh split the default strategy places it on the
+    4-device subset instead of replicating."""
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 16), DataType.FLOAT, name="x")
+    h = ff.dense(x, 16, name="big")
+    # fold two samples together: batch dim becomes 4 — divides data(4)
+    # but not data(4) x data_sub(2)
+    r = ff.reshape(h, (4, 32), name="fold")
+    ff.dense(r, 4, name="small_head")
+    ff.graph.infer_shapes()
+    strat = default_dp_strategy(ff.graph, _axis_sizes())
+    assert strat["big"].output_spec(0)[0] == ("data", "data_sub")
+    assert strat["fold"].output_spec(0)[0] == ("data",)
+    assert strat["small_head"].output_spec(0)[0] == ("data",)
+
+
+def test_submesh_model_compiles_and_trains():
+    """End to end on the 8-device CPU mesh: enable_submesh splits the
+    mesh, the folded op runs on the 4-device subset, and the jitted step
+    executes."""
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 8},
+                   enable_submesh=True)
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 16), DataType.FLOAT, name="x")
+    h = ff.dense(x, 16, name="big")
+    r = ff.reshape(h, (4, 32), name="fold")
+    s = ff.dense(r, 8, name="small")
+    u = ff.reshape(s, (8, 4), name="unfold")
+    ff.softmax(u, name="sm")
+    strat = default_dp_strategy(ff.graph, _axis_sizes())
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy=strat)
+    assert dict(zip(ff.mesh.axis_names, ff.mesh.devices.shape)) == {
+        "data": 4, "data_sub": 2}
+    rs = np.random.RandomState(0)
+    xd = rs.randn(16, 16).astype(np.float32)
+    yd = (rs.rand(16) * 4).astype(np.int32)
+    m = ff.fit(xd, yd, epochs=1, verbose=False)
+    assert m.train_all == 16
