@@ -704,12 +704,12 @@ class FFModel:
                 n.sharding = strategy[n.name]
             elif n.op_type == OpType.INPUT and (
                     data_degree > 1 or axis_sizes.get("data_sub", 1) > 1):
+                from flexflow_tpu.parallel.sharding import group_degree
+
                 shape = n.outputs[0]
                 spec = data_batch_spec(shape.ndim, shape.dims[0].size,
                                        axis_sizes)
-                deg = 1
-                for a in spec[0]:
-                    deg *= axis_sizes.get(a, 1)
+                deg = group_degree(spec[0], axis_sizes)
                 # shard over the widest divisible group (possibly the
                 # data_sub-only subset); indivisible stays replicated
                 if deg > 1 and shape.dims[0].size % deg == 0:

@@ -74,6 +74,16 @@ def data_batch_spec(ndim: int, dim0: int, axis_sizes) -> Spec:
     )
 
 
+def group_degree(axes, axis_sizes) -> int:
+    """Product of the named axes' sizes — the sharding degree a dim-0
+    axes tuple implies (shared by input placement and host-batch
+    sharding so the two can never disagree)."""
+    d = 1
+    for a in axes:
+        d *= axis_sizes.get(a, 1)
+    return d
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardingView:
     """Per-node strategy record assigned by the search (or default-DP).

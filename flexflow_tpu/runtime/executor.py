@@ -355,9 +355,9 @@ class Executor:
             for i, (entry, size) in enumerate(zip(spec, shape)):
                 if entry is None and size % ddeg == 0 and size > best_size:
                     best, best_size = i, size
-            if best >= 0:
+            if best >= 0 and data_group:
                 spec[best] = (data_group if len(data_group) > 1
-                              else "data")
+                              else data_group[0])
             return NamedSharding(mesh, PartitionSpec(*spec))
 
         def shardings_like(params_tree):
@@ -698,19 +698,25 @@ class Executor:
         _apply_strategy assigns to INPUT nodes."""
         from jax.sharding import NamedSharding
 
-        from flexflow_tpu.parallel.sharding import data_batch_spec
+        from flexflow_tpu.parallel.sharding import (
+            data_batch_spec,
+            group_degree,
+        )
 
         if self.mesh is None:
             return None
         sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         if sizes.get("data", 1) * sizes.get("data_sub", 1) <= 1:
             return None
-        spec = (batch_spec(ndim) if batch_size is None
-                else data_batch_spec(ndim, batch_size, sizes))
-        if batch_size is not None:
-            deg = 1
-            for a in spec[0]:
-                deg *= sizes.get(a, 1)
+        if batch_size is None:
+            # legacy path (no divisibility info): plain data-axis sharding,
+            # only meaningful when the mesh actually has a data axis
+            if sizes.get("data", 1) <= 1:
+                return None
+            spec = batch_spec(ndim)
+        else:
+            spec = data_batch_spec(ndim, batch_size, sizes)
+            deg = group_degree(spec[0], sizes)
             if deg <= 1 or batch_size % deg != 0:
                 return None
         return NamedSharding(self.mesh, spec_to_partition_spec(spec))

@@ -91,6 +91,11 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
     else:
         dp = ShardingView((batch_spec(out_ndim),))
         views = [dp]
+    # every non-pure-DP view below batch-shards over the widest divisible
+    # data group (data x data_sub under the submesh split) so hybrid
+    # strategies keep full data-parallel width
+    bspec = (data_batch_spec(out_ndim, dim0, axis_sizes) if has_sub
+             else batch_spec(out_ndim))
     t = node.op_type
 
     if t == OpType.LINEAR and has_model:
@@ -100,18 +105,18 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
         # all-gather when the producer left the feature dim sharded).
         views.append(
             ShardingView(
-                (batch_spec(out_ndim)[:-1] + (("model",),),),
+                (bspec[:-1] + (("model",),),),
                 {"kernel": ((), ("model",)), "bias": (("model",),)},
-                input_specs=(batch_spec(out_ndim),),
+                input_specs=(bspec,),
             )
         )
         # row parallel (contraction dim sharded -> all-reduce after); the
         # consumed input arrives sharded on its last dim
         views.append(
             ShardingView(
-                (batch_spec(out_ndim),),
+                (bspec,),
                 {"kernel": (("model",), ()), "bias": ((),)},
-                input_specs=(batch_spec(out_ndim)[:-1] + (("model",),),),
+                input_specs=(bspec[:-1] + (("model",),),),
             )
         )
     elif t in (OpType.MULTIHEAD_ATTENTION, OpType.RING_ATTENTION) and (
@@ -121,26 +126,26 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
             # head (attribute) parallelism, activations batch-sharded
             views.append(
                 ShardingView(
-                    (batch_spec(out_ndim),),
+                    (bspec,),
                     {
                         "wq": ((), ("model",), ()),
                         "wk": ((), ("model",), ()),
                         "wv": ((), ("model",), ()),
                         "wo": (("model",), (), ()),
                     },
-                    input_specs=(batch_spec(out_ndim),) * 3,
+                    input_specs=(bspec,) * 3,
                 )
             )
     elif t == OpType.EMBEDDING and has_model:
         views.append(
             ShardingView(
-                (batch_spec(out_ndim),),
+                (bspec,),
                 {"kernel": ((), ("model",))},
             )
         )
         views.append(
             ShardingView(
-                (batch_spec(out_ndim),),
+                (bspec,),
                 {"kernel": (("model",), ())},  # vocab-sharded
             )
         )
@@ -162,7 +167,7 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
         ax = "expert" if has_expert else "model"
         views.append(
             ShardingView(
-                (batch_spec(out_ndim),),
+                (bspec,),
                 {"w1": ((ax,), (), ()), "w2": ((ax,), (), ())},
             )
         )
@@ -170,7 +175,7 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
         # output-channel (parameter) parallelism
         views.append(
             ShardingView(
-                ((("data",),) + (("model",),) + ((),) * (out_ndim - 2),),
+                ((bspec[0],) + (("model",),) + ((),) * (out_ndim - 2),),
                 {"kernel": (("model",), (), (), ()), "bias": (("model",),)},
             )
         )
@@ -181,7 +186,7 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
         # resharding; sharded softmax costs only tiny reduction collectives
         # which XLA emits (approximated as free here)
         views.append(
-            ShardingView((batch_spec(out_ndim)[:-1] + (("model",),),))
+            ShardingView((bspec[:-1] + (("model",),),))
         )
 
     # full-mesh DP: batch sharded over data AND model — the "use every chip
@@ -191,12 +196,15 @@ def enumerate_views(node: Node, axis_sizes: Dict[str, int],
     # Gated on batch divisibility: prune_spec drops the whole axes tuple at
     # execution when the dim doesn't divide, so an indivisible view would
     # be priced 8-way but run fully replicated.
-    full_deg = axis_sizes.get("data", 1) * axis_sizes.get("model", 1)
+    full_axes = bspec[0] + ("model",)
+    full_deg = 1
+    for a in full_axes:
+        full_deg *= axis_sizes.get(a, 1)
     if (axis_sizes.get("model", 1) > 1 and node.outputs
             and node.outputs[0].dims
             and node.outputs[0].dims[0].size % full_deg == 0):
         views.append(ShardingView(
-            ((("data", "model"),) + tuple(() for _ in range(out_ndim - 1)),)
+            ((full_axes,) + tuple(() for _ in range(out_ndim - 1)),)
         ))
 
     views = _seq_variants(views, out_ndim, has_seq)

@@ -38,7 +38,10 @@ def main():
     )
 
     if model == "mlp":
-        cfg = FFConfig(batch_size=16, mesh_shape={"data": 4, "model": 2},
+        # mesh scales with the process count (2 x nproc data shards over
+        # nproc hosts x 4 devices): the same worker exercises n=2 and n>2
+        cfg = FFConfig(batch_size=16,
+                       mesh_shape={"data": 2 * nproc, "model": 2},
                        search_budget=2, seed=11)
         ff = FFModel(cfg)
         x = ff.create_tensor((16, 32), name="x")

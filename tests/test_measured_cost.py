@@ -108,14 +108,22 @@ def test_collective_calibration_fits_ici_knobs():
     # depends on; tiny latency-bound payloads on the CPU backend's
     # emulated collectives are noisier than the bound)
     checked = 0
+    ratios = []
     for kind, axis, nn, nbytes, dt in cost._coll_samples:
         if nbytes < 1 << 16:
             continue
         modeled = cost.modeled_collective_time(kind, nbytes, nn)
         ratio = modeled / dt
         assert 0.3 <= ratio <= 3.0, (kind, nbytes, modeled, dt, ratio)
+        ratios.append(ratio)
         checked += 1
     assert checked >= 6
+    # the per-sample bound is loose (CPU-emulated collectives are noisy);
+    # the AGGREGATE fit must be much tighter — the median calibrated/
+    # measured ratio within 2x is what strategy ranking leans on
+    # (VERDICT r3 weak #8: ranking margins vs calibration slack)
+    med = sorted(ratios)[len(ratios) // 2]
+    assert 0.5 <= med <= 2.0, (med, ratios)
 
 
 def test_calibrate_with_mesh_returns_ici_knobs():

@@ -60,6 +60,19 @@ def test_multihost_mlp_two_processes():
     assert c0.split("correct=")[1] == c1.split("correct=")[1]
 
 
+def test_multihost_mlp_four_processes():
+    """n>2 hosts (VERDICT r3 weak #7): four processes x 4 devices form a
+    16-device data:8 x model:2 machine; strategy broadcast and per-host
+    feeding must agree across all four."""
+    outs = _run_workers("mlp", nproc=4, timeout=600)
+    corrects = set()
+    for i, out in enumerate(outs):
+        assert f"proc {i}: mlp OK" in out, out
+        corrects.add([l for l in out.splitlines()
+                      if "correct=" in l][0].split("correct=")[1])
+    assert len(corrects) == 1, corrects
+
+
 def test_multihost_llama_tiny_two_processes():
     outs = _run_workers("llama")
     for i, out in enumerate(outs):
