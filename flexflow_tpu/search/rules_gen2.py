@@ -962,14 +962,14 @@ def _parallel_family() -> List[Dict]:
 
     rules: List[Dict] = []
     # rank-4 activations (conv-style or attention-shaped)
-    for axis in ("model", "seq", "expert"):
+    for axis in ("model", "seq", "expert", "data_sub"):
         rules.append(_rule_linear_col_tp(axis, 4))
         rules.append(_rule_linear_row_tp(axis, 4))
         rules.append(_rule_megatron_mlp(axis, 4, fused=False))
         rules.append(_rule_megatron_mlp(axis, 4, fused=True))
         rules.append(_rule_gated_mlp(axis, 4))
     # embedding with a VOCAB-sharded table: partial-sum rows -> Reduction
-    for axis in ("model", "seq", "expert"):
+    for axis in ("model", "seq", "expert", "data_sub"):
         rules.append({
             "name": f"partition_embedding_vocab_{axis}",
             "requires_axis": axis,
@@ -996,7 +996,7 @@ def _parallel_family() -> List[Dict]:
         })
     # attention head-parallelism per axis (the declarative
     # create_partition_attention_combine, substitution.cc:1764)
-    for axis in ("model", "seq", "expert"):
+    for axis in ("model", "seq", "expert", "data_sub"):
         rules.append({
             "name": f"partition_attention_heads_{axis}",
             "requires_axis": axis,
@@ -1022,7 +1022,7 @@ def _parallel_family() -> List[Dict]:
             },
         })
     # fused EXPERTS bank sharded over an expert/model axis
-    for axis in ("expert", "model"):
+    for axis in ("expert", "model", "data_sub"):
         rules.append({
             "name": f"partition_experts_{axis}",
             "requires_axis": axis,
@@ -1047,7 +1047,7 @@ def _parallel_family() -> List[Dict]:
         })
     # conv2d row-TP: input-channel-sharded kernel + Reduction (the conv
     # analog of replicate_linear_reduce; NCHW kernel layout (f, c, kh, kw))
-    for axis in ("model", "seq", "expert"):
+    for axis in ("model", "seq", "expert", "data_sub"):
         rules.append({
             "name": f"replicate_conv2d_reduce_{axis}",
             "requires_axis": axis,
@@ -1077,7 +1077,7 @@ def _parallel_family() -> List[Dict]:
         })
     # ring attention with head-sharded projections (SP graphs can still
     # take head parallelism on an orthogonal axis)
-    for axis in ("model", "expert"):
+    for axis in ("model", "expert", "data_sub"):
         rules.append({
             "name": f"partition_ring_attention_heads_{axis}",
             "requires_axis": axis,
@@ -1105,7 +1105,7 @@ def _parallel_family() -> List[Dict]:
     # vocab-parallel lm head: col-TP linear + vocab-sharded softmax in one
     # move (the chain the per-node climber crosses two resharding barriers
     # to find)
-    for axis in ("model", "seq", "expert"):
+    for axis in ("model", "seq", "expert", "data_sub"):
         rules.append({
             "name": f"vocab_parallel_head_{axis}",
             "requires_axis": axis,
@@ -1140,7 +1140,7 @@ def _parallel_family() -> List[Dict]:
             },
         })
     # 5d batch-matmul partition (GQA grouped attention shapes)
-    for axis in ("model", "seq", "expert"):
+    for axis in ("model", "seq", "expert", "data_sub"):
         shard = [[axis]] + [[] for _ in range(4)]
         plain = [[] for _ in range(5)]
         rules.append({

@@ -1113,6 +1113,415 @@ def _misc_family() -> List[Dict]:
 
 
 # ---------------------------------------------------------------------------
+# family K: associativity across subtract/divide, scalar slides through
+# binaries, self-operand absorption, trig/exp double arguments, identity
+# eliminations, remaining CSE
+
+
+def _assoc_slide_family() -> List[Dict]:
+    rules: List[Dict] = []
+
+    def chain2(name, k_in, k_out, dst_in, dst_out):
+        """outer(inner(a,b), c) -> dst_out(a, dst_in(b, c)) — the
+        subtract/divide associativity folds (inner always on operand 0;
+        the dst wiring assumes it)."""
+        return {
+            "name": name,
+            "src": {
+                "nodes": [{"id": "i", "type": "ELEMENT_BINARY",
+                           "when": {"attr_eq": ["kind", k_in]}},
+                          {"id": "o", "type": "ELEMENT_BINARY",
+                           "when": {"attr_eq": ["kind", k_out]}}],
+                "edges": [["i", 0, "o", 0]],
+                "inputs": [["a", "i", 0], ["b", "i", 1],
+                           ["c", "o", 1]],
+                "outputs": [["o", 0]],
+            },
+            "dst": {
+                "nodes": [{"id": "j", "type": "ELEMENT_BINARY",
+                           "name": "{i}", "reuse": "i",
+                           "attrs": {"kind": dst_in}},
+                          {"id": "p", "type": "ELEMENT_BINARY",
+                           "name": "{o}", "reuse": "o",
+                           "attrs": {"kind": dst_out}}],
+                "edges": [["j", 0, "p", 1]],
+                "inputs": [["a", "p", 0], ["b", "j", 0], ["c", "j", 1]],
+                "outputs": [["p", 0]],
+            },
+        }
+
+    # (a-b)-c == a-(b+c); (a/b)/c == a/(b*c)
+    rules.append(chain2("assoc_subtract_fold", "subtract", "subtract",
+                        "add", "subtract"))
+    rules.append(chain2("assoc_divide_fold", "divide", "divide",
+                        "multiply", "divide"))
+    # (a-b)+c == a-(b-c); (a/b)*c == a/(b/c)
+    rules.append(chain2("slide_add_into_subtract", "subtract", "add",
+                        "subtract", "subtract"))
+    rules.append(chain2("slide_multiply_into_divide", "divide", "multiply",
+                        "divide", "divide"))
+
+    # scalar unaries slide through add/subtract:
+    #   (a # b) then scalar  ->  per-operand placement that preserves it
+    # scalar_add over add lands on ONE operand; scalar_mul distributes
+    for kind, bk, both in (
+            ("scalar_add", "add", False), ("scalar_add", "subtract", False),
+            ("scalar_sub", "add", False), ("scalar_sub", "subtract", False),
+            ("scalar_multiply", "add", True),
+            ("scalar_multiply", "subtract", True),
+            ("scalar_truediv", "add", True),
+            ("scalar_truediv", "subtract", True)):
+        dst_nodes = [{"id": "u1", "type": "ELEMENT_UNARY", "name": "{u}",
+                      "reuse": "u", "attrs": {"$copy": "u"}},
+                     _copy("b2", "b", "ELEMENT_BINARY")]
+        if both:
+            dst_nodes.append(_fresh("u2", "u", "ELEMENT_UNARY", "r"))
+            dst_edges = [["u1", 0, "b2", 0], ["u2", 0, "b2", 1]]
+            dst_inputs = [["a", "u1", 0], ["c", "u2", 0]]
+        else:
+            dst_edges = [["u1", 0, "b2", 0]]
+            dst_inputs = [["a", "u1", 0], ["c", "b2", 1]]
+        rules.append({
+            "name": f"slide_{kind}_through_{bk}",
+            "src": {
+                "nodes": [{"id": "b", "type": "ELEMENT_BINARY",
+                           "when": {"attr_eq": ["kind", bk]}},
+                          _unary_node("u", [kind])],
+                "edges": [["b", 0, "u", 0]],
+                "inputs": [["a", "b", 0], ["c", "b", 1]],
+                "outputs": [["u", 0]],
+            },
+            "dst": {
+                "nodes": dst_nodes,
+                "edges": dst_edges,
+                "inputs": dst_inputs,
+                "outputs": [["b2", 0]],
+            },
+        })
+
+    # self-operand absorption: max(x,x) == min(x,x) == x; x+x == 2x
+    for bk in ("max", "min"):
+        rules.append({
+            "name": f"collapse_{bk}_self",
+            "src": {
+                "nodes": [{"id": "b", "type": "ELEMENT_BINARY",
+                           "when": {"attr_eq": ["kind", bk]}}],
+                "inputs": [["x", "b", 0], ["x", "b", 1]],  # SHARED
+                "outputs": [["b", 0]],
+            },
+            "dst": {
+                "nodes": [{"id": "i", "type": "ELEMENT_UNARY", "name": "{b}",
+                           "reuse": "b", "attrs": {"kind": "identity",
+                                                   "scalar": 0.0}}],
+                "inputs": [["x", "i", 0]],
+                "outputs": [["i", 0]],
+            },
+        })
+    rules.append({
+        "name": "self_add_to_scalar_double",
+        "src": {
+            "nodes": [{"id": "b", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "add"]}}],
+            "inputs": [["x", "b", 0], ["x", "b", 1]],
+            "outputs": [["b", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY", "name": "{b}",
+                       "reuse": "b",
+                       "attrs": {"kind": "scalar_multiply",
+                                 "scalar": 2.0}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+    })
+    rules.append({
+        "name": "scalar_double_to_self_add",
+        "src": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["scalar_multiply"],
+                                "attr_eq": ["scalar", 2.0]}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "b", "type": "ELEMENT_BINARY", "name": "{u}",
+                       "reuse": "u", "attrs": {"kind": "add"}}],
+            "inputs": [["x", "b", 0], ["x", "b", 1]],
+            "outputs": [["b", 0]],
+        },
+    })
+
+    # exp(2x) == exp(x)^2 == exp(x)*exp(x); sin(2x) == 2 sin(x) cos(x)
+    rules.append({
+        "name": "split_exp_double_arg",
+        "src": {
+            "nodes": [{"id": "s", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["scalar_multiply"],
+                                "attr_eq": ["scalar", 2.0]}},
+                      _unary_node("e", ["exp"])],
+            "edges": [["s", 0, "e", 0]],
+            "inputs": [["x", "s", 0]],
+            "outputs": [["e", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("e2", "e", "ELEMENT_UNARY"),
+                      {"id": "m", "type": "ELEMENT_BINARY", "name": "{s}",
+                       "reuse": "s", "attrs": {"kind": "multiply"}}],
+            "edges": [["e2", 0, "m", 0], ["e2", 0, "m", 1]],
+            "inputs": [["x", "e2", 0]],
+            "outputs": [["m", 0]],
+        },
+    })
+    rules.append({
+        "name": "fuse_sin_double_angle",
+        "src": {
+            "nodes": [_unary_node("p1", ["sin"]), _unary_node("p2", ["cos"]),
+                      {"id": "m", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "multiply"]}},
+                      {"id": "d", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["scalar_multiply"],
+                                "attr_eq": ["scalar", 2.0]}}],
+            "edges": [["p1", 0, "m", 0], ["p2", 0, "m", 1],
+                      ["m", 0, "d", 0]],
+            "inputs": [["x", "p1", 0], ["x", "p2", 0]],  # SHARED x
+            "outputs": [["d", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "s2", "type": "ELEMENT_UNARY",
+                       "name": "{d}_arg",
+                       "attrs": {"kind": "scalar_multiply",
+                                 "scalar": 2.0}},
+                      {"id": "sn", "type": "ELEMENT_UNARY", "name": "{d}",
+                       "reuse": "d", "attrs": {"kind": "sin",
+                                               "scalar": 0.0}}],
+            "edges": [["s2", 0, "sn", 0]],
+            "inputs": [["x", "s2", 0]],
+            "outputs": [["sn", 0]],
+        },
+    })
+
+    # identity eliminations: a no-op pool, a same-shape reshape
+    rules.append({
+        "name": "drop_pool2d_identity",
+        "src": {
+            "nodes": [{"id": "p", "type": "POOL2D",
+                       "when": {"attr_eq": [["kernel", [1, 1]],
+                                            ["stride", [1, 1]],
+                                            ["padding", [0, 0]],
+                                            ["activation", "none"]]}}],
+            "inputs": [["x", "p", 0]],
+            "outputs": [["p", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "i", "type": "ELEMENT_UNARY", "name": "{p}",
+                       "reuse": "p", "attrs": {"kind": "identity",
+                                               "scalar": 0.0}}],
+            "inputs": [["x", "i", 0]],
+            "outputs": [["i", 0]],
+        },
+    })
+    rules.append({
+        "name": "drop_identity_reshape",
+        "src": {
+            "nodes": [{"id": "r", "type": "RESHAPE"}],
+            "inputs": [["x", "r", 0]],
+            "outputs": [["r", 0]],
+        },
+        "where": [{"kind": "reshape_identity", "args": ["r"]}],
+        "dst": {
+            "nodes": [{"id": "i", "type": "ELEMENT_UNARY", "name": "{r}",
+                       "reuse": "r", "attrs": {"kind": "identity",
+                                               "scalar": 0.0}}],
+            "inputs": [["x", "i", 0]],
+            "outputs": [["i", 0]],
+        },
+    })
+
+    # binary over same-shape reshapes
+    rules.append({
+        "name": "hoist_binary_over_reshape",
+        "src": {
+            "nodes": [{"id": "r1", "type": "RESHAPE"},
+                      {"id": "r2", "type": "RESHAPE"},
+                      {"id": "b", "type": "ELEMENT_BINARY"}],
+            "edges": [["r1", 0, "b", 0], ["r2", 0, "b", 1]],
+            "inputs": [["x", "r1", 0], ["y", "r2", 0]],
+            "outputs": [["b", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["r1", "r2", "shape"]},
+                  {"kind": "first_inputs_same_shape",
+                   "args": ["r1", "r2"]}],
+        "dst": {
+            "nodes": [_copy("b2", "b", "ELEMENT_BINARY"),
+                      _copy("r", "r1", "RESHAPE")],
+            "edges": [["b2", 0, "r", 0]],
+            "inputs": [["x", "b2", 0], ["y", "b2", 1]],
+            "outputs": [["r", 0]],
+        },
+    })
+    rules.append({
+        "name": "distribute_reshape_over_binary",
+        "src": {
+            "nodes": [{"id": "b", "type": "ELEMENT_BINARY"},
+                      {"id": "r", "type": "RESHAPE"}],
+            "edges": [["b", 0, "r", 0]],
+            "inputs": [["x", "b", 0], ["y", "b", 1]],
+            "outputs": [["r", 0]],
+        },
+        "where": [{"kind": "inputs_same_shape", "args": ["b"]}],
+        "dst": {
+            "nodes": [_copy("r1", "r", "RESHAPE"),
+                      _fresh("r2", "r", "RESHAPE", "b"),
+                      _copy("b2", "b", "ELEMENT_BINARY")],
+            "edges": [["r1", 0, "b2", 0], ["r2", 0, "b2", 1]],
+            "inputs": [["x", "r1", 0], ["y", "r2", 0]],
+            "outputs": [["b2", 0]],
+        },
+    })
+
+    # slide scalar_multiply into the bmm RIGHT operand (the left-operand
+    # slide ships in gen2)
+    rules.append({
+        "name": "slide_scalar_mul_out_of_bmm_rhs",
+        "src": {
+            "nodes": [_unary_node("u", ["scalar_multiply"]),
+                      {"id": "m", "type": "BATCH_MATMUL",
+                       "when": _bmm_when()}],
+            "edges": [["u", 0, "m", 1]],
+            "inputs": [["a", "m", 0], ["b", "u", 0]],
+            "outputs": [["m", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("m2", "m", "BATCH_MATMUL"),
+                      _copy("u2", "u", "ELEMENT_UNARY")],
+            "edges": [["m2", 0, "u2", 0]],
+            "inputs": [["a", "m2", 0], ["b", "m2", 1]],
+            "outputs": [["u2", 0]],
+        },
+    })
+    rules.append({
+        "name": "slide_scalar_mul_into_bmm_rhs",
+        "src": {
+            "nodes": [{"id": "m", "type": "BATCH_MATMUL",
+                       "when": _bmm_when()},
+                      _unary_node("u", ["scalar_multiply"])],
+            "edges": [["m", 0, "u", 0]],
+            "inputs": [["a", "m", 0], ["b", "m", 1]],
+            "outputs": [["u", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("u2", "u", "ELEMENT_UNARY"),
+                      _copy("m2", "m", "BATCH_MATMUL")],
+            "edges": [["u2", 0, "m2", 1]],
+            "inputs": [["a", "m2", 0], ["b", "u2", 0]],
+            "outputs": [["m2", 0]],
+        },
+    })
+
+    # remaining weightless CSE
+    rules.append({
+        "name": "cse_flat",
+        "src": {
+            "nodes": [{"id": "a", "type": "FLAT"},
+                      {"id": "b", "type": "FLAT"}],
+            "edges": [],
+            "inputs": [["x", "a", 0], ["x", "b", 0]],
+            "outputs": [["a", 0], ["b", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "n", "type": "FLAT", "reuse": "a",
+                       "name": "{a}", "attrs": {"$copy": "a"}}],
+            "inputs": [["x", "n", 0]],
+            "outputs": [["n", 0], ["n", 0]],
+        },
+    })
+    rules.append({
+        "name": "cse_layernorm_noaffine",
+        "src": {
+            "nodes": [{"id": "a", "type": "LAYER_NORM",
+                       "when": {"attr_eq": ["elementwise_affine", False]}},
+                      {"id": "b", "type": "LAYER_NORM",
+                       "when": {"attr_eq": ["elementwise_affine", False]}}],
+            "edges": [],
+            "inputs": [["x", "a", 0], ["x", "b", 0]],
+            "outputs": [["a", 0], ["b", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["a", "b", "axes"]},
+                  {"kind": "attrs_equal", "args": ["a", "b", "eps"]}],
+        "dst": {
+            "nodes": [{"id": "n", "type": "LAYER_NORM", "reuse": "a",
+                       "name": "{a}", "attrs": {"$copy": "a"}}],
+            "inputs": [["x", "n", 0]],
+            "outputs": [["n", 0], ["n", 0]],
+        },
+    })
+    rules.append({
+        "name": "cse_dropout_zero",
+        "src": {
+            "nodes": [{"id": "a", "type": "DROPOUT",
+                       "when": {"attr_eq": ["rate", 0.0]}},
+                      {"id": "b", "type": "DROPOUT",
+                       "when": {"attr_eq": ["rate", 0.0]}}],
+            "edges": [],
+            "inputs": [["x", "a", 0], ["x", "b", 0]],
+            "outputs": [["a", 0], ["b", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "n", "type": "DROPOUT", "reuse": "a",
+                       "name": "{a}", "attrs": {"$copy": "a"}}],
+            "inputs": [["x", "n", 0]],
+            "outputs": [["n", 0], ["n", 0]],
+        },
+    })
+
+    # unary over a 3-way concat (the 2-way template ships in gen2)
+    rules.append({
+        "name": "distribute_unary_over_concat3",
+        "src": {
+            "nodes": [{"id": "cat", "type": "CONCAT"},
+                      _unary_node("u")],
+            "edges": [["cat", 0, "u", 0]],
+            "inputs": [["a", "cat", 0], ["b", "cat", 1], ["c", "cat", 2]],
+            "outputs": [["u", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("u1", "u", "ELEMENT_UNARY"),
+                      _fresh("u2", "u", "ELEMENT_UNARY", "r"),
+                      _fresh("u3", "u", "ELEMENT_UNARY", "s"),
+                      _copy("cat2", "cat", "CONCAT")],
+            "edges": [["u1", 0, "cat2", 0], ["u2", 0, "cat2", 1],
+                      ["u3", 0, "cat2", 2]],
+            "inputs": [["a", "u1", 0], ["b", "u2", 0], ["c", "u3", 0]],
+            "outputs": [["cat2", 0]],
+        },
+    })
+    rules.append({
+        "name": "hoist_unary_over_concat3",
+        "src": {
+            "nodes": [_unary_node("u1"), _unary_node("u2"),
+                      _unary_node("u3"),
+                      {"id": "cat", "type": "CONCAT"}],
+            "edges": [["u1", 0, "cat", 0], ["u2", 0, "cat", 1],
+                      ["u3", 0, "cat", 2]],
+            "inputs": [["a", "u1", 0], ["b", "u2", 0], ["c", "u3", 0]],
+            "outputs": [["cat", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["u1", "u2", "u3", "kind"]},
+                  {"kind": "attrs_equal",
+                   "args": ["u1", "u2", "u3", "scalar"]}],
+        "dst": {
+            "nodes": [_copy("cat2", "cat", "CONCAT"),
+                      _copy("u", "u1", "ELEMENT_UNARY")],
+            "edges": [["cat2", 0, "u", 0]],
+            "inputs": [["a", "cat2", 0], ["b", "cat2", 1],
+                       ["c", "cat2", 2]],
+            "outputs": [["u", 0]],
+        },
+    })
+    return rules
+
+
+# ---------------------------------------------------------------------------
 
 
 def extra_rules3() -> List[Dict]:
@@ -1129,6 +1538,7 @@ def extra_rules3() -> List[Dict]:
         + _bmm_concat_family()
         + _weighted_merge_family()
         + _misc_family()
+        + _assoc_slide_family()
     )
     names = [r["name"] for r in rules]
     assert len(names) == len(set(names)), "duplicate rule names in gen3"

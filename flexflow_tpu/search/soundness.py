@@ -112,6 +112,8 @@ def _default_attrs(op: OpType, in_shapes: List, ov: Dict,
         return A.ElementBinaryAttrs(get("kind", "add"))
     if op == OpType.RESHAPE:
         dims = [d.size for d in in_shapes[0].dims]
+        if "identity" in rule_name:  # where reshape_identity: same shape
+            return A.ReshapeAttrs(tuple(dims))
         if len(dims) == 1:  # chain partner: split a flattened input back
             return A.ReshapeAttrs((2, dims[0] // 2))
         return A.ReshapeAttrs(tuple([dims[0] * dims[1]] + dims[2:]))
@@ -162,6 +164,8 @@ def _default_attrs(op: OpType, in_shapes: List, ov: Dict,
         return A.DropoutAttrs(float(get("rate", 0.0)))
     if op == OpType.GATHER:
         return A.GatherAttrs(int(get("axis", -1)))
+    if op == OpType.FLAT:
+        return A.FlatAttrs()
     if op == OpType.TOPK:
         return A.TopKAttrs(int(get("k", 3)), bool(get("sorted", True)))
     if op in (OpType.REDUCE_SUM, OpType.MEAN):
@@ -212,6 +216,8 @@ _BMM_SHAPES = {
     "assoc_bmm_right": {"a": (2, 3, 4), "b": (2, 4, 5), "c": (2, 5, 6)},
     "slide_scalar_mul_out_of_bmm": {"a": (2, 3, 4), "b": (2, 4, 5)},
     "slide_scalar_mul_into_bmm": {"a": (2, 3, 4), "b": (2, 4, 5)},
+    "slide_scalar_mul_out_of_bmm_rhs": {"a": (2, 3, 4), "b": (2, 4, 5)},
+    "slide_scalar_mul_into_bmm_rhs": {"a": (2, 3, 4), "b": (2, 4, 5)},
     "transpose_of_bmm": {"a": (2, 3, 4), "b": (2, 4, 5)},
     "bmm_of_transposes": {"a": (2, 3, 4), "b": (2, 4, 5)},
     "cse_batch_matmul": {"x": (2, 3, 4), "y": (2, 4, 5)},

@@ -51,6 +51,7 @@ ATTRS_CLASSES: Dict[OpType, type] = {
     OpType.ELEMENT_UNARY: A.ElementUnaryAttrs,
     OpType.ELEMENT_BINARY: A.ElementBinaryAttrs,
     OpType.RESHAPE: A.ReshapeAttrs,
+    OpType.FLAT: A.FlatAttrs,
     OpType.TRANSPOSE: A.TransposeAttrs,
     OpType.REVERSE: A.ReverseAttrs,
     OpType.CONCAT: A.ConcatAttrs,
@@ -262,6 +263,27 @@ def _where_inputs_same_dtype(nodes: Dict[str, Node], args) -> bool:
     return all(d == dts[0] for d in dts)
 
 
+def _where_reshape_identity(nodes: Dict[str, Node], args) -> bool:
+    """The reshape's target shape equals its input shape (a no-op)."""
+    n = nodes[args[0]]
+    if not n.in_shapes:
+        return False
+    return tuple(n.attrs.shape) == tuple(
+        d.size for d in n.in_shapes[0].dims)
+
+
+def _where_first_inputs_same_shape(nodes: Dict[str, Node], args) -> bool:
+    """Every listed node's FIRST input has the same shape (hoisting an op
+    over a binary requires the operands it was applied to to agree)."""
+    shapes = []
+    for a in args:
+        n = nodes[a]
+        if not n.in_shapes:
+            return False
+        shapes.append(tuple(d.size for d in n.in_shapes[0].dims))
+    return all(s == shapes[0] for s in shapes)
+
+
 def _where_concat_piece_sizes_match(nodes: Dict[str, Node], args) -> bool:
     """Two concats (possibly on DIFFERENT axes) split into pairwise
     equal-sized pieces along each one's own axis — block rewrites (bmm
@@ -318,6 +340,8 @@ WHERE_PREDICATES: Dict[str, Callable[[Dict[str, Node], Any], bool]] = {
     "inputs_same_shape": _where_inputs_same_shape,
     "reverse_axis_reduced": _where_reverse_axis_reduced,
     "concat_piece_sizes_match": _where_concat_piece_sizes_match,
+    "reshape_identity": _where_reshape_identity,
+    "first_inputs_same_shape": _where_first_inputs_same_shape,
     "reverse_axis_not_last": _where_reverse_axis_not_last,
     "perms_inverse": _where_perms_inverse,
     "attrs_equal": _where_attrs_equal,
@@ -1054,11 +1078,11 @@ def gen_default_rules() -> List[Dict]:
     # linear column/row TP per mesh axis and activation rank (the
     # hand-coded builders in substitution.py cover only "model"; these give
     # the search the same moves on seq/expert axes of exotic meshes)
-    for axis in ("seq", "expert"):
+    for axis in ("seq", "expert", "data_sub"):
         for ndim in (2, 3):
             rules.append(_rule_linear_col_tp(axis, ndim))
             rules.append(_rule_linear_row_tp(axis, ndim))
-    for axis in ("model", "seq", "expert"):
+    for axis in ("model", "seq", "expert", "data_sub"):
         # conv2d output-channel TP + combine on the channel dim
         rules.append({
             "name": f"partition_conv2d_combine_{axis}",
@@ -1118,7 +1142,7 @@ def gen_default_rules() -> List[Dict]:
         })
 
     # --- TP chain rules: the one-move Megatron/Llama rewrites -----------
-    for axis in ("model", "seq", "expert"):
+    for axis in ("model", "seq", "expert", "data_sub"):
         for ndim in (2, 3):
             rules.append(_rule_megatron_mlp(axis, ndim, fused=False))
             rules.append(_rule_megatron_mlp(axis, ndim, fused=True))
@@ -1385,7 +1409,7 @@ def gen_default_rules() -> List[Dict]:
 
     # --- batch-matmul batch-dim partition (attention scores/values on a
     # hand-built BMM path shard over the batch*heads dim) -----------------
-    for axis in ("model", "seq", "expert"):
+    for axis in ("model", "seq", "expert", "data_sub"):
         for ndim in (3, 4):
             shard = [[axis]] + [[] for _ in range(ndim - 1)]
             plain = [[] for _ in range(ndim)]
