@@ -1522,6 +1522,252 @@ def _assoc_slide_family() -> List[Dict]:
 
 
 # ---------------------------------------------------------------------------
+# family L: exact unary compositions + layout identity eliminations
+
+
+def _unary_identity_family() -> List[Dict]:
+    rules: List[Dict] = []
+
+    def compose2(name, k1, k2, out_kind):
+        """k2(k1(x)) == out_kind(x) (exact pointwise identity)."""
+        return {
+            "name": name,
+            "src": {
+                "nodes": [_unary_node("u1", [k1]), _unary_node("u2", [k2])],
+                "edges": [["u1", 0, "u2", 0]],
+                "inputs": [["x", "u1", 0]],
+                "outputs": [["u2", 0]],
+            },
+            "dst": {
+                "nodes": [{"id": "u", "type": "ELEMENT_UNARY",
+                           "name": "{u1}", "reuse": "u1",
+                           "attrs": {"kind": out_kind, "scalar": 0.0}}],
+                "inputs": [["x", "u", 0]],
+                "outputs": [["u", 0]],
+            },
+        }
+
+    # elu is identity on [0, inf): elu(relu(x)) == relu(x); and
+    # relu(elu(x)) == relu(x) (elu < 0 exactly where x < 0)
+    rules.append(compose2("collapse_elu_after_relu", "relu", "elu", "relu"))
+    rules.append(compose2("collapse_relu_after_elu", "elu", "relu", "relu"))
+    # (x^2)^2 == x^4
+    rules.append({
+        "name": "compose_pow_2_2",
+        "src": {
+            "nodes": [{"id": "u1", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["pow"],
+                                "attr_eq": ["scalar", 2.0]}},
+                      {"id": "u2", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["pow"],
+                                "attr_eq": ["scalar", 2.0]}}],
+            "edges": [["u1", 0, "u2", 0]],
+            "inputs": [["x", "u1", 0]],
+            "outputs": [["u2", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "u", "type": "ELEMENT_UNARY", "name": "{u1}",
+                       "reuse": "u1",
+                       "attrs": {"kind": "pow", "scalar": 4.0}}],
+            "inputs": [["x", "u", 0]],
+            "outputs": [["u", 0]],
+        },
+    })
+    # cos(x) == sin(x + pi/2), both directions
+    rules.append({
+        "name": "cos_to_shifted_sin",
+        "src": {
+            "nodes": [_unary_node("c", ["cos"])],
+            "inputs": [["x", "c", 0]],
+            "outputs": [["c", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "sh", "type": "ELEMENT_UNARY",
+                       "name": "{c}_shift",
+                       "attrs": {"kind": "scalar_add",
+                                 "scalar": 1.5707963267948966}},
+                      {"id": "s", "type": "ELEMENT_UNARY", "name": "{c}",
+                       "reuse": "c", "attrs": {"kind": "sin",
+                                               "scalar": 0.0}}],
+            "edges": [["sh", 0, "s", 0]],
+            "inputs": [["x", "sh", 0]],
+            "outputs": [["s", 0]],
+        },
+    })
+    rules.append({
+        "name": "shifted_sin_to_cos",
+        "src": {
+            "nodes": [{"id": "sh", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["scalar_add"],
+                                "attr_eq": ["scalar",
+                                            1.5707963267948966]}},
+                      _unary_node("s", ["sin"])],
+            "edges": [["sh", 0, "s", 0]],
+            "inputs": [["x", "sh", 0]],
+            "outputs": [["s", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "c", "type": "ELEMENT_UNARY", "name": "{s}",
+                       "reuse": "s", "attrs": {"kind": "cos",
+                                               "scalar": 0.0}}],
+            "inputs": [["x", "c", 0]],
+            "outputs": [["c", 0]],
+        },
+    })
+    # tanh(x) == 2*sigmoid(2x) - 1, both directions
+    rules.append({
+        "name": "tanh_to_sigmoid",
+        "src": {
+            "nodes": [_unary_node("t", ["tanh"])],
+            "inputs": [["x", "t", 0]],
+            "outputs": [["t", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "d", "type": "ELEMENT_UNARY",
+                       "name": "{t}_arg",
+                       "attrs": {"kind": "scalar_multiply", "scalar": 2.0}},
+                      {"id": "g", "type": "ELEMENT_UNARY",
+                       "name": "{t}_gate",
+                       "attrs": {"kind": "sigmoid", "scalar": 0.0}},
+                      {"id": "m", "type": "ELEMENT_UNARY",
+                       "name": "{t}_scale",
+                       "attrs": {"kind": "scalar_multiply", "scalar": 2.0}},
+                      {"id": "o", "type": "ELEMENT_UNARY", "name": "{t}",
+                       "reuse": "t",
+                       "attrs": {"kind": "scalar_sub", "scalar": 1.0}}],
+            "edges": [["d", 0, "g", 0], ["g", 0, "m", 0], ["m", 0, "o", 0]],
+            "inputs": [["x", "d", 0]],
+            "outputs": [["o", 0]],
+        },
+    })
+    rules.append({
+        "name": "sigmoid_chain_to_tanh",
+        "src": {
+            "nodes": [{"id": "d", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["scalar_multiply"],
+                                "attr_eq": ["scalar", 2.0]}},
+                      _unary_node("g", ["sigmoid"]),
+                      {"id": "m", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["scalar_multiply"],
+                                "attr_eq": ["scalar", 2.0]}},
+                      {"id": "o", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["scalar_sub"],
+                                "attr_eq": ["scalar", 1.0]}}],
+            "edges": [["d", 0, "g", 0], ["g", 0, "m", 0], ["m", 0, "o", 0]],
+            "inputs": [["x", "d", 0]],
+            "outputs": [["o", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "t", "type": "ELEMENT_UNARY", "name": "{o}",
+                       "reuse": "o", "attrs": {"kind": "tanh",
+                                               "scalar": 0.0}}],
+            "inputs": [["x", "t", 0]],
+            "outputs": [["t", 0]],
+        },
+    })
+    # relu(x) - relu(-x) == x
+    rules.append({
+        "name": "relu_decomposition_to_identity",
+        "src": {
+            "nodes": [_unary_node("p", ["relu"]),
+                      {"id": "n", "type": "ELEMENT_UNARY",
+                       "when": {"unary_kind": ["scalar_multiply"],
+                                "attr_eq": ["scalar", -1.0]}},
+                      _unary_node("q", ["relu"]),
+                      {"id": "s", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "subtract"]}}],
+            "edges": [["n", 0, "q", 0], ["p", 0, "s", 0], ["q", 0, "s", 1]],
+            "inputs": [["x", "p", 0], ["x", "n", 0]],  # SHARED x
+            "outputs": [["s", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "i", "type": "ELEMENT_UNARY", "name": "{s}",
+                       "reuse": "s", "attrs": {"kind": "identity",
+                                               "scalar": 0.0}}],
+            "inputs": [["x", "i", 0]],
+            "outputs": [["i", 0]],
+        },
+    })
+    # max(a,b) + min(a,b) == a + b (shared operands)
+    rules.append({
+        "name": "max_plus_min_to_add",
+        "src": {
+            "nodes": [{"id": "mx", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "max"]}},
+                      {"id": "mn", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "min"]}},
+                      {"id": "s", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "add"]}}],
+            "edges": [["mx", 0, "s", 0], ["mn", 0, "s", 1]],
+            "inputs": [["a", "mx", 0], ["b", "mx", 1],
+                       ["a", "mn", 0], ["b", "mn", 1]],
+            "outputs": [["s", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "p", "type": "ELEMENT_BINARY", "name": "{s}",
+                       "reuse": "s", "attrs": {"kind": "add"}}],
+            "inputs": [["a", "p", 0], ["b", "p", 1]],
+            "outputs": [["p", 0]],
+        },
+    })
+    # a - b == -(b - a)
+    rules.append({
+        "name": "anticommute_subtract",
+        "src": {
+            "nodes": [{"id": "s", "type": "ELEMENT_BINARY",
+                       "when": {"attr_eq": ["kind", "subtract"]}}],
+            "inputs": [["a", "s", 0], ["b", "s", 1]],
+            "outputs": [["s", 0]],
+        },
+        "dst": {
+            "nodes": [{"id": "r", "type": "ELEMENT_BINARY", "name": "{s}",
+                       "reuse": "s", "attrs": {"kind": "subtract"}},
+                      {"id": "n", "type": "ELEMENT_UNARY",
+                       "name": "{s}_neg",
+                       "attrs": {"kind": "scalar_multiply",
+                                 "scalar": -1.0}}],
+            "edges": [["r", 0, "n", 0]],
+            "inputs": [["b", "r", 0], ["a", "r", 1]],
+            "outputs": [["n", 0]],
+        },
+    })
+    # identity layout eliminations
+    rules.append({
+        "name": "drop_identity_transpose",
+        "src": {
+            "nodes": [{"id": "t", "type": "TRANSPOSE"}],
+            "inputs": [["x", "t", 0]],
+            "outputs": [["t", 0]],
+        },
+        "where": [{"kind": "transpose_identity", "args": ["t"]}],
+        "dst": {
+            "nodes": [{"id": "i", "type": "ELEMENT_UNARY", "name": "{t}",
+                       "reuse": "t", "attrs": {"kind": "identity",
+                                               "scalar": 0.0}}],
+            "inputs": [["x", "i", 0]],
+            "outputs": [["i", 0]],
+        },
+    })
+    rules.append({
+        "name": "drop_identity_split",
+        "src": {
+            "nodes": [{"id": "sp", "type": "SPLIT"}],
+            "inputs": [["x", "sp", 0]],
+            "outputs": [["sp", 0]],
+        },
+        "where": [{"kind": "split_identity", "args": ["sp"]}],
+        "dst": {
+            "nodes": [{"id": "i", "type": "ELEMENT_UNARY", "name": "{sp}",
+                       "reuse": "sp", "attrs": {"kind": "identity",
+                                                "scalar": 0.0}}],
+            "inputs": [["x", "i", 0]],
+            "outputs": [["i", 0]],
+        },
+    })
+    return rules
+
+
+# ---------------------------------------------------------------------------
 
 
 def extra_rules3() -> List[Dict]:
@@ -1539,6 +1785,7 @@ def extra_rules3() -> List[Dict]:
         + _weighted_merge_family()
         + _misc_family()
         + _assoc_slide_family()
+        + _unary_identity_family()
     )
     names = [r["name"] for r in rules]
     assert len(names) == len(set(names)), "duplicate rule names in gen3"

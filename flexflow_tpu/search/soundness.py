@@ -77,7 +77,8 @@ def _pairs(spec):
 
 def _default_attrs(op: OpType, in_shapes: List, ov: Dict,
                    n_outputs: int, rule_name: str,
-                   adversarial: bool = False):
+                   adversarial: bool = False,
+                   where_kinds: frozenset = frozenset()):
     """Concrete attrs for a pattern node given its input shapes and the
     overrides derived from its `when` clause. `adversarial` flips every
     non-pinned default toward the configuration MOST likely to break an
@@ -112,7 +113,7 @@ def _default_attrs(op: OpType, in_shapes: List, ov: Dict,
         return A.ElementBinaryAttrs(get("kind", "add"))
     if op == OpType.RESHAPE:
         dims = [d.size for d in in_shapes[0].dims]
-        if "identity" in rule_name:  # where reshape_identity: same shape
+        if "reshape_identity" in where_kinds:  # guard needs same shape
             return A.ReshapeAttrs(tuple(dims))
         if len(dims) == 1:  # chain partner: split a flattened input back
             return A.ReshapeAttrs((2, dims[0] // 2))
@@ -120,7 +121,9 @@ def _default_attrs(op: OpType, in_shapes: List, ov: Dict,
     if op == OpType.TRANSPOSE:
         perm = get("perm", None)
         if perm is None:
-            if adversarial and nd > 1:
+            if "transpose_identity" in where_kinds:
+                perm = tuple(range(nd))
+            elif adversarial and nd > 1:
                 perm = tuple(range(1, nd)) + (0,)   # MOVES the last axis
             else:
                 # fix the last axis (satisfies perm_fixes_last)
@@ -134,12 +137,15 @@ def _default_attrs(op: OpType, in_shapes: List, ov: Dict,
     if op == OpType.SPLIT:
         ax = int(get("axis", 1 if nd > 1 else 0))
         total = in_shapes[0].dims[ax].size
-        n = max(n_outputs, 2)
+        # identity rules need the degenerate 1-way split; everything else
+        # wants a real split even when only one output is consumed
+        n = (max(n_outputs, 1) if "split_identity" in where_kinds
+             else max(n_outputs, 2))
         part = total // n
         sizes = [part] * (n - 1) + [total - part * (n - 1)]
         return A.SplitAttrs(tuple(sizes), ax)
     if op == OpType.CAST:
-        if "identity" in rule_name:  # where cast_identity: dtype == input's
+        if "cast_identity" in where_kinds:  # dtype == input's
             return A.CastAttrs(in_shapes[0].dtype)
         dflt = DataType.HALF if adversarial else DataType.DOUBLE  # narrowing
         return A.CastAttrs(get("dtype", dflt))
@@ -305,8 +311,12 @@ def instantiate_rule(rule: Dict, profile_nd: int = 2,
             if op == OpType.BATCH_MATMUL:
                 attrs = A.BatchMatmulAttrs()
             else:
-                attrs = _default_attrs(op, in_shapes, ov, n_out, name,
-                                       adversarial=adversarial)
+                attrs = _default_attrs(
+                    op, in_shapes, ov, n_out, name,
+                    adversarial=adversarial,
+                    where_kinds=frozenset(
+                        w.get("kind") for w in rule.get("where", ())),
+                )
             node = g.create_node(op, attrs, pid)
             for (didx, producer, si) in ins:
                 g.add_edge(producer, node, si, didx)

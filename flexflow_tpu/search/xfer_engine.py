@@ -272,6 +272,17 @@ def _where_reshape_identity(nodes: Dict[str, Node], args) -> bool:
         d.size for d in n.in_shapes[0].dims)
 
 
+def _where_transpose_identity(nodes: Dict[str, Node], args) -> bool:
+    """perm is the identity permutation (a no-op transpose)."""
+    perm = getattr(nodes[args[0]].attrs, "perm", None)
+    return perm is not None and tuple(perm) == tuple(range(len(perm)))
+
+
+def _where_split_identity(nodes: Dict[str, Node], args) -> bool:
+    """A 1-way split (the whole tensor in one piece) is a no-op."""
+    return len(nodes[args[0]].attrs.sizes) == 1
+
+
 def _where_first_inputs_same_shape(nodes: Dict[str, Node], args) -> bool:
     """Every listed node's FIRST input has the same shape (hoisting an op
     over a binary requires the operands it was applied to to agree)."""
@@ -341,6 +352,8 @@ WHERE_PREDICATES: Dict[str, Callable[[Dict[str, Node], Any], bool]] = {
     "reverse_axis_reduced": _where_reverse_axis_reduced,
     "concat_piece_sizes_match": _where_concat_piece_sizes_match,
     "reshape_identity": _where_reshape_identity,
+    "transpose_identity": _where_transpose_identity,
+    "split_identity": _where_split_identity,
     "first_inputs_same_shape": _where_first_inputs_same_shape,
     "reverse_axis_not_last": _where_reverse_axis_not_last,
     "perms_inverse": _where_perms_inverse,
