@@ -843,6 +843,9 @@ class FFModel:
             "modeled_ms": [candidates[r[1]][0] * 1e3 for r in results],
             "picked_modeled_rank": win[1],
             "picked_timed_index": results.index(win),
+            # search-cost observability (wall time, expansions, baseline)
+            # so gate records carry regression signals as the corpus grows
+            "search": dict(self.search_stats),
         }
         if self.config.profiling:
             timed = ", ".join(f"{r[0]*1e3:.2f}" for r in results)
