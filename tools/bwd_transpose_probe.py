@@ -1,0 +1,98 @@
+"""Backward-pass transpose probe for the 1b bench config.
+
+BENCH_r02/r03 analysis: at the ~0.9B Llama config the fwd+bwd floor is
+~305-310 ms vs ~229 ideal, with ~26 ms of backward-pass transposes and
+~15 ms of copies; the named levers are the wo / down-projection einsum
+operand orders. This probe times candidate formulations of each suspect
+matmul (fwd + grad) in isolation on the local chip so the winning layout
+can be applied to the lowerings with evidence.
+
+Each candidate computes the SAME function; only operand layout/contraction
+order differs — XLA may or may not insert explicit transposes per variant.
+
+Usage: python tools/bwd_transpose_probe.py [--platform tpu|cpu]
+       [--dim 2048] [--hidden 5632] [--heads 16] [--tokens 8192]
+Prints one JSON line per (site, variant).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--dim", type=int, default=2048)
+    ap.add_argument("--hidden", type=int, default=5632)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--tokens", type=int, default=8192)  # batch*seq
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    hd = args.dim // args.heads
+    rs = np.random.RandomState(0)
+
+    def bench(fn, *xs):
+        f = jax.jit(jax.grad(lambda *a: fn(*a).astype(jnp.float32).sum(),
+                             argnums=tuple(range(len(xs)))))
+        g = f(*xs)
+        jax.block_until_ready(g)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            g = f(*xs)
+        jax.block_until_ready(g)
+        return (time.perf_counter() - t0) / args.iters
+
+    t = args.tokens
+    o = jnp.asarray(rs.randn(t, args.heads, hd), jnp.bfloat16)
+    m = jnp.asarray(rs.randn(t, args.hidden), jnp.bfloat16)
+
+    sites = {
+        # wo projection: (t, h, d) x (h, d, e) -> (t, e)
+        "wo": {
+            "hde": (lambda o_, w: jnp.einsum("thd,hde->te", o_, w),
+                    (o, jnp.asarray(rs.randn(args.heads, hd, args.dim),
+                                    jnp.bfloat16))),
+            "ehd": (lambda o_, w: jnp.einsum("thd,ehd->te", o_, w),
+                    (o, jnp.asarray(rs.randn(args.dim, args.heads, hd),
+                                    jnp.bfloat16))),
+            "flat_he": (lambda o_, w: o_.reshape(t, -1) @ w,
+                        (o, jnp.asarray(rs.randn(args.dim, args.dim) * 0.1,
+                                        jnp.bfloat16))),
+        },
+        # down projection: (t, hidden) x (hidden, e) -> (t, e)
+        "down": {
+            "he": (lambda m_, w: jnp.einsum("th,he->te", m_, w),
+                   (m, jnp.asarray(rs.randn(args.hidden, args.dim),
+                                   jnp.bfloat16))),
+            "eh": (lambda m_, w: jnp.einsum("th,eh->te", m_, w),
+                   (m, jnp.asarray(rs.randn(args.dim, args.hidden),
+                                   jnp.bfloat16))),
+        },
+    }
+    for site, variants in sites.items():
+        for name, (fn, xs) in variants.items():
+            try:
+                dt = bench(fn, *xs)
+            except Exception as e:
+                print(json.dumps({"site": site, "variant": name,
+                                  "error": str(e)[:160]}))
+                continue
+            print(json.dumps({"site": site, "variant": name,
+                              "ms_fwd_bwd": round(dt * 1e3, 3)}))
+
+
+if __name__ == "__main__":
+    main()
