@@ -61,6 +61,30 @@ def test_submesh_op_prefers_subset_over_replication():
     assert strat["small_head"].output_spec(0)[0] == ("data",)
 
 
+def test_search_proposes_data_sub_tp_rules():
+    """The corpus's data_sub-instantiated parallelization rules fire on a
+    submesh-split mesh — the search can propose TP over the device-subset
+    group, not just batch placement."""
+    import jax
+
+    from flexflow_tpu.parallel.mesh import make_mesh
+    from flexflow_tpu.search.api import graph_optimize
+
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 4, "data_sub": 2},
+                   search_budget=8)
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 32), DataType.FLOAT, name="x")
+    h = ff.dense(x, 64, use_bias=False, name="d0")
+    h = ff.relu(h, name="r")
+    ff.dense(h, 8, use_bias=False, name="d1")
+    ff.graph.infer_shapes()
+    mesh = make_mesh({"data": 4, "data_sub": 2}, jax.devices())
+    stats = {}
+    graph_optimize(ff.graph, mesh, cfg, stats_out=stats)
+    fired = [n for n in stats.get("rule_fires", {}) if "data_sub" in n]
+    assert fired, "no data_sub parallelization rule fired on the submesh"
+
+
 def test_submesh_model_compiles_and_trains():
     """End to end on the 8-device CPU mesh: enable_submesh splits the
     mesh, the folded op runs on the 4-device subset, and the jitted step
