@@ -24,7 +24,8 @@ def _random_model(ff, rs, in_dim, n_classes):
     n_layers = rs.randint(2, 6)
     for i in range(n_layers):
         kind = rs.choice(["dense", "dense_act", "norm", "dropout",
-                          "branch", "residual"])
+                          "branch", "residual", "minmax", "scalar_chain",
+                          "split_merge"])
         if kind == "dense":
             width = int(rs.choice([32, 64, 128]))
             t = ff.dense(t, width, use_bias=bool(rs.randint(2)),
@@ -46,6 +47,21 @@ def _random_model(ff, rs, in_dim, n_classes):
         elif kind == "residual":
             a = ff.dense(t, width, name=f"ra{i}")
             t = ff.add(t, a, name=f"res{i}")
+        elif kind == "minmax":
+            # exercises the round-4 monotone/minmax + self-operand rules
+            a = ff.dense(t, width, use_bias=False, name=f"ma{i}")
+            t = [ff.max, ff.min][rs.randint(2)](t, a, name=f"mm{i}")
+        elif kind == "scalar_chain":
+            # exercises scalar fold/slide/identity rules
+            t = ff.scalar_multiply(t, float(rs.choice([2.0, 0.5, -1.0])),
+                                   name=f"sm{i}")
+            t = ff.scalar_add(t, float(rs.randn()), name=f"sa{i}")
+        elif kind == "split_merge":
+            # exercises split/concat cancellation + piecewise rules
+            if width % 2 == 0:
+                a, b = ff.split(t, [width // 2, width // 2], axis=1,
+                                name=f"sp{i}")
+                t = ff.concat([a, b], axis=1, name=f"sc{i}")
     t = ff.dense(t, n_classes, name="head")
     return ff.softmax(t, name="softmax")
 
@@ -69,6 +85,27 @@ def test_random_graph_search_compile_train(seed):
     p = ff.predict(x[:16])
     assert p.shape == (16, n_classes)
     assert np.isfinite(np.asarray(p)).all()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_random_graph_submesh_search_compile_train(seed):
+    """Same randomized nets on a data x data_sub x model SUBMESH mesh with
+    the search on: the data_sub corpus rules and subset placements must
+    compose with arbitrary graphs through compile + train."""
+    rs = np.random.RandomState(seed + 50)
+    in_dim, n_classes = 48, 4
+    cfg = FFConfig(batch_size=16, seed=seed, num_devices=8,
+                   mesh_shape={"data": 2, "data_sub": 2, "model": 2},
+                   search_budget=8)
+    ff = FFModel(cfg)
+    _random_model(ff, rs, in_dim, n_classes)
+    ff.compile(optimizer=AdamOptimizer(lr=1e-3),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY])
+    x = rs.randn(32, in_dim).astype(np.float32)
+    y = rs.randint(0, n_classes, 32).astype(np.int32)
+    m = ff.fit(x, y, epochs=1, verbose=False)
+    assert np.isfinite(m.sparse_cce_loss)
 
 
 @pytest.mark.parametrize("seed", range(3))
