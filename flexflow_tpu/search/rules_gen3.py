@@ -1768,6 +1768,150 @@ def _unary_identity_family() -> List[Dict]:
 
 
 # ---------------------------------------------------------------------------
+# family M: pool/reduce composition + gather distribution
+
+
+def _compose_family() -> List[Dict]:
+    rules: List[Dict] = []
+    # two stride-2 2x2 pools compose into one stride-4 4x4 pool of the
+    # same type (exact for max — max of maxes — and for avg: equal-weight
+    # average of disjoint equal-size windows)
+    p22 = {"attr_eq": [["kernel", [2, 2]], ["stride", [2, 2]],
+                       ["padding", [0, 0]], ["activation", "none"]]}
+    for pt in ("max", "avg"):
+        when = {"attr_eq": p22["attr_eq"] + [["pool_type", pt]]}
+        rules.append({
+            "name": f"compose_{pt}pool_2x2",
+            "src": {
+                "nodes": [{"id": "p1", "type": "POOL2D",
+                           "when": dict(when)},
+                          {"id": "p2", "type": "POOL2D",
+                           "when": dict(when)}],
+                "edges": [["p1", 0, "p2", 0]],
+                "inputs": [["x", "p1", 0]],
+                "outputs": [["p2", 0]],
+            },
+            "dst": {
+                "nodes": [{"id": "p", "type": "POOL2D", "name": "{p1}",
+                           "reuse": "p1",
+                           "attrs": {"kernel": [4, 4], "stride": [4, 4],
+                                     "padding": [0, 0],
+                                     "pool_type": {"$attr": ["p1",
+                                                             "pool_type"]},
+                                     "activation": {"$attr": [
+                                         "p1", "activation"]}}}],
+                "inputs": [["x", "p", 0]],
+                "outputs": [["p", 0]],
+            },
+        })
+    # keepdims reductions over the two trailing axes compose (sum of sums;
+    # mean of means over disjoint axes is the mean over both)
+    for red in ("REDUCE_SUM", "MEAN"):
+        rules.append({
+            "name": f"compose_{red.lower()}_keepdims",
+            "src": {
+                "nodes": [{"id": "r1", "type": red,
+                           "when": {"attr_eq": [["axes", [-1]],
+                                                ["keepdims", True]]}},
+                          {"id": "r2", "type": red,
+                           "when": {"attr_eq": [["axes", [-2]],
+                                                ["keepdims", True]]}}],
+                "edges": [["r1", 0, "r2", 0]],
+                "inputs": [["x", "r1", 0]],
+                "outputs": [["r2", 0]],
+            },
+            "dst": {
+                "nodes": [{"id": "r", "type": red, "name": "{r1}",
+                           "reuse": "r1",
+                           "attrs": {"kind": {"$attr": ["r1", "kind"]},
+                                     "axes": [-2, -1],
+                                     "keepdims": True}}],
+                "inputs": [["x", "r", 0]],
+                "outputs": [["r", 0]],
+            },
+        })
+    # gather distributes over an elementwise binary with equal-shape
+    # operands (pure indexing), both directions
+    rules.append({
+        "name": "distribute_gather_over_binary",
+        "src": {
+            "nodes": [{"id": "b", "type": "ELEMENT_BINARY"},
+                      {"id": "g", "type": "GATHER"}],
+            "edges": [["b", 0, "g", 0]],
+            "inputs": [["x", "b", 0], ["y", "b", 1], ["i", "g", 1]],
+            "outputs": [["g", 0]],
+        },
+        "where": [{"kind": "inputs_same_shape", "args": ["b"]}],
+        "dst": {
+            "nodes": [_copy("g1", "g", "GATHER"),
+                      _fresh("g2", "g", "GATHER", "b"),
+                      _copy("b2", "b", "ELEMENT_BINARY")],
+            "edges": [["g1", 0, "b2", 0], ["g2", 0, "b2", 1]],
+            "inputs": [["x", "g1", 0], ["i", "g1", 1],
+                       ["y", "g2", 0], ["i", "g2", 1]],
+            "outputs": [["b2", 0]],
+        },
+    })
+    rules.append({
+        "name": "hoist_gather_over_binary",
+        "src": {
+            "nodes": [{"id": "g1", "type": "GATHER"},
+                      {"id": "g2", "type": "GATHER"},
+                      {"id": "b", "type": "ELEMENT_BINARY"}],
+            "edges": [["g1", 0, "b", 0], ["g2", 0, "b", 1]],
+            "inputs": [["x", "g1", 0], ["i", "g1", 1],
+                       ["y", "g2", 0], ["i", "g2", 1]],  # SHARED index
+            "outputs": [["b", 0]],
+        },
+        "where": [{"kind": "attrs_equal", "args": ["g1", "g2", "axis"]},
+                  {"kind": "first_inputs_same_shape", "args": ["g1", "g2"]}],
+        "dst": {
+            "nodes": [_copy("b2", "b", "ELEMENT_BINARY"),
+                      _copy("g", "g1", "GATHER")],
+            "edges": [["b2", 0, "g", 0]],
+            "inputs": [["x", "b2", 0], ["y", "b2", 1], ["i", "g", 1]],
+            "outputs": [["g", 0]],
+        },
+    })
+    # cast commutes with gather (indexing is dtype-agnostic)
+    rules.append({
+        "name": "commute_gather_before_cast",
+        "src": {
+            "nodes": [{"id": "c", "type": "CAST"},
+                      {"id": "g", "type": "GATHER"}],
+            "edges": [["c", 0, "g", 0]],
+            "inputs": [["x", "c", 0], ["i", "g", 1]],
+            "outputs": [["g", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("g2", "g", "GATHER"),
+                      _copy("c2", "c", "CAST")],
+            "edges": [["g2", 0, "c2", 0]],
+            "inputs": [["x", "g2", 0], ["i", "g2", 1]],
+            "outputs": [["c2", 0]],
+        },
+    })
+    rules.append({
+        "name": "commute_cast_before_gather",
+        "src": {
+            "nodes": [{"id": "g", "type": "GATHER"},
+                      {"id": "c", "type": "CAST"}],
+            "edges": [["g", 0, "c", 0]],
+            "inputs": [["x", "g", 0], ["i", "g", 1]],
+            "outputs": [["c", 0]],
+        },
+        "dst": {
+            "nodes": [_copy("c2", "c", "CAST"),
+                      _copy("g2", "g", "GATHER")],
+            "edges": [["c2", 0, "g2", 0]],
+            "inputs": [["x", "c2", 0], ["i", "g2", 1]],
+            "outputs": [["g2", 0]],
+        },
+    })
+    return rules
+
+
+# ---------------------------------------------------------------------------
 
 
 def extra_rules3() -> List[Dict]:
@@ -1786,6 +1930,7 @@ def extra_rules3() -> List[Dict]:
         + _misc_family()
         + _assoc_slide_family()
         + _unary_identity_family()
+        + _compose_family()
     )
     names = [r["name"] for r in rules]
     assert len(names) == len(set(names)), "duplicate rule names in gen3"
