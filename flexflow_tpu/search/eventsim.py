@@ -12,12 +12,20 @@ different times, ring attention overlaps ppermute hops with block compute,
 and concurrent collectives contend on the same mesh axis's ICI rings. The
 expansion here lowers a (graph, strategy) into:
 
-  * one serial channel per CHIP (compute), one per MESH AXIS (its ICI ring
-    group — in an SPMD program all rings of one axis carry identical
-    traffic, so one channel captures both the axis's serialization and
-    cross-collective contention on its links);
-  * lockstep ops: one compute task per chip + per-axis comm tasks for the
-    node's collectives (CostModel.node_comm_events) and gradient syncs
+  * one serial channel per CHIP (compute) and one per ICI RING INSTANCE —
+    a (mesh axis, coordinate-along-the-other-axes) pair. A lockstep SPMD
+    collective occupies every instance of its axis concurrently (all rings
+    carry the same traffic), so same-axis collectives still contend link
+    for link; but constructs whose collectives are restricted to a device
+    subset (per-stage gradient syncs of a pipe-sharded PIPELINE, per-group
+    TP) occupy ONLY their own instances and overlap with their siblings —
+    the routed-network fidelity the reference gets from per-link SimTasks
+    (simulator.h:515-605);
+  * a single shared DCN channel for slice-crossing collectives (the host
+    NIC) when the machine model declares `chips_per_slice` — DCN traffic
+    no longer falsely contends with ICI traffic;
+  * lockstep ops: one compute task per chip + per-instance comm tasks for
+    the node's collectives (CostModel.node_comm_events) and gradient syncs
     (weight_sync_events — dependents-free, so they overlap later compute
     exactly like XLA async collectives);
   * PIPELINE composites: stage x microbatch forward/backward wave tasks on
@@ -29,13 +37,17 @@ expansion here lowers a (graph, strategy) into:
 
 The DAG ships to the native engine in one call (ffsim_tasksim_build) and
 is list-scheduled there. Falls back to None (caller uses the serial sum)
-when the native library is unavailable or the mesh/graph is too large.
+when the native library is unavailable or the mesh/graph is too large —
+the oversize fallback is LOUD: it logs a warning (once) and reports the
+ranking mode through the `info` out-param so gate records can show which
+ranking a search actually used.
 """
 
 from __future__ import annotations
 
+import logging
 import math
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional, Sequence
 
 from flexflow_tpu.ffconst import OpType
 from flexflow_tpu.pcg.graph import Graph
@@ -46,10 +58,19 @@ from flexflow_tpu.search.cost_model import (
     spec_degree,
 )
 
+logger = logging.getLogger(__name__)
+
 # expansion size guard: beyond this many tasks the Python build loop costs
 # more than the fidelity is worth inside a search — callers fall back to
 # the serial sum (the playoff re-rank still uses the two-channel simulate)
 MAX_TASKS = 200_000
+
+# ring instances per axis before collapsing back to one shared channel —
+# beyond this the instance enumeration itself dominates; collapsing is
+# exact for lockstep SPMD collectives and only loses subset overlap
+MAX_GROUP_CHANNELS = 64
+
+_warned_oversize = False
 
 
 class _DagBuilder:
@@ -84,13 +105,132 @@ class _DagBuilder:
                                    self.dep_dst)
 
 
+class _IciChannels:
+    """Ring-instance comm channels (simulator.h:515-605 per-link analog).
+
+    A collective over mesh axes rides the PRIMARY axis's rings; the torus
+    has one physical ring instance of that axis per coordinate of the
+    other axes. `emit` schedules one task per instance a collective
+    actually touches, grouping devices by their orthogonal coordinates —
+    so a sync whose per-device deps come from disjoint stages lands on
+    disjoint instances and overlaps, while two whole-mesh collectives on
+    the same axis still contend on every instance.
+    """
+
+    def __init__(self, b: _DagBuilder, axis_names: Sequence[str],
+                 shape: Sequence[int], coord_of, n_dev: int, machine):
+        self.b = b
+        self.axis_names = list(axis_names)
+        self.shape = list(shape)
+        self.coord_of = coord_of
+        self.n_dev = n_dev
+        self.machine = machine
+        self._chan: Dict = {}
+        self._dcn: Optional[int] = None
+
+    def _channel(self, key) -> int:
+        c = self._chan.get(key)
+        if c is None:
+            c = self.b.new_channel()
+            self._chan[key] = c
+        return c
+
+    def _primary(self, axes) -> Optional[int]:
+        for a in axes:
+            if a in self.axis_names:
+                i = self.axis_names.index(a)
+                if self.shape[i] > 1:
+                    return i
+        return None
+
+    def emit(self, axes, duration: float,
+             deps_by_dev: Sequence[Iterable[int]],
+             devices: Optional[Iterable[int]] = None) -> List[Optional[int]]:
+        """Schedule one collective event over mesh `axes`.
+
+        `deps_by_dev[d]` = tasks device d must finish before joining the
+        collective; `devices` optionally restricts the participants (a
+        device subset, e.g. one pipeline stage). Returns a per-device
+        completion task id (None for non-participants).
+
+        Synchronization and occupancy are separate concerns: devices form
+        one independent SYNC GROUP per coordinate over the axes NOT in the
+        collective (a multi-axis all-reduce couples every device that any
+        of its axes spans — splitting it finer would let one column finish
+        before the other's producers arrive); each group then OCCUPIES the
+        primary axis's physical ring instance at every non-primary
+        coordinate its members touch, so contention stays per link."""
+        devs = list(devices) if devices is not None else list(range(self.n_dev))
+        out: List[Optional[int]] = [None] * self.n_dev
+
+        def broadcast(channel: int) -> List[Optional[int]]:
+            tid = self.b.add(channel, duration,
+                             {x for d in devs for x in deps_by_dev[d]})
+            for d in devs:
+                out[d] = tid
+            return out
+
+        primary = self._primary(axes)
+        if primary is None:
+            # no real participants (all named axes trivial): unconstrained
+            return broadcast(-1)
+        part = {self.axis_names.index(a) for a in axes
+                if a in self.axis_names
+                and self.shape[self.axis_names.index(a)] > 1}
+        participants = math.prod(self.shape[i] for i in part)
+        if (self.machine is not None
+                and getattr(self.machine, "chips_per_slice", None) is not None
+                and self.machine._crosses_dcn(participants)):
+            # slice-crossing traffic rides the host NIC, one shared channel
+            return broadcast(self._dcn_channel())
+        non_primary = [i for i in range(len(self.shape))
+                       if i != primary and self.shape[i] > 1]
+        n_inst = (math.prod(self.shape[i] for i in non_primary)
+                  if non_primary else 1)
+        if n_inst > MAX_GROUP_CHANNELS:
+            # collapse to the old one-channel-per-axis model: exact for
+            # lockstep SPMD, loses subset overlap on very large meshes
+            return broadcast(self._channel((primary, "collapsed")))
+        # channel identity = physical ring instance of the primary axis:
+        # the device's coordinate along every other non-trivial axis
+        nonpart = [i for i in non_primary if i not in part]
+        groups: Dict[tuple, tuple] = {}
+        for d in devs:
+            gkey = tuple(self.coord_of(d, i) for i in nonpart)
+            deps, members = groups.setdefault(gkey, (set(), []))
+            deps.update(deps_by_dev[d])
+            members.append(d)
+        for gkey, (deps, members) in groups.items():
+            insts = sorted({tuple(self.coord_of(d, i) for i in non_primary)
+                            for d in members})
+            tids = [self.b.add(self._channel((primary, inst)), duration,
+                               deps) for inst in insts]
+            # a group spanning several ring instances (secondary collective
+            # axes) completes when ALL of them drain: join on a free task
+            done_id = (tids[0] if len(tids) == 1
+                       else self.b.add(-1, 0.0, tids))
+            for d in members:
+                out[d] = done_id
+        return out
+
+    def _dcn_channel(self) -> int:
+        if self._dcn is None:
+            self._dcn = self.b.new_channel()
+        return self._dcn
+
+
 def simulate_graph(graph: Graph, strategy: Dict, cost: CostModel,
-                   training: bool = True) -> Optional[float]:
+                   training: bool = True,
+                   info: Optional[Dict] = None) -> Optional[float]:
     """Makespan of one step of `graph` under `strategy` on the per-device
-    task simulator, or None when unavailable/oversized."""
+    task simulator, or None when unavailable/oversized. `info`, when
+    given, receives {"mode": "eventsim"|"serial_fallback_oversized"|
+    "unavailable", ...} so callers can record which ranking was used."""
     from flexflow_tpu import native
 
     if not native.available():
+        if info is not None:
+            info["mode"] = "unavailable"
         return None
     axis_names = list(cost.axis_sizes)
     shape = [max(int(cost.axis_sizes[a]), 1) for a in axis_names]
@@ -108,15 +248,20 @@ def simulate_graph(graph: Graph, strategy: Dict, cost: CostModel,
         else:
             est += 1
     if n_dev * max(est, 1) > MAX_TASKS:
+        global _warned_oversize
+        if not _warned_oversize:
+            logger.warning(
+                "eventsim: expanded task count %d (x%d devices) exceeds "
+                "MAX_TASKS=%d; falling back to the serial op-sum for this "
+                "and further oversized graphs — rankings lose overlap/"
+                "contention awareness (warned once)",
+                est, n_dev, MAX_TASKS)
+            _warned_oversize = True
+        if info is not None:
+            info["mode"] = "serial_fallback_oversized"
+            info["est_tasks"] = n_dev * est
         return None
-    axis_chan = {a: n_dev + i for i, a in enumerate(axis_names)}
-    b = _DagBuilder(n_dev + len(axis_names))
-
-    def comm_chan(axes) -> int:
-        for a in axes:
-            if cost.axis_sizes.get(a, 1) > 1 and a in axis_chan:
-                return axis_chan[a]
-        return -1
+    b = _DagBuilder(n_dev)
 
     # device index <-> mesh coords (row-major over axis_names order)
     strides = [0] * len(shape)
@@ -127,6 +272,9 @@ def simulate_graph(graph: Graph, strategy: Dict, cost: CostModel,
 
     def coord_of(dev: int, axis_idx: int) -> int:
         return (dev // strides[axis_idx]) % shape[axis_idx]
+
+    ici = _IciChannels(b, axis_names, shape, coord_of, n_dev,
+                       getattr(cost, "machine", None))
 
     # per node guid: completion task id per device
     done: Dict[int, List[int]] = {}
@@ -152,15 +300,16 @@ def simulate_graph(graph: Graph, strategy: Dict, cost: CostModel,
             axes, xt = cost.edge_xfer_event(
                 src_node.outputs[e.src_idx], src_spec, dst_spec)
             if xt > 0.0:
-                ct = b.add(comm_chan(axes), xt, set(src_done))
+                per_dev = ici.emit(axes, xt, [[t] for t in src_done])
                 for d in range(n_dev):
-                    in_deps[d].append(ct)
+                    in_deps[d].append(per_dev[d])
             else:
                 for d in range(n_dev):
                     in_deps[d].append(src_done[d])
 
         if node.op_type == OpType.PIPELINE and is_pipe_sharded(node, view) \
-                and "pipe" in axis_chan and cost.axis_sizes.get("pipe", 1) > 1:
+                and "pipe" in axis_names \
+                and cost.axis_sizes.get("pipe", 1) > 1:
             completion = _expand_pipeline(b, graph, node, view, cost,
                                           training, in_deps, n_dev,
                                           axis_names, coord_of)
@@ -169,7 +318,7 @@ def simulate_graph(graph: Graph, strategy: Dict, cost: CostModel,
               and view is not None
               and _seq_degree(node, view, cost) > 1):
             completion = _expand_ring(b, graph, node, view, cost, training,
-                                      in_deps, n_dev, comm_chan)
+                                      in_deps, n_dev, ici)
         else:
             t = cost.node_compute_time(graph, node, view, training)
             ids = [b.add(d, t, in_deps[d]) for d in range(n_dev)]
@@ -179,19 +328,27 @@ def simulate_graph(graph: Graph, strategy: Dict, cost: CostModel,
                                                   training):
                 if et <= 0.0:
                     continue
-                ct = b.add(comm_chan(axes), et, set(completion))
-                completion = [ct] * n_dev
+                completion = ici.emit(axes, et,
+                                      [[c] for c in completion])
         done[node.guid] = completion
 
         if training:
             # gradient syncs: scheduled after the node, no dependents —
-            # they contend on their axes' channels and extend the makespan
-            # only when they cannot hide behind later work
+            # they contend on their instances' channels and extend the
+            # makespan only when they cannot hide behind later work. Deps
+            # are PER DEVICE: a pipe-sharded weight's sync instance at
+            # stage s starts when stage s finishes, so stage-local syncs
+            # overlap each other and other stages' remaining backward
             for axes, st in cost.weight_sync_events(graph, node, view):
                 if st > 0.0:
-                    b.add(comm_chan(axes), st, set(done[node.guid]))
+                    ici.emit(axes, st, [[c] for c in done[node.guid]])
 
-    return b.run()
+    out = b.run()
+    if info is not None:
+        info["mode"] = "eventsim"
+        info["tasks"] = len(b.channels)
+        info["channels"] = b.n_channels
+    return out
 
 
 def _seq_degree(node, view, cost: CostModel) -> int:
@@ -205,7 +362,8 @@ def _seq_degree(node, view, cost: CostModel) -> int:
 
 
 def _expand_ring(b: _DagBuilder, graph, node, view, cost: CostModel,
-                 training: bool, in_deps, n_dev: int, comm_chan) -> List[int]:
+                 training: bool, in_deps, n_dev: int,
+                 ici: _IciChannels) -> List[int]:
     """Ring attention as `deg` per-device block-compute steps with a
     CONCURRENT k/v ppermute chain on the seq axis: each hop forwards the
     block it just received (hop i depends on hop i-1, NOT on step i's
@@ -214,12 +372,12 @@ def _expand_ring(b: _DagBuilder, graph, node, view, cost: CostModel,
     ~max(deg*block, (deg-1)*hop). The backward wave re-permutes k/v plus
     accumulating dk/dv (2x bytes). Non-seq collectives the cost model
     prices for this node (e.g. a head-TP wo all-reduce) are scheduled
-    after the waves."""
+    after the waves. Hops ride the seq axis's ring instances — disjoint
+    data-group rings permute concurrently."""
     deg = _seq_degree(node, view, cost)
     total = cost.node_compute_time(graph, node, view, training)
     spec = view.output_spec(0)
     seq_axes = tuple(spec[1])
-    chan = comm_chan(seq_axes)
     a = node.attrs
     bsz = node.outputs[0].dims[0].size
     s = node.outputs[0].dims[1].size
@@ -236,21 +394,20 @@ def _expand_ring(b: _DagBuilder, graph, node, view, cost: CostModel,
     cur = in_deps
     last = None
     for step_c, hop_c in waves:
-        prev_hop = None
+        prev_hop: Optional[List[Optional[int]]] = None
         for i in range(deg):
-            deps_i = cur if i == 0 else None
-            ids = [b.add(d, step_c,
-                         (deps_i[d] if deps_i is not None else [prev_hop]))
-                   for d in range(n_dev)]
+            if i == 0:
+                ids = [b.add(d, step_c, cur[d]) for d in range(n_dev)]
+            else:
+                ids = [b.add(d, step_c, [prev_hop[d]])
+                       for d in range(n_dev)]
             last = ids
             if i < deg - 1:
                 # forward the just-received block: chain on the previous
                 # hop (and, for the first, on the input being ready)
-                hop_deps = ([prev_hop] if prev_hop is not None
-                            else set(x for d in range(n_dev)
-                                     for x in cur[d]))
-                hop = b.add(chan, hop_c, hop_deps)
-                prev_hop = hop
+                hop_deps = ([[prev_hop[d]] for d in range(n_dev)]
+                            if prev_hop is not None else cur)
+                prev_hop = ici.emit(seq_axes, hop_c, hop_deps)
         cur = [[last[d]] for d in range(n_dev)]
     completion = last
     # non-seq collectives (additive in node_comm_events, e.g. head-TP wo
@@ -258,8 +415,7 @@ def _expand_ring(b: _DagBuilder, graph, node, view, cost: CostModel,
     for axes, et in cost.node_comm_events(graph, node, view, training):
         if et <= 0.0 or tuple(axes) == seq_axes:
             continue  # seq legs are replaced by the explicit hop chain
-        ct = b.add(comm_chan(axes), et, set(completion))
-        completion = [ct] * n_dev
+        completion = ici.emit(axes, et, [[c] for c in completion])
     return completion
 
 

@@ -925,13 +925,20 @@ def unity_search(
         t = gc.time
         if getattr(cost, "event_sim", False):
             # rank by the per-device task simulator (overlap, pipeline
-            # bubbles, per-axis ICI contention); the serial sum stays the
-            # fallback when the native engine is unavailable
+            # bubbles, per-ring-instance ICI contention); the serial sum
+            # stays the fallback when the native engine is unavailable —
+            # stats_out["eventsim"] records which ranking each candidate
+            # actually got (oversize fallbacks must not pass silently)
             from flexflow_tpu.search.eventsim import simulate_graph
 
-            sim = simulate_graph(g, s, cost, training)
+            sim_info = {} if stats_out is not None else None
+            sim = simulate_graph(g, s, cost, training, info=sim_info)
             if sim is not None:
                 t = sim
+            if stats_out is not None:
+                cov = stats_out.setdefault("eventsim", {})
+                mode = sim_info.get("mode", "unavailable")
+                cov[mode] = cov.get(mode, 0) + 1
         if objective is not None:
             return objective(t, gc.memory_per_chip), s
         if memory_limit is not None and gc.memory_per_chip > memory_limit:
