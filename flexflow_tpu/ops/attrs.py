@@ -578,6 +578,12 @@ class ReduceAttrs(OpAttrs):
     keepdims: bool = False
 
     def infer(self, x: Shape):
+        for a in self.axes:
+            # modulo would silently reduce the WRONG axis on out-of-range
+            # input (axis 7 of a 2-D tensor -> axis 1)
+            if not -x.ndim <= a < x.ndim:
+                raise ValueError(
+                    f"reduce axis {a} out of range for {x.ndim}-D input")
         ax = {a % x.ndim for a in self.axes}
         dims = []
         for i, d in enumerate(x.dims):

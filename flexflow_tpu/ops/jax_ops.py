@@ -139,6 +139,27 @@ def apply_rope(x, theta: float, pos_offset=0):
     return out.astype(x.dtype)
 
 
+def qkv_project(x, w, dt):
+    """(B,S,E) x (E,H,D) -> (B,S,H,D) through the weight's 2D [E, H*D]
+    view. Contracting the 3D weight directly lets XLA's forward and
+    weight-grad dots prefer DIFFERENT minor-to-major layouts for it, and
+    with donated buffers that materializes per-step relayout copies of the
+    parameter AND its Adam state (~2.1 GB/step measured at the 1b bench
+    config, tools/hlo_transpose_audit.py); the reshape is a bitcast of the
+    canonical layout, so every use agrees and the copies vanish."""
+    E, H, D = w.shape
+    y = jnp.einsum("bse,ef->bsf", x, w.reshape(E, H * D).astype(dt))
+    return y.reshape(*x.shape[:-1], H, D)
+
+
+def attn_out_project(o, w, dt):
+    """(B,S,H,D) x (H,D,E) -> (B,S,E) through the [H*D, E] view (same
+    layout-pinning rationale as qkv_project)."""
+    H, D, E = w.shape
+    return jnp.einsum("bsf,fe->bse", o.reshape(*o.shape[:-2], H * D),
+                      w.reshape(H * D, E).astype(dt))
+
+
 def _dot_product_attention(q, k, v, causal: bool, scale: float,
                            dropout_rate: float = 0.0, dropout_rng=None,
                            mask=None):
@@ -171,16 +192,22 @@ def _sharded_flash(q, k, v, mesh, causal, scale, interpret=False):
     sharded over `data`, heads over `model` (head-TP keeps the flash path —
     a bare pallas_call would force GSPMD to gather, VERDICT r1 weakness 3).
     The full sequence is local to every shard (seq-sharded attention goes
-    through ring attention instead)."""
+    through ring attention instead). GQA kv heads stay UNREPEATED when
+    they divide the head axis (the kernel maps q heads onto kv heads);
+    otherwise the repeat happens here so both specs shard evenly."""
     from jax.sharding import PartitionSpec as P
 
     from flexflow_tpu.ops.pallas import flash_attention
     from flexflow_tpu.parallel.compat import shard_map as _shard_map
 
     B, S, H, D = q.shape
+    Hkv = k.shape[2]
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     b_ax = "data" if sizes.get("data", 1) > 1 and B % sizes["data"] == 0 else None
     h_ax = "model" if sizes.get("model", 1) > 1 and H % sizes["model"] == 0 else None
+    if h_ax is not None and Hkv % sizes["model"] != 0:
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
     spec = P(b_ax, None, h_ax, None)
 
     def fn(ql, kl, vl):
@@ -195,22 +222,18 @@ def fused_attention(q, k, v, *, causal, scale, dropout=0.0, dropout_rng=None,
                     mesh=None):
     """Dispatch: Pallas flash kernel on TPU when shapes/config allow —
     wrapped in shard_map on multi-device meshes so DP/head-TP strategies
-    keep the flash path — XLA dot-product attention otherwise. The GQA
-    head repeat happens before dispatch so shard_map sees equal head
-    counts. Sets LAST_ATTENTION_KERNEL for observability."""
+    keep the flash path — XLA dot-product attention otherwise. GQA kv
+    heads reach the flash kernels unrepeated (the kernel index maps fold
+    the repeat); the XLA fallback repeats internally. Sets
+    LAST_ATTENTION_KERNEL for observability."""
     import os
 
     global LAST_ATTENTION_KERNEL
+
     from flexflow_tpu.ops.pallas import (
         flash_attention,
         flash_attention_available,
     )
-
-    H, Hkv = q.shape[2], k.shape[2]
-    if Hkv != H:
-        rep = H // Hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
 
     force_interp = os.environ.get("FF_TPU_FLASH_INTERPRET") == "1"
     single = mesh is None or getattr(mesh, "size", 1) == 1
@@ -282,9 +305,9 @@ def _mha(attrs, inputs, params, ctx):
     v_in = inputs[2] if len(inputs) > 2 else k_in
     dt = q_in.dtype
     hd = attrs.kdim
-    q = jnp.einsum("bse,ehd->bshd", q_in, params["wq"].astype(dt))
-    k = jnp.einsum("bse,ehd->bshd", k_in, params["wk"].astype(dt))
-    v = jnp.einsum("bse,ehd->bshd", v_in, params["wv"].astype(dt))
+    q = qkv_project(q_in, params["wq"], dt)
+    k = qkv_project(k_in, params["wk"], dt)
+    v = qkv_project(v_in, params["wv"], dt)
     if attrs.use_bias:
         q = q + params["bq"].astype(dt)
         k = k + params["bk"].astype(dt)
@@ -307,7 +330,7 @@ def _mha(attrs, inputs, params, ctx):
             dropout=attrs.dropout if ctx.training else 0.0,
             dropout_rng=drop_rng, mesh=ctx.mesh,
         )
-    y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
+    y = attn_out_project(out, params["wo"], dt)
     if attrs.use_bias:
         y = y + params["bo"].astype(dt)
     return [y]
@@ -808,9 +831,9 @@ def _decoder_block(p, h, attrs, mesh=None, cache=None):
 
     hd = h.shape[-1] // attrs.heads
     a = rms(h, p["ln1"])
-    q = jnp.einsum("bse,ehd->bshd", a, p["wq"].astype(dt))
-    k = jnp.einsum("bse,ehd->bshd", a, p["wk"].astype(dt))
-    v = jnp.einsum("bse,ehd->bshd", a, p["wv"].astype(dt))
+    q = qkv_project(a, p["wq"], dt)
+    k = qkv_project(a, p["wk"], dt)
+    v = qkv_project(a, p["wv"], dt)
     kc = vc = None
     if cache is not None:
         cache_k, cache_v, pos = cache
@@ -823,7 +846,7 @@ def _decoder_block(p, h, attrs, mesh=None, cache=None):
         k = apply_rope(k, attrs.rope_theta)
         o = fused_attention(q, k, v, causal=attrs.causal,
                             scale=1.0 / (hd**0.5), mesh=mesh)
-    h = h + jnp.einsum("bshd,hde->bse", o, p["wo"].astype(dt))
+    h = h + attn_out_project(o, p["wo"], dt)
     m = rms(h, p["ln2"])
     g = jnp.einsum("bse,eh->bsh", m, p["gate"].astype(dt))
     u = jnp.einsum("bse,eh->bsh", m, p["up"].astype(dt))

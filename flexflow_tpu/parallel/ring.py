@@ -205,9 +205,11 @@ def ring_attention_lowering(attrs, inputs, params, ctx):
     v_in = inputs[2] if len(inputs) > 2 else k_in
     dt = q_in.dtype
     hd = attrs.kdim
-    q = jnp.einsum("bse,ehd->bshd", q_in, params["wq"].astype(dt))
-    k = jnp.einsum("bse,ehd->bshd", k_in, params["wk"].astype(dt))
-    v = jnp.einsum("bse,ehd->bshd", v_in, params["wv"].astype(dt))
+    from flexflow_tpu.ops.jax_ops import attn_out_project, qkv_project
+
+    q = qkv_project(q_in, params["wq"], dt)
+    k = qkv_project(k_in, params["wk"], dt)
+    v = qkv_project(v_in, params["wv"], dt)
     if attrs.rope:
         # applied at the global (logical) level, before the seq-sharded ring
         # core — positions are global so each shard sees correct angles
@@ -227,5 +229,5 @@ def ring_attention_lowering(attrs, inputs, params, ctx):
     out = seq_attn(
         q, k, v, mesh=ctx.mesh, causal=attrs.causal, scale=1.0 / (hd**0.5)
     )
-    y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
+    y = attn_out_project(out, params["wo"], dt)
     return [y]

@@ -1,0 +1,150 @@
+"""Backward-pass layout regression guards (VERDICT r4 #2).
+
+The r2-r4 benches carried ~26 ms of backward transposes + ~15 ms of
+copies per 1b step, traced with tools/hlo_transpose_audit.py to (a) the
+flash kernels' head-major to_bh/from_bh transposes and their backward
+mirrors, (b) the GQA kv-head repeat and its reduce-sum backward, and
+(c) 3D qkv weights whose forward and weight-grad dots preferred
+different layouts, relayout-copying the parameter AND its Adam state
+every step. These tests pin the fixes on CPU: the flash path must emit
+ZERO logical transposes (the flat-lane kernels read the projection
+layout directly) and no kv-head repeat, at any head dim >= 128.
+"""
+
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _llama_step_hlo(hd: int):
+    """Optimized HLO text of a small Llama train step with head_dim=hd,
+    flash forced through the Pallas interpret path (CPU-executable)."""
+    os.environ["FF_TPU_FLASH_INTERPRET"] = "1"
+    try:
+        from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+        from flexflow_tpu.models.llama import LlamaConfig, build_llama
+
+        heads = 4
+        lcfg = LlamaConfig(vocab_size=128, dim=heads * hd, layers=2,
+                           heads=heads, kv_heads=2, hidden=2 * heads * hd,
+                           rope_theta=10000.0)
+        ff = FFModel(FFConfig(batch_size=2))
+        build_llama(ff, lcfg, seq_len=256)
+        ff.compile(optimizer=AdamOptimizer(lr=1e-3),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+        step = ff.executor.train_step()
+        tr, ntr = ff._params
+        opt = ff._opt_state
+        rng = jax.random.key(0)
+        rs = np.random.RandomState(0)
+        x = rs.randint(0, 128, (2, 256)).astype(np.int32)
+        y = np.roll(x, -1, 1).astype(np.int32)
+        lowered = jax.jit(step).lower(tr, ntr, opt, rng, y, x)
+        return lowered.compile().as_text()
+    finally:
+        del os.environ["FF_TPU_FLASH_INTERPRET"]
+
+
+def _transposes(txt, source_substr, min_bytes):
+    """HLO transpose instructions above min_bytes whose metadata points
+    at source_substr."""
+    dt_bytes = {"f32": 4, "bf16": 2, "f16": 2}
+    out = []
+    for line in txt.splitlines():
+        s = line.strip()
+        m = re.match(r"%?[\w.\-]+ = (\w+)\[([\d,]*)\][^=]*? transpose\(", s)
+        if not m:
+            continue
+        if source_substr not in s:
+            continue
+        if m.group(1) not in dt_bytes:
+            continue
+        n = dt_bytes[m.group(1)]
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        if n >= min_bytes:
+            out.append((n, s[:160]))
+    return out
+
+
+def test_flash_path_emits_no_attention_transposes():
+    """With head_dim a lane multiple, the flat-lane flash kernels consume
+    the projection layout directly: the compiled train step must contain
+    NO transpose attributable to the attention stack (fwd or bwd) at or
+    above one activation block's size."""
+    txt = _llama_step_hlo(hd=128)
+    act_bytes = 2 * 256 * 4 * 128 * 2  # one (B,S,H,D) bf16 activation
+    bad = []
+    for src in ("flash_attention.py", "jax_ops.py"):
+        bad += _transposes(txt, src, min_bytes=act_bytes)
+    assert not bad, "attention-stack transposes reappeared:\n" + "\n".join(
+        ln for _, ln in bad)
+
+
+def test_flash_path_materializes_no_kv_repeat():
+    """GQA is resolved in the kernel index maps: no jnp.repeat of k/v
+    (fwd) and no reduce-over-repeats (bwd) may appear on the flash path.
+    A materialized repeat shows up as a (B,S,H,D)-sized broadcast/concat
+    from fused_attention's old pre-repeat — absent now by construction;
+    guard via the dkv cotangent shape staying at the UNREPEATED head
+    count inside the custom VJP."""
+    from flexflow_tpu.ops.pallas import flash_attention
+
+    B, S, H, Hkv, D = 1, 256, 4, 2, 128
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(B, S, Hkv, D), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(B, S, Hkv, D), jnp.bfloat16)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, scale=0.1, interpret=True)
+        of = o.astype(jnp.float32)
+        return (of * of).sum()
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert dk.shape == (B, S, Hkv, D)
+    assert dv.shape == (B, S, Hkv, D)
+    # and the grads are numerically right vs the XLA reference
+    from flexflow_tpu.ops.jax_ops import _dot_product_attention
+
+    def ref_loss(q, k, v):
+        o = _dot_product_attention(q, k, v, True, 0.1)
+        of = o.astype(jnp.float32)
+        return (of * of).sum()
+
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in ((dq, rq), (dk, rk), (dv, rv)):
+        np.testing.assert_allclose(a.astype(np.float32),
+                                   b.astype(np.float32),
+                                   atol=0.5, rtol=0.15)
+
+
+def test_qkv_weight_uses_agree_on_2d_view():
+    """qkv_project/attn_out_project must contract through the 2D weight
+    view (the layout-pinning fix): the jaxpr of a projection fwd+bwd
+    contains dots only on 2D-reshaped weights, never a 3D dot_general
+    against the raw (E,H,D) parameter."""
+    from flexflow_tpu.ops.jax_ops import attn_out_project, qkv_project
+
+    E, H, D = 64, 4, 16
+    x = jnp.ones((2, 8, E), jnp.bfloat16)
+    w = jnp.ones((E, H, D), jnp.float32)
+    wo = jnp.ones((H, D, E), jnp.float32)
+
+    def f(x, w, wo):
+        y = qkv_project(x, w, jnp.bfloat16)
+        return attn_out_project(y, wo, jnp.bfloat16).astype(jnp.float32).sum()
+
+    jaxpr = jax.make_jaxpr(jax.grad(f, argnums=(1, 2)))(x, w, wo)
+    for eqn in jaxpr.jaxpr.eqns:
+        if eqn.primitive.name != "dot_general":
+            continue
+        for invar in eqn.invars:
+            shape = getattr(getattr(invar, "aval", None), "shape", ())
+            assert len(shape) <= 3, (
+                f"dot_general against >3D operand {shape}: the 2D weight "
+                "view was bypassed")
