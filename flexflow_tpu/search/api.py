@@ -45,6 +45,9 @@ def _cost_model(mesh, config) -> CostModel:
         if config.machine_model_file
         else TPUMachineModel.make("v5e", num_chips=num_chips)
     )
+    # slice-crossing detection needs the mesh axis ORDER (outer axes span
+    # slices under row-major device placement), not just participant counts
+    machine.axis_order = dict(axis_sizes)
     kw = dict(
         param_parallel=config.enable_parameter_parallel,
         attr_parallel=config.enable_attribute_parallel,
@@ -202,6 +205,14 @@ def graph_optimize(graph: Graph, mesh, config, candidates_out=None,
     _t0 = _time.perf_counter()
     cost = _cost_model(mesh, config)
     _maybe_measure(cost, graph, config, mesh=mesh)
+    if (stats_out is not None
+            and getattr(cost.machine, "chips_per_slice", None)):
+        # which mesh axes' collectives ride DCN on this multi-slice
+        # machine — gate records show the intra/inter-slice split
+        stats_out["dcn_axes"] = [
+            a for a, s in cost.axis_sizes.items()
+            if s > 1 and cost.machine._crosses_dcn(s, (a,))
+        ]
     if config.memory_search:
         # memory-aware path: λ binary search blending run time and per-chip
         # memory (graph.cc:2046-2131 analog)
@@ -220,6 +231,16 @@ def graph_optimize(graph: Graph, mesh, config, candidates_out=None,
     # best-first is ~linear in depth where the flat search is not
     fn = pick_search_fn(graph)
     kw = {}
+    exclude = getattr(config, "exclude_rules", None)
+    if exclude:
+        # rule-ablation hook (tools/rule_coverage.py --profit): run the
+        # identical search minus the named rules to price each rule's
+        # contribution to the winner
+        from flexflow_tpu.search.substitution import default_xfers
+
+        drop = set(exclude)
+        kw["xfers"] = [x for x in default_xfers(cost.axis_sizes)
+                       if getattr(x, "name", None) not in drop]
     if candidates_out is not None:
         kw["candidates_out"] = candidates_out
         kw["candidates_k"] = max(getattr(config, "validate_top_k", 0), 2)

@@ -613,7 +613,8 @@ def make_cancel_parallel_ops() -> GraphXfer:
     )
 
 
-def default_xfers(axis_sizes: Dict[str, int]) -> List[GraphXfer]:
+def default_xfers(axis_sizes: Dict[str, int],
+                  full_corpus: Optional[bool] = None) -> List[GraphXfer]:
     # linear+activation fusion comes from the JSON corpus
     # (fuse_linear_{relu,gelu,sigmoid,tanh,silu}); registering the
     # hand-coded make_fuse_linear_activation too would double-match every
@@ -638,7 +639,7 @@ def default_xfers(axis_sizes: Dict[str, int]) -> List[GraphXfer]:
     # cancellations, conv/embedding parallelization — xfer_engine.py)
     from flexflow_tpu.search.xfer_engine import default_decl_xfers
 
-    xf += default_decl_xfers(axis_sizes)
+    xf += default_decl_xfers(axis_sizes, full_corpus=full_corpus)
     return xf
 
 
@@ -904,6 +905,10 @@ def unity_search(
     from flexflow_tpu.search.dp import ViewDP
 
     xfers = xfers if xfers is not None else default_xfers(cost.axis_sizes)
+    if stats_out is not None:
+        # corpus-size observability: a truncated (active-set) or inflated
+        # corpus shows up in gate records next to wall_s
+        stats_out["n_xfers"] = len(xfers)
     # one ViewDP across all candidates: its memo keys on (structure hash,
     # boundary views), so shared subgraphs are solved once
     view_dp = (ViewDP(cost, training=training, objective=objective)
@@ -986,6 +991,12 @@ def unity_search(
     input_hash = graph.structure_hash()
     collect(best_cost, graph, best_strategy, input_hash)
     seen = {input_hash}
+    # rewrite provenance: structure hash -> tuple of rule names applied
+    # along the candidate's derivation — the winner's lineage tells the
+    # coverage tool exactly which rules CARRY the result (and are worth
+    # ablation-pricing), at zero extra search cost
+    lineage = {input_hash: ()}
+    best_lineage = ()
     counter = itertools.count()
     heap = [(best_cost, next(counter), graph)]
     expansions = 0
@@ -994,6 +1005,7 @@ def unity_search(
         if c > alpha * best_cost:
             continue
         expansions += 1
+        g_line = lineage.get(g.structure_hash(), ())
         for xfer in xfers:
             cands = xfer.apply_all(g)
             if stats_out is not None and cands:
@@ -1005,10 +1017,12 @@ def unity_search(
                 if h in seen:
                     continue
                 seen.add(h)
+                lineage[h] = g_line + (xfer.name,)
                 cc, ss = evaluate(cand)
                 collect(cc, cand, ss, h)
                 if cc < best_cost:
                     best_graph, best_cost, best_strategy = cand, cc, ss
+                    best_lineage = lineage[h]
                 if cc <= alpha * best_cost:
                     heapq.heappush(heap, (cc, next(counter), cand))
     if stats_out is not None:
@@ -1018,6 +1032,10 @@ def unity_search(
         stats_out["candidates_seen"] = (
             stats_out.get("candidates_seen", 0) + len(seen)
         )
+        wr = stats_out.setdefault("winner_rules", [])
+        for name in best_lineage:
+            if name not in wr:
+                wr.append(name)
         # the sequence-DP path pre-fills the whole-graph baseline; only a
         # direct (flat) call records its own input graph's cost here
         stats_out.setdefault("baseline_cost", initial_cost)

@@ -1,7 +1,15 @@
-"""Rule-fire observability: the search records which corpus rules produce
-candidates (stats_out["rule_fires"]), and the known structural/TP rules
-fire on their natural configs. The full five-config report lives in
-tools/rule_coverage.py (output snapshot: docs/rule_coverage.json)."""
+"""Rule-fire observability + corpus profit gates (VERDICT r4 #3): the
+search records which corpus rules produce candidates
+(stats_out["rule_fires"]) and which rules lie on the WINNER's derivation
+(stats_out["winner_rules"]); the default search only pays match cost for
+the ACTIVE set (rules with demonstrated coverage on the BASELINE +
+InceptionV3 configs, search/rules/active_rules.json), while the full
+corpus stays loadable. The full report lives in tools/rule_coverage.py
+(snapshot: docs/rule_coverage.json)."""
+
+import json
+import os
+import time
 
 import jax
 
@@ -25,3 +33,88 @@ def test_search_records_rule_fires_mixtral_ep():
     # the expert-parallel partition rule must fire on an expert mesh
     assert any("expert" in name for name in fires), fires
     assert stats["expansions"] > 0 and stats["wall_s"] > 0
+
+
+def test_active_rule_set_gates_default_matching():
+    """The default declarative corpus is the ACTIVE subset; the full
+    408-rule corpus stays loadable behind full_corpus=True (383 dead
+    rules must no longer tax every search's match loop)."""
+    from flexflow_tpu.search.xfer_engine import (
+        ACTIVE_RULES_PATH,
+        default_decl_xfers,
+    )
+
+    assert os.path.exists(ACTIVE_RULES_PATH), (
+        "active_rules.json missing — regenerate with "
+        "tools/rule_coverage.py --write-active"
+    )
+    with open(ACTIVE_RULES_PATH) as f:
+        active = set(json.load(f)["active"])
+    assert active, "active set is empty"
+    axis_sizes = {"data": 2, "model": 4, "seq": 1, "expert": 1}
+    default = default_decl_xfers(axis_sizes)
+    full = default_decl_xfers(axis_sizes, full_corpus=True)
+    assert {x.name for x in default} <= active
+    assert len(full) > 2 * len(default), (
+        f"pruning ineffective: {len(default)} active vs {len(full)} full"
+    )
+
+
+def test_winner_lineage_recorded_and_profitable():
+    """The search reports the rules on the winning graph's derivation;
+    on a TP mesh the llama winner's lineage is non-empty and the
+    committed coverage snapshot prices at least one rule with positive
+    profit on some config."""
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+
+    mesh_shape = {"data": 2, "model": 4}
+    cfg = FFConfig(batch_size=8, mesh_shape=mesh_shape, search_budget=12)
+    ff = FFModel(cfg)
+    build_llama(ff, LlamaConfig(vocab_size=256, dim=64, layers=2, heads=4,
+                                kv_heads=2, hidden=128,
+                                rope_theta=10000.0),
+                batch_size=8, seq_len=128)
+    ff.graph.infer_shapes()
+    mesh = make_mesh(mesh_shape, jax.devices())
+    stats = {}
+    graph_optimize(ff.graph, mesh, cfg, stats_out=stats)
+    assert stats.get("winner_rules"), (
+        "no winner lineage recorded on a TP mesh"
+    )
+    snap = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "rule_coverage.json")
+    with open(snap) as f:
+        report = json.load(f)
+    profits = report.get("profit_by_config", {})
+    gains = [
+        (cfg_name, rule, v)
+        for cfg_name, rules in profits.items()
+        if not cfg_name.startswith("_")
+        for rule, v in rules.items() if isinstance(v, float) and v > 0
+    ]
+    assert gains, "coverage snapshot prices no rule with positive profit"
+
+
+def test_search_wall_time_bounded_at_budget_12():
+    """Corpus growth must not silently tax the search (VERDICT r4 weak
+    #6): a budget-12 llama search on the active corpus stays under a
+    generous wall bound on the CI mesh. (The canonical data x model TP
+    mesh: 3-axis meshes multiply ViewDP's per-node view space and sit
+    near 150s regardless of corpus size — a separate cost, not the one
+    this test guards.)"""
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+
+    mesh_shape = {"data": 2, "model": 4}
+    cfg = FFConfig(batch_size=8, mesh_shape=mesh_shape, search_budget=12)
+    ff = FFModel(cfg)
+    build_llama(ff, LlamaConfig(vocab_size=256, dim=64, layers=2, heads=4,
+                                kv_heads=2, hidden=128,
+                                rope_theta=10000.0),
+                batch_size=8, seq_len=128)
+    ff.graph.infer_shapes()
+    mesh = make_mesh(mesh_shape, jax.devices())
+    stats = {}
+    t0 = time.perf_counter()
+    graph_optimize(ff.graph, mesh, cfg, stats_out=stats)
+    wall = time.perf_counter() - t0
+    assert wall < 90.0, f"budget-12 search took {wall:.1f}s"

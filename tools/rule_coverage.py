@@ -1,14 +1,23 @@
-"""Rule-coverage report: which substitution rules ever FIRE on the five
-BASELINE configs (BASELINE.json "configs": AlexNet/CIFAR-10, ResNet-50,
-BERT-base, Llama TP+DP, Mixtral MoE EP).
+"""Rule-coverage + rule-profit report over the BASELINE configs plus
+InceptionV3 (the one family where the reference's concat/merge algebra
+demonstrably fires — substitution.cc:1726-1868).
 
 A rule "fires" when its pattern matches and produces a rewrite candidate
-during a budgeted Unity search over the config's graph on its natural mesh.
-Dead rules are not bugs — a corpus is a library, and e.g. conv rules cannot
-fire on a pure transformer — but a rule dead across ALL five configs is
-worth knowing about (it only earns its keep on exotic graphs).
+during a budgeted Unity search over the config's graph on its natural
+mesh. The search also records each config's WINNER LINEAGE (the rules on
+the winning graph's derivation path, stats_out["winner_rules"]) — rules
+not on any winner's lineage have zero first-order profit, so ablation
+pricing only reruns the search for lineage rules: profit = (winner cost
+with the rule excluded) - (winner cost with it). Positive profit means
+the searched winner is modeled faster because the rule exists.
+
+`--write-active` persists the union of fired rules to
+search/rules/active_rules.json: the default search then only pays match
+cost for rules with demonstrated coverage (FF_TPU_FULL_CORPUS=1 restores
+the full corpus; dead rules stay loadable in default_rules.json).
 
 Usage: python tools/rule_coverage.py [--budget N] [--out FILE.json]
+       [--profit] [--write-active]
 Runs on the CPU backend with an 8-device virtual mesh.
 """
 
@@ -27,12 +36,25 @@ try:
 except Exception:
     pass
 
+PARALLELIZATION_MARKERS = (
+    "_tp_", "col_tp", "row_tp", "data_sub", "ring", "ulysses", "partition",
+    "replicate", "vocab", "gated", "expert", "pipeline", "_dp_",
+)
+
+
+def is_algebraic(name: str) -> bool:
+    """Non-parallelization rule: fusion/cancellation/commutation algebra
+    rather than a sharding proposal."""
+    return not any(m in name for m in PARALLELIZATION_MARKERS)
+
 
 def _configs():
-    """(name, build(ff) -> None, mesh_shape) per BASELINE config; small
-    layer counts — coverage depends on structure, not depth."""
+    """(name, build(ff) -> None, mesh_shape) per BASELINE config plus
+    InceptionV3; small layer counts — coverage depends on structure, not
+    depth."""
     from flexflow_tpu.models.alexnet import build_alexnet_cifar10
     from flexflow_tpu.models.bert import BertConfig, build_bert
+    from flexflow_tpu.models.inception import build_inception_v3
     from flexflow_tpu.models.llama import LlamaConfig, build_llama
     from flexflow_tpu.models.mixtral import MixtralConfig, build_mixtral
     from flexflow_tpu.models.resnet import build_resnet50
@@ -57,61 +79,134 @@ def _configs():
     def mixtral(ff):
         build_mixtral(ff, MixtralConfig.tiny(), batch_size=8, seq_len=32)
 
+    def inception(ff):
+        # 75px input keeps the tiny-config search fast; every inception
+        # block's concat-of-parallel-branches structure is preserved
+        build_inception_v3(ff, batch_size=8, classes=32, image_size=75)
+
     return [
         ("alexnet_cifar10", alexnet, {"data": 2, "model": 4}),
         ("resnet50", resnet, {"data": 2, "model": 4}),
         ("bert_base", bert, {"data": 2, "model": 4}),
         ("llama_tp_dp", llama, {"data": 2, "seq": 2, "model": 2}),
         ("mixtral_ep", mixtral, {"data": 2, "expert": 4}),
+        ("inception_v3", inception, {"data": 2, "model": 4}),
     ]
+
+
+def _search(build, mesh_shape, budget, exclude=None):
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.parallel.mesh import make_mesh
+    from flexflow_tpu.search.api import graph_optimize
+
+    cfg = FFConfig(batch_size=8, mesh_shape=mesh_shape,
+                   search_budget=budget)
+    if exclude:
+        cfg.exclude_rules = list(exclude)
+    ff = FFModel(cfg)
+    build(ff)
+    ff.graph.infer_shapes()
+    mesh = make_mesh(mesh_shape, jax.devices())
+    stats = {}
+    graph_optimize(ff.graph, mesh, cfg, stats_out=stats)
+    return stats
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--budget", type=int, default=12)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--profit", action="store_true",
+                    help="ablate each fired corpus rule and price it")
+    ap.add_argument("--write-active", action="store_true",
+                    help="persist fired-rule set to active_rules.json")
     args = ap.parse_args()
 
-    from flexflow_tpu import FFConfig, FFModel
-    from flexflow_tpu.parallel.mesh import make_mesh
-    from flexflow_tpu.search.api import graph_optimize
-    from flexflow_tpu.search.xfer_engine import DEFAULT_RULES_PATH
+    from flexflow_tpu.search.xfer_engine import (
+        ACTIVE_RULES_PATH,
+        DEFAULT_RULES_PATH,
+    )
+
+    # coverage must observe the FULL corpus, not a previous active set
+    os.environ["FF_TPU_FULL_CORPUS"] = "1"
 
     with open(DEFAULT_RULES_PATH) as f:
         all_rules = [r["name"] for r in json.load(f)]
+    corpus = set(all_rules)
     per_config = {}
+    profit_by_config = {}
     fires_total = {}
+    wall_by_config = {}
     for name, build, mesh_shape in _configs():
-        cfg = FFConfig(batch_size=8, mesh_shape=mesh_shape,
-                       search_budget=args.budget)
-        ff = FFModel(cfg)
-        build(ff)
-        ff.graph.infer_shapes()
-        mesh = make_mesh(mesh_shape, jax.devices())
-        stats = {}
         try:
-            graph_optimize(ff.graph, mesh, cfg, stats_out=stats)
+            stats = _search(build, mesh_shape, args.budget)
         except Exception as e:  # a config that cannot search still reports
             print(f"[{name}] search failed: {e}", file=sys.stderr)
+            stats = {}
         fires = stats.get("rule_fires", {})
         per_config[name] = fires
+        wall_by_config[name] = round(stats.get("wall_s", 0.0), 2)
         for k, v in fires.items():
             fires_total[k] = fires_total.get(k, 0) + v
         print(f"[{name}] {len(fires)} rules fired, "
               f"{stats.get('expansions', 0)} expansions, "
               f"{stats.get('wall_s', 0.0):.1f}s")
+        if args.profit:
+            base_cost = stats.get("best_cost")
+            winner_rules = stats.get("winner_rules", [])
+            # fired-but-not-on-the-lineage rules have zero first-order
+            # profit by construction — record them as 0 without rerunning
+            profits = {r: 0.0 for r in set(fires) & corpus}
+            for rule in sorted(set(winner_rules) & corpus):
+                try:
+                    ab = _search(build, mesh_shape, args.budget,
+                                 exclude=[rule])
+                    without = ab.get("best_cost")
+                    if base_cost is not None and without is not None:
+                        profits[rule] = round(without - base_cost, 9)
+                except Exception as e:
+                    profits[rule] = f"ablation failed: {e}"
+            profit_by_config[name] = profits
+            profit_by_config.setdefault("_winner_rules", {})[name] = \
+                list(winner_rules)
+            gains = {k: v for k, v in profits.items()
+                     if isinstance(v, float) and v > 0}
+            print(f"[{name}] winner lineage {winner_rules}; "
+                  f"{len(gains)} rule(s) with positive profit")
 
-    dead = sorted(set(all_rules) - set(fires_total))
+    dead = sorted(corpus - set(fires_total))
     report = {
         "corpus_size": len(all_rules),
         "fired_any_config": len(fires_total),
         "dead_everywhere": len(dead),
         "dead_rules": dead,
         "fires_by_config": per_config,
+        "wall_s_by_config": wall_by_config,
     }
+    if args.profit:
+        report["profit_by_config"] = profit_by_config
     print(f"\ncorpus: {len(all_rules)} rules; "
-          f"{len(fires_total)} fired on >=1 BASELINE config; "
+          f"{len(fires_total)} fired on >=1 config; "
           f"{len(dead)} dead everywhere")
+    if args.write_active:
+        # hand xfers (ring/pipeline/cancel...) are not corpus rules; the
+        # active file only gates the DECLARATIVE corpus. Parallelization
+        # families stay active for EVERY axis regardless of coverage:
+        # they are the hand-designed sharding proposals, already
+        # mesh-gated by requires_axis, and a config list can never span
+        # all axis combinations (a data_sub or seq-only mesh must still
+        # be offered its TP rules). Only dead ALGEBRAIC rules are pruned.
+        par = {n for n in corpus
+               if any(m in n for m in PARALLELIZATION_MARKERS)}
+        active = sorted((set(fires_total) & corpus) | par)
+        with open(ACTIVE_RULES_PATH, "w") as f:
+            json.dump({
+                "generated_by": "tools/rule_coverage.py --write-active",
+                "configs": [n for n, _, _ in _configs()],
+                "active": active,
+            }, f, indent=1)
+        print(f"wrote {len(active)} active rules to {ACTIVE_RULES_PATH} "
+              f"({len(par)} parallelization + fired algebraic)")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1, sort_keys=True)
