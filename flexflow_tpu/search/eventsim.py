@@ -180,7 +180,7 @@ class _IciChannels:
         participants = math.prod(self.shape[i] for i in part)
         if (self.machine is not None
                 and getattr(self.machine, "chips_per_slice", None) is not None
-                and self.machine._crosses_dcn(participants)):
+                and self.machine._crosses_dcn(participants, tuple(axes))):
             # slice-crossing traffic rides the host NIC, one shared channel
             return broadcast(self._dcn_channel())
         non_primary = [i for i in range(len(self.shape))

@@ -56,6 +56,12 @@ class TPUMachineModel:
     # multi-slice: chips per slice; collectives crossing slices use DCN
     chips_per_slice: Optional[int] = None
     dcn_bw: float = 25e9  # bytes/s per host
+    # ordered mesh axis sizes (outermost first, row-major device order) —
+    # stamped by the search's _cost_model so slice-crossing detection can
+    # use an axis's SPAN (stride x size) instead of its participant count:
+    # a 2-way DP collective over the outermost axis of a 2-slice machine
+    # crosses DCN even though it has only 2 participants per group
+    axis_order: Optional[Dict[str, int]] = None
 
     @staticmethod
     def make(chip: str = "v5e", num_chips: int = 8, **kw) -> "TPUMachineModel":
@@ -95,16 +101,37 @@ class TPUMachineModel:
         torus model maps them onto torus dims for multi-ring bandwidth."""
         return 2 * self.chip.ici_link_bw * self.ici_efficiency
 
-    def _crosses_dcn(self, participants: int) -> bool:
-        return (
-            self.chips_per_slice is not None and participants > self.chips_per_slice
-        )
+    def _axis_span(self, axes) -> Optional[int]:
+        """Device-index span of a collective over mesh `axes` under
+        row-major device order, or None when the axis order is unknown."""
+        if not self.axis_order or not axes:
+            return None
+        names = list(self.axis_order)
+        sizes = [max(int(s), 1) for s in self.axis_order.values()]
+        strides = [1] * len(sizes)
+        for i in range(len(sizes) - 2, -1, -1):
+            strides[i] = strides[i + 1] * sizes[i + 1]
+        span = 1
+        for a in axes:
+            if a in names:
+                i = names.index(a)
+                span = max(span, sizes[i] * strides[i])
+        return span
+
+    def _crosses_dcn(self, participants: int,
+                     axes: Optional[Tuple[str, ...]] = None) -> bool:
+        if self.chips_per_slice is None:
+            return False
+        span = self._axis_span(axes)
+        if span is not None:
+            return span > self.chips_per_slice
+        return participants > self.chips_per_slice
 
     def all_reduce_time(self, bytes_global: float, participants: int,
                  axes: Optional[Tuple[str, ...]] = None) -> float:
         if participants <= 1:
             return 0.0
-        if self._crosses_dcn(participants):
+        if self._crosses_dcn(participants, axes):
             return bytes_global * 2 / self.dcn_bw + self.ici_latency * participants
         moved = 2 * bytes_global * (participants - 1) / participants
         return (moved / self._axis_bw(participants, axes)
@@ -115,7 +142,7 @@ class TPUMachineModel:
         if participants <= 1:
             return 0.0
         moved = bytes_global * (participants - 1) / participants
-        bw = (self.dcn_bw if self._crosses_dcn(participants)
+        bw = (self.dcn_bw if self._crosses_dcn(participants, axes)
               else self._axis_bw(participants, axes))
         return moved / bw + self.ici_latency * participants
 
@@ -129,7 +156,7 @@ class TPUMachineModel:
             return 0.0
         # each chip keeps 1/n, sends (n-1)/n of its shard
         moved = bytes_global * (participants - 1) / (participants * participants)
-        bw = (self.dcn_bw if self._crosses_dcn(participants)
+        bw = (self.dcn_bw if self._crosses_dcn(participants, axes)
               else self._axis_bw(participants, axes))
         return moved / bw + self.ici_latency * participants
 
