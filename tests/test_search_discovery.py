@@ -96,6 +96,31 @@ def test_search_discovers_ring_attention_and_beats_dp():
     assert stats["expansions"] > 0 and stats["wall_s"] > 0
 
 
+def test_search_winner_uses_seq_parallel_at_scale_shapes():
+    """At a scale-shaped config (seq 4096, dim 64) on data x seq:4, full
+    attention's S² term genuinely dominates, so an honest cost model must
+    make the SEARCH WINNER — not merely a retained pool candidate — use
+    ring/Ulysses attention (VERDICT r4 #4: the pool-retention form of the
+    gate can hide dishonest full-MHA pricing at exactly the shapes
+    sequence parallelism exists for)."""
+    ff = _plain_llama(batch=4, seq=4096, layers=2)
+    cfg = FFConfig(batch_size=4, mesh_shape={"data": 2, "seq": 4},
+                   search_budget=12)
+    mesh = __import__("flexflow_tpu.parallel.mesh", fromlist=["make_mesh"]) \
+        .make_mesh({"data": 2, "seq": 4}, jax.devices())
+    stats = {}
+    best_graph, _ = graph_optimize(ff.graph, mesh, cfg, stats_out=stats)
+    n_sp = sum(1 for n in best_graph.nodes
+               if n.op_type == OpType.RING_ATTENTION)
+    assert n_sp > 0, (
+        f"winner skips seq-parallel attention at seq=4096 (best "
+        f"{stats.get('best_cost')}, baseline {stats.get('baseline_cost')})"
+    )
+    # and the modeled win over the unrewritten baseline is substantial at
+    # this shape, not ranking noise
+    assert stats["best_cost"] < stats["baseline_cost"] * 0.9
+
+
 def test_discovered_ring_graph_compiles_and_trains():
     """End to end: compile() with search retains the discovered ring
     candidate in the playoff pool, its REAL train step compiles and runs
