@@ -1050,3 +1050,295 @@ int ffc_config_set_str(ffc_config_t cfg, const char *field,
 }  // extern "C" (vision/MoE/config additions)
 
 }  // extern "C" (checkpoint/strategy/eval/transformer additions)
+
+// ---- long-tail surface (reference python/flexflow_c.cc:181-1751): SGD,
+// initializer objects, elementwise/scalar/reduction/gather/LSTM. These
+// wrappers null-check their handles (the error-path contract the tests
+// exercise: a NULL handle or input sets ffc_last_error instead of
+// crashing).
+
+namespace {
+
+bool require(bool ok, const char *what) {
+  if (!ok) g_error = std::string("null ") + what;
+  return ok;
+}
+
+ffc_tensor_t unary_op(ffc_model_t handle, ffc_tensor_t x,
+                      const char *method) {
+  g_error.clear();
+  if (!require(handle != nullptr, "model handle") ||
+      !require(x != nullptr, "input tensor"))
+    return nullptr;
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(x));
+  PyObject *t = call_method(st->model, method, args);
+  Py_DECREF(args);
+  return t;
+}
+
+ffc_tensor_t binary_op(ffc_model_t handle, ffc_tensor_t a, ffc_tensor_t b,
+                       const char *method) {
+  g_error.clear();
+  if (!require(handle != nullptr, "model handle") ||
+      !require(a != nullptr && b != nullptr, "input tensor"))
+    return nullptr;
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(2, reinterpret_cast<PyObject *>(a),
+                                reinterpret_cast<PyObject *>(b));
+  PyObject *t = call_method(st->model, method, args);
+  Py_DECREF(args);
+  return t;
+}
+
+ffc_tensor_t scalar_op(ffc_model_t handle, ffc_tensor_t x,
+                       const char *method, float scalar) {
+  g_error.clear();
+  if (!require(handle != nullptr, "model handle") ||
+      !require(x != nullptr, "input tensor"))
+    return nullptr;
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = Py_BuildValue("(Of)",
+                                 reinterpret_cast<PyObject *>(x), scalar);
+  PyObject *t = call_method(st->model, method, args);
+  Py_DECREF(args);
+  return t;
+}
+
+ffc_initializer_t make_initializer(const char *cls, PyObject *kwargs) {
+  g_error.clear();
+  PyObject *mod = ff_module();
+  if (!mod) { Py_XDECREF(kwargs); return nullptr; }
+  PyObject *c = PyObject_GetAttrString(mod, cls);
+  if (!c) { set_error_from_python(); Py_XDECREF(kwargs); return nullptr; }
+  PyObject *args = PyTuple_New(0);
+  PyObject *obj = PyObject_Call(c, args, kwargs);
+  Py_DECREF(c);
+  Py_DECREF(args);
+  Py_XDECREF(kwargs);
+  if (!obj) set_error_from_python();
+  return obj;
+}
+
+}  // namespace
+
+extern "C" {
+
+int ffc_model_compile_sgd(ffc_model_t handle, ffc_loss_t loss, float lr,
+                          float momentum, int nesterov,
+                          float weight_decay) {
+  g_error.clear();
+  if (!require(handle != nullptr, "model handle")) return -1;
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *mod = ff_module();
+  if (!mod) return -1;
+  PyObject *opt_cls = PyObject_GetAttrString(mod, "SGDOptimizer");
+  if (!opt_cls) { set_error_from_python(); return -1; }
+  PyObject *okw = Py_BuildValue("{s:f,s:f,s:O,s:f}", "lr", lr, "momentum",
+                                momentum, "nesterov",
+                                nesterov ? Py_True : Py_False,
+                                "weight_decay", weight_decay);
+  PyObject *oargs = PyTuple_New(0);
+  PyObject *opt = PyObject_Call(opt_cls, oargs, okw);
+  Py_DECREF(opt_cls);
+  Py_DECREF(oargs);
+  Py_DECREF(okw);
+  if (!opt) { set_error_from_python(); return -1; }
+  return compile_with_optimizer(st, opt, loss);
+}
+
+ffc_initializer_t ffc_glorot_uniform_initializer_create(int seed) {
+  return make_initializer("GlorotUniformInitializer",
+                          Py_BuildValue("{s:i}", "seed", seed));
+}
+
+ffc_initializer_t ffc_zero_initializer_create(void) {
+  return make_initializer("ZeroInitializer", nullptr);
+}
+
+ffc_initializer_t ffc_constant_initializer_create(float value) {
+  return make_initializer("ConstantInitializer",
+                          Py_BuildValue("{s:f}", "value", value));
+}
+
+ffc_initializer_t ffc_uniform_initializer_create(int seed, float minv,
+                                                 float maxv) {
+  return make_initializer(
+      "UniformInitializer",
+      Py_BuildValue("{s:f,s:f,s:i}", "minv", minv, "maxv", maxv, "seed",
+                    seed));
+}
+
+ffc_initializer_t ffc_norm_initializer_create(int seed, float mean,
+                                              float stddev) {
+  return make_initializer(
+      "NormInitializer",
+      Py_BuildValue("{s:f,s:f,s:i}", "mean", mean, "stddev", stddev,
+                    "seed", seed));
+}
+
+void ffc_initializer_destroy(ffc_initializer_t init) {
+  Py_XDECREF(reinterpret_cast<PyObject *>(init));
+}
+
+ffc_tensor_t ffc_model_dense_init(ffc_model_t handle, ffc_tensor_t input,
+                                  int out_dim, ffc_activation_t act,
+                                  int use_bias,
+                                  ffc_initializer_t kernel_init,
+                                  ffc_initializer_t bias_init) {
+  g_error.clear();
+  if (!require(handle != nullptr, "model handle") ||
+      !require(input != nullptr, "input tensor"))
+    return nullptr;
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *act_obj = enum_member("ActiMode", act_name(act));
+  if (!act_obj) return nullptr;
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue(
+      "{s:i,s:O,s:i,s:O,s:O}", "out_dim", out_dim, "activation", act_obj,
+      "use_bias", use_bias ? 1 : 0, "kernel_initializer",
+      kernel_init ? reinterpret_cast<PyObject *>(kernel_init) : Py_None,
+      "bias_initializer",
+      bias_init ? reinterpret_cast<PyObject *>(bias_init) : Py_None);
+  PyObject *t = call_method(st->model, "dense", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(act_obj);
+  return t;
+}
+
+ffc_tensor_t ffc_model_divide(ffc_model_t m, ffc_tensor_t a,
+                              ffc_tensor_t b) {
+  return binary_op(m, a, b, "divide");
+}
+ffc_tensor_t ffc_model_max(ffc_model_t m, ffc_tensor_t a, ffc_tensor_t b) {
+  return binary_op(m, a, b, "max");
+}
+ffc_tensor_t ffc_model_min(ffc_model_t m, ffc_tensor_t a, ffc_tensor_t b) {
+  return binary_op(m, a, b, "min");
+}
+ffc_tensor_t ffc_model_exp(ffc_model_t m, ffc_tensor_t x) {
+  return unary_op(m, x, "exp");
+}
+ffc_tensor_t ffc_model_sin(ffc_model_t m, ffc_tensor_t x) {
+  return unary_op(m, x, "sin");
+}
+ffc_tensor_t ffc_model_cos(ffc_model_t m, ffc_tensor_t x) {
+  return unary_op(m, x, "cos");
+}
+ffc_tensor_t ffc_model_rsqrt(ffc_model_t m, ffc_tensor_t x) {
+  return unary_op(m, x, "rsqrt");
+}
+ffc_tensor_t ffc_model_identity(ffc_model_t m, ffc_tensor_t x) {
+  return unary_op(m, x, "identity");
+}
+ffc_tensor_t ffc_model_pow(ffc_model_t m, ffc_tensor_t x, float exponent) {
+  return scalar_op(m, x, "pow", exponent);
+}
+ffc_tensor_t ffc_model_scalar_add(ffc_model_t m, ffc_tensor_t x,
+                                  float scalar) {
+  return scalar_op(m, x, "scalar_add", scalar);
+}
+ffc_tensor_t ffc_model_scalar_sub(ffc_model_t m, ffc_tensor_t x,
+                                  float scalar) {
+  return scalar_op(m, x, "scalar_sub", scalar);
+}
+ffc_tensor_t ffc_model_scalar_multiply(ffc_model_t m, ffc_tensor_t x,
+                                       float scalar) {
+  return scalar_op(m, x, "scalar_multiply", scalar);
+}
+ffc_tensor_t ffc_model_scalar_true_divide(ffc_model_t m, ffc_tensor_t x,
+                                          float scalar) {
+  return scalar_op(m, x, "scalar_true_divide", scalar);
+}
+
+ffc_tensor_t ffc_model_reverse(ffc_model_t handle, ffc_tensor_t x,
+                               int axis) {
+  g_error.clear();
+  if (!require(handle != nullptr, "model handle") ||
+      !require(x != nullptr, "input tensor"))
+    return nullptr;
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = Py_BuildValue("(Oi)",
+                                 reinterpret_cast<PyObject *>(x), axis);
+  PyObject *t = call_method(st->model, "reverse", args);
+  Py_DECREF(args);
+  return t;
+}
+
+ffc_tensor_t ffc_model_gather(ffc_model_t handle, ffc_tensor_t input,
+                              ffc_tensor_t index, int axis) {
+  g_error.clear();
+  if (!require(handle != nullptr, "model handle") ||
+      !require(input != nullptr && index != nullptr, "input tensor"))
+    return nullptr;
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = Py_BuildValue("(OOi)",
+                                 reinterpret_cast<PyObject *>(input),
+                                 reinterpret_cast<PyObject *>(index), axis);
+  PyObject *t = call_method(st->model, "gather", args);
+  Py_DECREF(args);
+  return t;
+}
+
+static ffc_tensor_t reduce_op(ffc_model_t handle, ffc_tensor_t input,
+                              const int *axes, int n_axes, int keepdims,
+                              const char *method) {
+  g_error.clear();
+  if (!require(handle != nullptr, "model handle") ||
+      !require(input != nullptr, "input tensor") ||
+      !require(axes != nullptr && n_axes > 0, "reduction axes"))
+    return nullptr;
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *ax = PyTuple_New(n_axes);
+  for (int i = 0; i < n_axes; i++)
+    PyTuple_SetItem(ax, i, PyLong_FromLong(axes[i]));
+  PyObject *args = Py_BuildValue("(ONO)",
+                                 reinterpret_cast<PyObject *>(input), ax,
+                                 keepdims ? Py_True : Py_False);
+  PyObject *t = call_method(st->model, method, args);
+  Py_DECREF(args);
+  return t;
+}
+
+ffc_tensor_t ffc_model_reduce_sum(ffc_model_t m, ffc_tensor_t input,
+                                  const int *axes, int n_axes,
+                                  int keepdims) {
+  return reduce_op(m, input, axes, n_axes, keepdims, "reduce_sum");
+}
+
+ffc_tensor_t ffc_model_mean(ffc_model_t m, ffc_tensor_t input,
+                            const int *axes, int n_axes, int keepdims) {
+  return reduce_op(m, input, axes, n_axes, keepdims, "mean");
+}
+
+int ffc_model_lstm(ffc_model_t handle, ffc_tensor_t input, int hidden,
+                   int use_bias, ffc_tensor_t out[3]) {
+  g_error.clear();
+  if (!require(handle != nullptr, "model handle") ||
+      !require(input != nullptr, "input tensor") ||
+      !require(out != nullptr, "output array"))
+    return -1;
+  auto *st = reinterpret_cast<ModelState *>(handle);
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(input));
+  PyObject *kwargs = Py_BuildValue("{s:i,s:i}", "hidden", hidden,
+                                   "use_bias", use_bias ? 1 : 0);
+  PyObject *tup = call_method(st->model, "lstm", args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  if (!tup) return -1;
+  if (!PyTuple_Check(tup) || PyTuple_Size(tup) != 3) {
+    g_error = "lstm did not return (outputs, h_n, c_n)";
+    Py_DECREF(tup);
+    return -1;
+  }
+  for (int i = 0; i < 3; i++) {
+    PyObject *t = PyTuple_GetItem(tup, i);
+    Py_INCREF(t);
+    out[i] = t;
+  }
+  Py_DECREF(tup);
+  return 0;
+}
+
+}  // extern "C" (long-tail additions)

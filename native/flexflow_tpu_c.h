@@ -209,6 +209,66 @@ ffc_tensor_t ffc_model_moe(ffc_model_t model, ffc_tensor_t input,
                            int num_exp, int num_select, int expert_hidden,
                            float alpha, float lambda_bal);
 
+/* ---- optimizers (long tail: SGD from C, reference
+ * flexflow_sgd_optimizer_create, python/flexflow_c.cc:181-260) ---- */
+int ffc_model_compile_sgd(ffc_model_t model, ffc_loss_t loss, float lr,
+                          float momentum, int nesterov, float weight_decay);
+
+/* ---- initializer objects (reference flexflow_glorot_uniform_/
+ * zero_/uniform_/norm_initializer_create) ---- */
+typedef void *ffc_initializer_t;
+ffc_initializer_t ffc_glorot_uniform_initializer_create(int seed);
+ffc_initializer_t ffc_zero_initializer_create(void);
+ffc_initializer_t ffc_constant_initializer_create(float value);
+ffc_initializer_t ffc_uniform_initializer_create(int seed, float minv,
+                                                 float maxv);
+ffc_initializer_t ffc_norm_initializer_create(int seed, float mean,
+                                              float stddev);
+void ffc_initializer_destroy(ffc_initializer_t init);
+/* dense with explicit initializers (NULL entries keep layer defaults) */
+ffc_tensor_t ffc_model_dense_init(ffc_model_t model, ffc_tensor_t input,
+                                  int out_dim, ffc_activation_t act,
+                                  int use_bias,
+                                  ffc_initializer_t kernel_init,
+                                  ffc_initializer_t bias_init);
+
+/* ---- elementwise / scalar / reduction / gather / recurrent long tail
+ * (reference python/flexflow_c.cc:560-1751) ---- */
+ffc_tensor_t ffc_model_divide(ffc_model_t model, ffc_tensor_t a,
+                              ffc_tensor_t b);
+ffc_tensor_t ffc_model_max(ffc_model_t model, ffc_tensor_t a,
+                           ffc_tensor_t b);
+ffc_tensor_t ffc_model_min(ffc_model_t model, ffc_tensor_t a,
+                           ffc_tensor_t b);
+ffc_tensor_t ffc_model_exp(ffc_model_t model, ffc_tensor_t x);
+ffc_tensor_t ffc_model_sin(ffc_model_t model, ffc_tensor_t x);
+ffc_tensor_t ffc_model_cos(ffc_model_t model, ffc_tensor_t x);
+ffc_tensor_t ffc_model_rsqrt(ffc_model_t model, ffc_tensor_t x);
+ffc_tensor_t ffc_model_pow(ffc_model_t model, ffc_tensor_t x,
+                           float exponent);
+ffc_tensor_t ffc_model_identity(ffc_model_t model, ffc_tensor_t x);
+ffc_tensor_t ffc_model_scalar_add(ffc_model_t model, ffc_tensor_t x,
+                                  float scalar);
+ffc_tensor_t ffc_model_scalar_sub(ffc_model_t model, ffc_tensor_t x,
+                                  float scalar);
+ffc_tensor_t ffc_model_scalar_multiply(ffc_model_t model, ffc_tensor_t x,
+                                       float scalar);
+ffc_tensor_t ffc_model_scalar_true_divide(ffc_model_t model,
+                                          ffc_tensor_t x, float scalar);
+ffc_tensor_t ffc_model_reverse(ffc_model_t model, ffc_tensor_t x,
+                               int axis);
+ffc_tensor_t ffc_model_gather(ffc_model_t model, ffc_tensor_t input,
+                              ffc_tensor_t index, int axis);
+ffc_tensor_t ffc_model_reduce_sum(ffc_model_t model, ffc_tensor_t input,
+                                  const int *axes, int n_axes,
+                                  int keepdims);
+ffc_tensor_t ffc_model_mean(ffc_model_t model, ffc_tensor_t input,
+                            const int *axes, int n_axes, int keepdims);
+/* LSTM over (batch, seq, dim): fills out[0..2] = {seq_out, h_n, c_n};
+ * returns 0/-1 (reference legacy NMT LSTM, nmt/rnn.h:161) */
+int ffc_model_lstm(ffc_model_t model, ffc_tensor_t input, int hidden,
+                   int use_bias, ffc_tensor_t out[3]);
+
 /* ---- config knobs ----
  * Set any FFConfig field by name BEFORE ffc_model_create, e.g.
  *   ffc_config_set_int(cfg, "search_budget", 12);

@@ -49,3 +49,10 @@ def test_c_api_trains_mlp(tmp_path):
     assert "C_API_CNN_OK" in r.stdout, r.stdout
     assert "C_API_STRUCT_OK" in r.stdout, r.stdout
     assert "C_API_MOE_OK" in r.stdout, r.stdout
+    # round 5: the long tail — SGD-with-momentum compile, initializer
+    # objects, scalar/elementwise/reduction entry points, LSTM from C,
+    # and the error-path contract (NULL handles / bad dims set
+    # ffc_last_error instead of crashing)
+    assert "C_API_LONGTAIL_OK" in r.stdout, r.stdout
+    assert "C_API_LSTM_OK" in r.stdout, r.stdout
+    assert "C_API_ERRORS_OK" in r.stdout, r.stdout
