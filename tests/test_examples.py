@@ -33,6 +33,7 @@ def _run(script, *flags, timeout=420):
     ("bert_attribute_parallel.py", ("-b", "8", "--mesh", "data=2,model=4")),
     ("mixtral_moe.py", ("-b", "8", "--mesh", "data=2,expert=4")),
     ("resnet_torch_import.py", ("-b", "8",)),
+    ("hf_finetune.py", ("-b", "4",)),
     ("inception_v3.py", ("-b", "4",)),
     ("candle_uno.py", ("-b", "16",)),
     ("dlrm_train.py", ("-b", "32",)),
