@@ -42,6 +42,8 @@ def main(argv=None):
     path = None
     if "--model" in args:
         i = args.index("--model")
+        if i + 1 >= len(args):
+            raise ValueError("flag --model requires a checkpoint path")
         path = args[i + 1]
         del args[i:i + 2]
     cfg = FFConfig.from_args(args)

@@ -28,9 +28,26 @@ import numpy as np
 
 
 def hf_to_llama_config(hf_cfg):
-    """Map a transformers LlamaConfig(-like) onto the native LlamaConfig."""
+    """Map a transformers LlamaConfig(-like) onto the native LlamaConfig.
+    Raises on config flags the import would silently get wrong (biases,
+    non-silu activations, decoupled head_dim) — lookalike checkpoints
+    must fail loudly, not produce wrong logits."""
     from flexflow_tpu.models.llama import LlamaConfig
 
+    for flag in ("attention_bias", "mlp_bias"):
+        if getattr(hf_cfg, flag, False):
+            raise ValueError(
+                f"unsupported HF config: {flag}=True (bias tensors would "
+                "be silently dropped)")
+    act = getattr(hf_cfg, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise ValueError(f"unsupported HF config: hidden_act={act!r} "
+                         "(the native Llama MLP is gated silu)")
+    hd = getattr(hf_cfg, "head_dim", None)
+    if hd not in (None, hf_cfg.hidden_size // hf_cfg.num_attention_heads):
+        raise ValueError(
+            f"unsupported HF config: head_dim={hd} decoupled from "
+            f"hidden_size//num_attention_heads")
     return LlamaConfig(
         vocab_size=hf_cfg.vocab_size,
         dim=hf_cfg.hidden_size,
@@ -91,7 +108,15 @@ def copy_hf_weights(hf_model, ff) -> int:
         put(f"l{i}_up", _t(layer.mlp.up_proj.weight).T, "kernel")
         put(f"l{i}_down", _t(layer.mlp.down_proj.weight).T, "kernel")
     put("final_norm", _t(base.norm.weight), "scale")
-    head = (base.embed_tokens.weight if cfg.tie_word_embeddings
-            else hf_model.lm_head.weight)
+    if cfg.tie_word_embeddings:
+        import warnings
+
+        warnings.warn(
+            "tie_word_embeddings checkpoint: the embedding is COPIED into "
+            "a separate lm_head parameter — fine-tuning trains them "
+            "independently (the tie invariant is not preserved)")
+        head = base.embed_tokens.weight
+    else:
+        head = hf_model.lm_head.weight
     put("lm_head", _t(head).T, "kernel")
     return copied

@@ -122,7 +122,14 @@ def apply_rope(x, theta: float, pos_offset=0):
     """Rotary position embedding, half-split (rotate_half) convention.
     x: (B, S, H, D). `pos_offset` is a scalar, or a (B,) vector of per-row
     offsets (continuous-batching decode: every slot sits at its own
-    absolute position)."""
+    absolute position).
+
+    Angles and sin/cos are computed in fp32 (position precision), but the
+    rotation itself runs in the ACTIVATION dtype: upcasting the whole
+    (B,S,H,D) tensor to fp32 made the backward materialize fp32 cotangent
+    converts+relayouts (~1.3 GB/step at the 1b bench config,
+    tools/hlo_transpose_audit.py); rotation values are in [-1,1] so bf16
+    rotation costs ~2^-8 relative error — far below bf16 matmul noise."""
     B, S, H, D = x.shape
     if D % 2 != 0:
         raise ValueError(f"RoPE requires an even head dim, got {D}")
@@ -131,12 +138,11 @@ def apply_rope(x, theta: float, pos_offset=0):
     off = jnp.asarray(pos_offset, jnp.float32).reshape(-1, 1)  # (B|1, 1)
     pos = jnp.arange(S, dtype=jnp.float32)[None, :] + off      # (B|1, S)
     ang = pos[:, :, None] * freqs[None, None, :]  # (B|1, S, d2)
-    cos = jnp.cos(ang)[:, :, None, :]
-    sin = jnp.sin(ang)[:, :, None, :]
-    xf = x.astype(jnp.float32)
-    x1, x2 = xf[..., :d2], xf[..., d2:]
-    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
-    return out.astype(x.dtype)
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                           axis=-1)
 
 
 def qkv_project(x, w, dt):
