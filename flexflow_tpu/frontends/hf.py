@@ -48,6 +48,18 @@ def hf_to_llama_config(hf_cfg):
         raise ValueError(
             f"unsupported HF config: head_dim={hd} decoupled from "
             f"hidden_size//num_attention_heads")
+    scaling = getattr(hf_cfg, "rope_scaling", None)
+    if scaling not in (None, {}) and (
+            not isinstance(scaling, dict)
+            or scaling.get("rope_type", scaling.get("type")) != "default"):
+        raise ValueError(
+            f"unsupported HF config: rope_scaling={scaling!r} (positions "
+            "would be rotated with unscaled theta — Llama-3.1-style "
+            "scaled RoPE is not implemented)")
+    prf = getattr(hf_cfg, "partial_rotary_factor", 1.0)
+    if prf not in (None, 1.0):
+        raise ValueError(
+            f"unsupported HF config: partial_rotary_factor={prf}")
     return LlamaConfig(
         vocab_size=hf_cfg.vocab_size,
         dim=hf_cfg.hidden_size,

@@ -121,15 +121,7 @@ class ViewDP:
             # tables (the reference's strict-hash cost cache discipline);
             # each combination is then a cheap table sum instead of a full
             # graph_cost walk.
-            from flexflow_tpu.search.table import build_table
-
-            base = dict(fixed)
-            for n in graph.nodes:
-                if n.name not in base and n.outputs:
-                    base[n.name] = space.ShardingView(
-                        (space.batch_spec(n.outputs[0].ndim),)
-                    )
-            table = build_table(graph, self.cost, cands, base, self.training)
+            table = self._priced_table(graph, cands, fixed)
             searchable = table.searchable()
 
             def tab_cost(a) -> float:
@@ -200,22 +192,60 @@ class ViewDP:
                 merged.update(s2)
                 return merged
 
-        # fallback: coordinate descent (2 sweeps)
-        names = list(cands)
-        strategy = dict(fixed)
-        for n in names:
-            strategy[n] = cands[n][0]
+        # fallback: coordinate descent (2 sweeps) on a priced StrategyTable
+        # — each flip is a table sum instead of a full graph_cost walk
+        # (the r4 form re-walked the graph per candidate flip, and on
+        # 3-axis meshes that dominated the whole search: ~550s of a
+        # budget-12 llama solve was spent here)
+        table = self._priced_table(graph, cands, fixed)
+
+        def tab_cost(a) -> float:
+            t, m = table.eval(a)
+            return self.objective(t, m) if self.objective else t
+
+        # seed from each node's FIRST candidate (substitution-carried
+        # views come first in _candidates): starting from the all-base
+        # assignment would reset a rewrite's coupled TP chain to DP, and
+        # single flips cannot climb back across the resharding barrier
+        assign = [0] * len(table.nodes)
+        searchable = table.searchable()
+        for i, node in enumerate(table.nodes):
+            first = cands.get(node.name, (None,))[0]
+            if first is not None and first in table.views[i]:
+                assign[i] = table.views[i].index(first)
+        cur = tab_cost(assign)
         for _ in range(2):
-            for n in names:
-                best_v, best_c = strategy[n], float("inf")
-                for v in cands[n]:
-                    s = dict(strategy)
-                    s[n] = v
-                    c = self._eval(graph, s)
-                    if c < best_c:
-                        best_v, best_c = v, c
-                strategy[n] = best_v
+            improved = False
+            for i in searchable:
+                best_k, best_c = assign[i], cur
+                for k in range(len(table.views[i])):
+                    if k == assign[i]:
+                        continue
+                    assign[i] = k
+                    c = tab_cost(assign)
+                    if c < best_c - 1e-15:
+                        best_k, best_c = k, c
+                assign[i] = best_k
+                if best_c < cur - 1e-15:
+                    cur, improved = best_c, True
+            if not improved:
+                break
+        strategy = dict(fixed)
+        strategy.update(table.to_strategy(assign))
         return strategy
+
+    def _priced_table(self, graph: Graph, cands, fixed):
+        """StrategyTable over `cands` with non-candidate nodes held at the
+        divisibility/submesh-aware DP defaults (the same base optimize()
+        fills) — a naive batch spec here would both mis-price choice-free
+        nodes inside the table and leak worse-than-default views into the
+        returned strategy. Shared by the exhaustive and coordinate-descent
+        branches so the two can never price the same graph differently."""
+        from flexflow_tpu.search.table import build_table
+
+        base = space.default_dp_strategy(graph, self.cost.axis_sizes)
+        base.update(fixed)
+        return build_table(graph, self.cost, cands, base, self.training)
 
 
 def greedy_polish(graph: Graph, strategy: Dict[str, ShardingView],
