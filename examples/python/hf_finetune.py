@@ -22,7 +22,11 @@ def load_hf_model(path=None):
     from transformers import LlamaConfig, LlamaForCausalLM
 
     if path:
-        return LlamaForCausalLM.from_pretrained(path)
+        # Llama-family or GPT-2 checkpoints (import_hf_causal_lm dispatches
+        # on config.model_type)
+        from transformers import AutoModelForCausalLM
+
+        return AutoModelForCausalLM.from_pretrained(path)
     # no checkpoint given: a tiny locally-constructed Llama (same class a
     # pretrained checkpoint loads into; CI-safe, no network)
     cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,

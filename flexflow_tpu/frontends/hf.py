@@ -14,10 +14,11 @@ the imported model runs the framework's own fused/flash lowerings rather
 than a replayed torch op graph.
 
 Supported: Llama-family causal LMs (LlamaForCausalLM and lookalikes with
-q/k/v/o_proj + gate/up/down_proj + RMSNorm). `import_hf_causal_lm`
-builds the graph; `copy_hf_weights` pushes the checkpoint into a
-compiled model; logits parity against the torch reference is tested in
-tests/test_hf_import.py.
+q/k/v/o_proj + gate/up/down_proj + RMSNorm) and GPT-2 (GPT2LMHeadModel:
+pre-LN, learned positions, fused c_attn, tanh-GELU). `import_hf_causal_lm`
+dispatches on config.model_type, builds the graph; `copy_hf_weights`
+pushes the checkpoint into a compiled model; logits parity against the
+torch reference is tested in tests/test_hf_import.py.
 """
 
 from __future__ import annotations
@@ -73,10 +74,52 @@ def hf_to_llama_config(hf_cfg):
     )
 
 
+def hf_to_gpt2_config(hf_cfg):
+    from flexflow_tpu.models.gpt2 import GPT2Config
+
+    act = getattr(hf_cfg, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        # exact-erf 'gelu' would silently drift: the lowering uses the
+        # tanh approximation (jax.nn.gelu default)
+        raise ValueError(f"unsupported GPT-2 activation {act!r} "
+                         "(only tanh-approximate GELU is faithful)")
+    if getattr(hf_cfg, "scale_attn_by_inverse_layer_idx", False):
+        raise ValueError("unsupported GPT-2 config: "
+                         "scale_attn_by_inverse_layer_idx=True")
+    if getattr(hf_cfg, "reorder_and_upcast_attn", False):
+        raise ValueError("unsupported GPT-2 config: "
+                         "reorder_and_upcast_attn=True")
+    if not getattr(hf_cfg, "scale_attn_weights", True):
+        raise ValueError("unsupported GPT-2 config: "
+                         "scale_attn_weights=False (attention is built "
+                         "with the standard 1/sqrt(head_dim) scale)")
+    return GPT2Config(
+        vocab_size=hf_cfg.vocab_size,
+        dim=hf_cfg.n_embd,
+        layers=hf_cfg.n_layer,
+        heads=hf_cfg.n_head,
+        inner=getattr(hf_cfg, "n_inner", None) or 0,
+        ln_eps=getattr(hf_cfg, "layer_norm_epsilon", 1e-5),
+    )
+
+
 def import_hf_causal_lm(hf_model, ff, batch_size: Optional[int] = None,
                         seq_len: int = 128):
-    """Build the framework graph for `hf_model` (a Llama-family
-    *ForCausalLM). Call ff.compile(...) then copy_hf_weights()."""
+    """Build the framework graph for `hf_model` (a Llama-family or GPT-2
+    *LMHeadModel/*ForCausalLM). Call ff.compile(...) then
+    copy_hf_weights()."""
+    mt = getattr(hf_model.config, "model_type", "llama")
+    if mt == "gpt2":
+        from flexflow_tpu.models.gpt2 import build_gpt2
+
+        n_pos = getattr(hf_model.config, "n_positions", None)
+        if n_pos is not None and seq_len > n_pos:
+            raise ValueError(
+                f"seq_len={seq_len} exceeds the checkpoint's learned "
+                f"position table (n_positions={n_pos})")
+        cfg = hf_to_gpt2_config(hf_model.config)
+        build_gpt2(ff, cfg, batch_size=batch_size, seq_len=seq_len)
+        return cfg
     from flexflow_tpu.models.llama import build_llama
 
     cfg = hf_to_llama_config(hf_model.config)
@@ -92,7 +135,10 @@ def copy_hf_weights(hf_model, ff) -> int:
     """Push every HF checkpoint tensor into the compiled model; returns
     the number of weights copied. torch nn.Linear stores [out, in] — the
     framework's dense kernel is [in, out] and attention weights are the
-    3-D [E,H,D]/[H,D,E] layouts of ops/jax_ops.qkv_project."""
+    3-D [E,H,D]/[H,D,E] layouts of ops/jax_ops.qkv_project. GPT-2's
+    Conv1D already stores [in, out]."""
+    if getattr(hf_model.config, "model_type", "llama") == "gpt2":
+        return _copy_gpt2_weights(hf_model, ff)
     cfg = hf_model.config
     H = cfg.num_attention_heads
     Hkv = getattr(cfg, "num_key_value_heads", H)
@@ -121,13 +167,64 @@ def copy_hf_weights(hf_model, ff) -> int:
         put(f"l{i}_down", _t(layer.mlp.down_proj.weight).T, "kernel")
     put("final_norm", _t(base.norm.weight), "scale")
     if cfg.tie_word_embeddings:
-        import warnings
-
-        warnings.warn(
-            "tie_word_embeddings checkpoint: the embedding is COPIED into "
-            "a separate lm_head parameter — fine-tuning trains them "
-            "independently (the tie invariant is not preserved)")
+        _warn_untied()
         head = base.embed_tokens.weight
+    else:
+        head = hf_model.lm_head.weight
+    put("lm_head", _t(head).T, "kernel")
+    return copied
+
+
+def _warn_untied():
+    import warnings
+
+    warnings.warn(
+        "tie_word_embeddings checkpoint: the embedding is COPIED into "
+        "a separate lm_head parameter — fine-tuning trains them "
+        "independently (the tie invariant is not preserved)")
+
+
+def _copy_gpt2_weights(hf_model, ff) -> int:
+    cfg = hf_model.config
+    H, E = cfg.n_head, cfg.n_embd
+    hd = E // H
+    base = hf_model.transformer
+    seq_len = next(n for n in ff.graph.nodes
+                   if n.name == "wpe").outputs[0].dims[0].size
+    copied = 0
+
+    def put(name, arr, weight_name):
+        nonlocal copied
+        ff.set_weight(name, np.ascontiguousarray(arr), weight_name)
+        copied += 1
+
+    put("wte", _t(base.wte.weight), "kernel")
+    put("wpe", _t(base.wpe.weight)[:seq_len], "weight")
+    for i, blk in enumerate(base.h):
+        put(f"h{i}_ln1", _t(blk.ln_1.weight), "scale")
+        put(f"h{i}_ln1", _t(blk.ln_1.bias), "bias")
+        # fused c_attn (Conv1D [E, 3E]): columns are q|k|v
+        w = _t(blk.attn.c_attn.weight)
+        bqkv = _t(blk.attn.c_attn.bias)
+        for j, nm in enumerate("qkv"):
+            put(f"h{i}_attn", w[:, j * E:(j + 1) * E].reshape(E, H, hd),
+                f"w{nm}")
+            put(f"h{i}_attn", bqkv[j * E:(j + 1) * E].reshape(H, hd),
+                f"b{nm}")
+        put(f"h{i}_attn", _t(blk.attn.c_proj.weight).reshape(H, hd, E),
+            "wo")
+        put(f"h{i}_attn", _t(blk.attn.c_proj.bias), "bo")
+        put(f"h{i}_ln2", _t(blk.ln_2.weight), "scale")
+        put(f"h{i}_ln2", _t(blk.ln_2.bias), "bias")
+        put(f"h{i}_fc", _t(blk.mlp.c_fc.weight), "kernel")
+        put(f"h{i}_fc", _t(blk.mlp.c_fc.bias), "bias")
+        put(f"h{i}_proj", _t(blk.mlp.c_proj.weight), "kernel")
+        put(f"h{i}_proj", _t(blk.mlp.c_proj.bias), "bias")
+    put("ln_f", _t(base.ln_f.weight), "scale")
+    put("ln_f", _t(base.ln_f.bias), "bias")
+    if getattr(cfg, "tie_word_embeddings", True):
+        _warn_untied()  # stock GPT-2 ties lm_head to wte
+        head = base.wte.weight
     else:
         head = hf_model.lm_head.weight
     put("lm_head", _t(head).T, "kernel")

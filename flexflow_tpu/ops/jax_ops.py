@@ -374,6 +374,22 @@ _BINARY = {
 @register_lowering(OpType.ELEMENT_BINARY)
 def _element_binary(attrs, inputs, params, ctx):
     a, b = inputs
+    # learned-position tables under KV-cache decode: an add of a (S, E)
+    # weight row table onto (B, s, E) activations must take the rows at
+    # the CURRENT cache position — prefill sees rows [0, s), a
+    # single-token step sees row [pos] (GPT-2/BERT-style absolute
+    # positions; training/full-seq shapes never hit this branch)
+    if (attrs.kind == "add" and ctx.cache_position is not None
+            and hasattr(b, "ndim") and hasattr(a, "ndim")
+            and b.ndim == a.ndim - 1 and a.ndim >= 3
+            and b.shape[0] != a.shape[1]):
+        pos = jnp.asarray(ctx.cache_position)
+        if pos.ndim == 0:
+            rows = lax.dynamic_slice_in_dim(b, pos, a.shape[1], axis=0)
+            b = rows[None]
+        else:
+            # continuous batching: per-row positions, single-token steps
+            b = b[pos][:, None]
     return [_BINARY[attrs.kind](a, b)]
 
 
