@@ -189,8 +189,12 @@ def _copy_gpt2_weights(hf_model, ff) -> int:
     H, E = cfg.n_head, cfg.n_embd
     hd = E // H
     base = hf_model.transformer
-    seq_len = next(n for n in ff.graph.nodes
-                   if n.name == "wpe").outputs[0].dims[0].size
+    wpe_node = next((n for n in ff.graph.nodes if n.name == "wpe"), None)
+    if wpe_node is None:
+        raise ValueError(
+            "graph has no 'wpe' node — was the model built by "
+            "import_hf_causal_lm/build_gpt2 before compile?")
+    seq_len = wpe_node.outputs[0].dims[0].size
     copied = 0
 
     def put(name, arr, weight_name):

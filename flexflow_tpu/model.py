@@ -255,6 +255,18 @@ class FFModel:
     def add(self, x, y, name=None):
         return self._binary("add", x, y, name)
 
+    def add_position_embedding(self, x, table, name=None):
+        """Add a learned absolute-position row table (seq_len, dim) onto
+        (batch, seq, dim) activations. Unlike a plain add, the op is
+        MARKED as a position table: KV-cache decode slices the rows at
+        the cache position, and generate() refuses lengths beyond the
+        table (GPT-2/BERT-style positions)."""
+        return self._one(
+            OpType.ELEMENT_BINARY,
+            A.ElementBinaryAttrs("add", position_table=True),
+            [x, table], name or "add_pos",
+        )
+
     def subtract(self, x, y, name=None):
         return self._binary("subtract", x, y, name)
 
@@ -1122,6 +1134,17 @@ class FFModel:
         ex = self.executor
         prompt_ids = np.asarray(prompt_ids, np.int32)
         b, s = prompt_ids.shape
+        # learned-position models: decode must not run past the position
+        # table (the in-jit slice would silently clamp to the last row)
+        for n in self.graph.nodes:
+            if getattr(n.attrs, "position_table", False):
+                ins = self.graph.input_shapes(n)
+                rows = ins[1].dims[0].size if len(ins) > 1 else None
+                if rows is not None and s + max_new_tokens > rows:
+                    raise ValueError(
+                        f"prompt ({s}) + max_new_tokens ({max_new_tokens}) "
+                        f"exceeds the learned position table ({rows} rows); "
+                        "rebuild the model with a longer seq_len")
         if s < 1:
             raise ValueError("prompt must contain at least one token")
         caches = ex.init_kv_cache(b, s + max_new_tokens)

@@ -34,7 +34,7 @@ def build_bert(ff: FFModel, cfg: BertConfig, batch_size: int = None,
     h = ff.embedding(ids, cfg.vocab_size, cfg.hidden, dtype=dtype, name="tok_emb")
     # learned positional embedding via a standalone weight broadcast-added
     pos = ff.create_weight((seq_len, cfg.hidden), dtype, name="pos_emb")
-    h = ff.add(h, pos, name="add_pos")
+    h = ff.add_position_embedding(h, pos, name="add_pos")
     h = ff.layer_norm(h, name="emb_ln")
     for i in range(cfg.layers):
         a = ff.multihead_attention(

@@ -41,7 +41,7 @@ def build_gpt2(ff: FFModel, cfg: GPT2Config, batch_size: int = None,
     ids = ff.create_tensor((b, seq_len), DataType.INT32, name="input_ids")
     h = ff.embedding(ids, cfg.vocab_size, cfg.dim, dtype=dtype, name="wte")
     pos = ff.create_weight((seq_len, cfg.dim), dtype, name="wpe")
-    h = ff.add(h, pos, name="add_pos")
+    h = ff.add_position_embedding(h, pos, name="add_pos")
     for i in range(cfg.layers):
         a = ff.layer_norm(h, eps=cfg.ln_eps, name=f"h{i}_ln1")
         a = ff.multihead_attention(a, a, a, cfg.dim, cfg.heads, bias=True,

@@ -351,6 +351,11 @@ class ElementBinaryAttrs(OpAttrs):
     src/ops/element_binary.cc)."""
 
     kind: str  # add|subtract|multiply|divide|max|min
+    # marks an add of an absolute-position row table (GPT-2/BERT learned
+    # positions): under KV-cache decode the lowering takes the table rows
+    # at the cache position, and generate() guards total length against
+    # the table size — an explicit graph property, not a shape heuristic
+    position_table: bool = False
 
     def infer(self, a: Shape, b: Shape):
         out = broadcast_dims(

@@ -374,15 +374,15 @@ _BINARY = {
 @register_lowering(OpType.ELEMENT_BINARY)
 def _element_binary(attrs, inputs, params, ctx):
     a, b = inputs
-    # learned-position tables under KV-cache decode: an add of a (S, E)
-    # weight row table onto (B, s, E) activations must take the rows at
-    # the CURRENT cache position — prefill sees rows [0, s), a
-    # single-token step sees row [pos] (GPT-2/BERT-style absolute
-    # positions; training/full-seq shapes never hit this branch)
-    if (attrs.kind == "add" and ctx.cache_position is not None
-            and hasattr(b, "ndim") and hasattr(a, "ndim")
-            and b.ndim == a.ndim - 1 and a.ndim >= 3
-            and b.shape[0] != a.shape[1]):
+    # learned-position tables (attrs.position_table, set by
+    # add_position_embedding) under KV-cache decode: the (S, E) row table
+    # adds its rows at the CURRENT cache position — prefill sees rows
+    # [pos, pos+s), a single-token step its own row. An explicit graph
+    # property rather than a shape heuristic: a chunked prefill starting
+    # at pos>0 with chunk length == table size would fool any sniffing.
+    # generate() guards total length against the table size up front
+    # (dynamic_slice clamps rather than faults inside jit).
+    if getattr(attrs, "position_table", False) and ctx.cache_position is not None:
         pos = jnp.asarray(ctx.cache_position)
         if pos.ndim == 0:
             rows = lax.dynamic_slice_in_dim(b, pos, a.shape[1], axis=0)

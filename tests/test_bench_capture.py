@@ -1,0 +1,66 @@
+"""Round-long bench capture resilience (VERDICT r4 #1): green results
+persist to a cache file, and when the backend tunnel is down at capture
+time bench.py emits the labeled last-green artifact instead of a 0.0
+diagnostic — but never answers a request for one config with a result
+measured at another. Parent-side logic only (never touches jax)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_persist_and_fallback_roundtrip(tmp_path, capsys, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_GREEN_PATH",
+                        str(tmp_path / "last_green.json"))
+    res = {"metric": "llama_1b_train_tokens_per_sec", "value": 123.0,
+           "unit": "tokens/s", "vs_baseline": 1.4}
+    bench._persist_green(res)
+    saved = json.loads((tmp_path / "last_green.json").read_text())
+    assert saved["value"] == 123.0 and "_captured" in saved
+
+    bench._emit_last_green_or({"value": 0.0}, exit_code=3)
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["cached"] is True and out["value"] == 123.0
+    assert "cache_note" in out
+
+
+def test_fallback_refuses_wrong_config(tmp_path, capsys, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_GREEN_PATH",
+                        str(tmp_path / "last_green.json"))
+    bench._persist_green({"metric": "llama_200m_train_tokens_per_sec",
+                          "value": 77.0, "unit": "tokens/s",
+                          "vs_baseline": 1.6})
+    # a 1b request must NOT be answered with the cached 200m number
+    try:
+        bench._emit_last_green_or(
+            {"metric": "llama_1b_train_tokens_per_sec", "value": 0.0},
+            exit_code=4, want="1b")
+    except SystemExit as e:
+        assert e.code == 4
+    else:
+        raise AssertionError("expected SystemExit on config mismatch")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 0.0 and "cached" not in out
+
+
+def test_smoke_results_never_persist(tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_GREEN_PATH",
+                        str(tmp_path / "last_green.json"))
+    monkeypatch.setenv("FLEXFLOW_BENCH_SMOKE", "1")
+    bench._persist_green({"metric": "llama_smoke_train_tokens_per_sec",
+                          "value": 9.0})
+    assert not (tmp_path / "last_green.json").exists()
