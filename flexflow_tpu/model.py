@@ -1136,15 +1136,12 @@ class FFModel:
         b, s = prompt_ids.shape
         # learned-position models: decode must not run past the position
         # table (the in-jit slice would silently clamp to the last row)
-        for n in self.graph.nodes:
-            if getattr(n.attrs, "position_table", False):
-                ins = self.graph.input_shapes(n)
-                rows = ins[1].dims[0].size if len(ins) > 1 else None
-                if rows is not None and s + max_new_tokens > rows:
-                    raise ValueError(
-                        f"prompt ({s}) + max_new_tokens ({max_new_tokens}) "
-                        f"exceeds the learned position table ({rows} rows); "
-                        "rebuild the model with a longer seq_len")
+        rows = self.position_table_rows()
+        if rows is not None and s + max_new_tokens > rows:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the learned position table ({rows} rows); "
+                "rebuild the model with a longer seq_len")
         if s < 1:
             raise ValueError("prompt must contain at least one token")
         caches = ex.init_kv_cache(b, s + max_new_tokens)
@@ -1217,6 +1214,20 @@ class FFModel:
         tr, ntr = self._params
         src = tr if key in tr and weight_name in tr.get(key, {}) else ntr
         return np.asarray(src[key][weight_name])
+
+    def position_table_rows(self) -> Optional[int]:
+        """Smallest learned-position table in the graph (rows), or None.
+        Every decode entry point (generate, GenerationServer) must keep
+        prompt+new tokens within it — the in-jit row slice clamps rather
+        than faults."""
+        rows = None
+        for n in self.graph.nodes:
+            if getattr(n.attrs, "position_table", False):
+                ins = self.graph.input_shapes(n)
+                if len(ins) > 1:
+                    r = ins[1].dims[0].size
+                    rows = r if rows is None else min(rows, r)
+        return rows
 
     def set_weight(self, tensor_or_name: Union[Tensor, str], value: np.ndarray,
                    weight_name: str = "kernel"):

@@ -212,8 +212,9 @@ def _sharded_flash(q, k, v, mesh, causal, scale, interpret=False):
     b_ax = "data" if sizes.get("data", 1) > 1 and B % sizes["data"] == 0 else None
     h_ax = "model" if sizes.get("model", 1) > 1 and H % sizes["model"] == 0 else None
     if h_ax is not None and Hkv % sizes["model"] != 0:
-        k = jnp.repeat(k, H // Hkv, axis=2)
-        v = jnp.repeat(v, H // Hkv, axis=2)
+        from flexflow_tpu.parallel.ring import repeat_kv
+
+        k, v = repeat_kv(k, v, H // Hkv)
     spec = P(b_ax, None, h_ax, None)
 
     def fn(ql, kl, vl):

@@ -45,15 +45,41 @@ def _modes(src, my):
     return jnp.where(src == my, 1, jnp.where(src < my, 0, 2)).astype(jnp.int32)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def ring_flash(q, k, v, axis_name, n_shards, causal, scale, blk, interpret):
+def _rep_heads(x, rep):
+    """(B*Hkv, s, D) -> (B*H, s, D): repeat each kv head `rep` times in
+    the head-major BH layout (matches to_bh's b*H + h ordering)."""
+    if rep == 1:
+        return x
+    BHkv, s, D = x.shape
+    return jnp.repeat(x.reshape(BHkv, 1, s, D), rep, axis=1).reshape(
+        BHkv * rep, s, D)
+
+
+def _sum_heads(g, rep):
+    """(B*H, s, D) -> (B*Hkv, s, D): sum the `rep` q-head gradients that
+    share each kv head (the backward of _rep_heads)."""
+    if rep == 1:
+        return g
+    BH, s, D = g.shape
+    return g.reshape(BH // rep, rep, s, D).sum(axis=1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def ring_flash(q, k, v, axis_name, n_shards, causal, scale, blk, interpret,
+               rep=1):
     out, _ = _ring_fwd_impl(q, k, v, axis_name, n_shards, causal, scale,
-                            blk, interpret)
+                            blk, interpret, rep)
     return out
 
 
 def _ring_fwd_impl(q, k, v, axis_name, n_shards, causal, scale, blk,
-                   interpret):
+                   interpret, rep):
+    """q: (B*H, S, D); k, v: (B*Hkv, S, D) with H = Hkv*rep. GQA kv stays
+    UNREPEATED on the ring — every ppermute hop moves 1/rep of the bytes
+    the pre-repeated form did; the repeat is a LOCAL broadcast right
+    before each block's kernel call (the cost model prices ring hops at
+    unrepeated kv bytes — cost_model.py kv_bytes uses num_kv — so this
+    makes the implementation match its own pricing)."""
     BH, S, D = q.shape
     my = lax.axis_index(axis_name)
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
@@ -64,13 +90,13 @@ def _ring_fwd_impl(q, k, v, axis_name, n_shards, causal, scale, blk,
 
     def full_step(ops):
         qq, kk, vv, m_, l_, a_ = ops
-        return _fwd_carry(qq, kk, vv, m_, l_, a_, False, scale, blk, blk,
-                          interpret)
+        return _fwd_carry(qq, _rep_heads(kk, rep), _rep_heads(vv, rep),
+                          m_, l_, a_, False, scale, blk, blk, interpret)
 
     def causal_step(ops):
         qq, kk, vv, m_, l_, a_ = ops
-        return _fwd_carry(qq, kk, vv, m_, l_, a_, True, scale, blk, blk,
-                          interpret)
+        return _fwd_carry(qq, _rep_heads(kk, rep), _rep_heads(vv, rep),
+                          m_, l_, a_, True, scale, blk, blk, interpret)
 
     def masked_step(ops):
         _, _, _, m_, l_, a_ = ops
@@ -96,21 +122,27 @@ def _ring_fwd_impl(q, k, v, axis_name, n_shards, causal, scale, blk,
     return out, lse
 
 
-def _ring_fwd(q, k, v, axis_name, n_shards, causal, scale, blk, interpret):
+def _ring_fwd(q, k, v, axis_name, n_shards, causal, scale, blk, interpret,
+              rep):
     out, lse = _ring_fwd_impl(q, k, v, axis_name, n_shards, causal, scale,
-                              blk, interpret)
+                              blk, interpret, rep)
     return out, (q, k, v, out, lse)
 
 
-def _ring_bwd(axis_name, n_shards, causal, scale, blk, interpret, res, do):
+def _ring_bwd(axis_name, n_shards, causal, scale, blk, interpret, rep, res,
+              do):
     q, k, v, out, lse = res
     my = lax.axis_index(axis_name)
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 
     def grads(ops, blk_causal):
         qq, kk, vv = ops
-        return _bwd(qq, kk, vv, out, lse, do, blk_causal, scale, blk, blk,
-                    interpret)
+        dq_c, dk_r, dv_r = _bwd(qq, _rep_heads(kk, rep),
+                                _rep_heads(vv, rep), out, lse, do,
+                                blk_causal, scale, blk, blk, interpret)
+        # fold the rep q-heads' contributions back onto each kv head so
+        # the accumulators (and their ring hops) stay unrepeated
+        return dq_c, _sum_heads(dk_r, rep), _sum_heads(dv_r, rep)
 
     def full_step(ops):
         return grads(ops, False)
@@ -164,12 +196,16 @@ def ring_flash_available(s_loc: int, *, interpret: bool = False) -> bool:
 def ring_flash_attention(q, k, v, *, axis_name: str, n_shards: int,
                          causal: bool, scale: float,
                          interpret: bool = False):
-    """Per-shard entry (inside shard_map). q,k,v: (B, s_loc, H, D) local
-    blocks with equal head counts (GQA repeat happens upstream)."""
+    """Per-shard entry (inside shard_map). q: (B, s_loc, H, D); k, v:
+    (B, s_loc, Hkv, D) with H % Hkv == 0 — GQA kv rides the ring
+    UNREPEATED (1/rep of the hop bytes); the repeat happens locally per
+    block inside ring_flash."""
     B, s_loc, H, D = q.shape
+    Hkv = k.shape[2]
+    rep = H // Hkv
 
     def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, s_loc, D)
+        return x.transpose(0, 2, 1, 3).reshape(B * x.shape[2], s_loc, D)
 
     qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
     pad = (-D) % LANES
@@ -178,7 +214,7 @@ def ring_flash_attention(q, k, v, *, axis_name: str, n_shards: int,
                       for x in (qb, kb, vb))
     blk = _pick_block(s_loc, 512)
     out = ring_flash(qb, kb, vb, axis_name, n_shards, causal, scale, blk,
-                     interpret)
+                     interpret, rep)
     if pad:
         out = out[..., :D]
     return out.reshape(B, H, s_loc, D).transpose(0, 2, 1, 3)

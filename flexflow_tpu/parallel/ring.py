@@ -29,11 +29,23 @@ def _mesh_axis_size(mesh, name: str) -> int:
     return dict(zip(mesh.axis_names, mesh.devices.shape))[name]
 
 
+def repeat_kv(k, v, rep: int):
+    """Materialize the GQA head repeat (the shared fallback for paths
+    that cannot carry unrepeated kv — one definition so every site's
+    trigger condition is the only thing that can differ)."""
+    if rep == 1:
+        return k, v
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
+
 def ring_attention_core(q, k, v, *, axis_name: str, n_shards: int, causal: bool,
                         scale: float, vary_axes=()):
-    """Per-shard body (inside shard_map). q,k,v: (B, s_loc, H, D) local
-    blocks; device i initially holds sequence block i."""
+    """Per-shard body (inside shard_map). q: (B, s_loc, H, D); k, v:
+    (B, s_loc, Hkv, D) — GQA kv rides the ring UNREPEATED (every
+    ppermute hop moves 1/rep of the bytes), repeated locally per block;
+    device i initially holds sequence block i."""
     B, s_loc, H, D = q.shape
+    rep = H // k.shape[2]
     my = lax.axis_index(axis_name)
     NEG = jnp.float32(-1e30)
 
@@ -57,8 +69,10 @@ def ring_attention_core(q, k, v, *, axis_name: str, n_shards: int, causal: bool,
     def body(i, carry):
         k_blk, v_blk, m, l, acc = carry
         src = (my - i) % n_shards  # which sequence block we hold now
+        kb = jnp.repeat(k_blk, rep, axis=2) if rep > 1 else k_blk
+        vb = jnp.repeat(v_blk, rep, axis=2) if rep > 1 else v_blk
         logits = jnp.einsum(
-            "bshd,bthd->bhst", qf, k_blk.astype(jnp.float32)
+            "bshd,bthd->bhst", qf, kb.astype(jnp.float32)
         ) * scale
         if causal:
             q_pos = my * s_loc + lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
@@ -73,7 +87,7 @@ def ring_attention_core(q, k, v, *, axis_name: str, n_shards: int, causal: bool,
         corr = jnp.exp(m - new_m)
         p = jnp.exp(logits - new_m[..., None]) * pmask
         new_l = l * corr + p.sum(axis=-1)
-        pv = jnp.einsum("bhst,bthd->bshd", p, v_blk.astype(jnp.float32))
+        pv = jnp.einsum("bhst,bthd->bshd", p, vb.astype(jnp.float32))
         new_acc = acc * corr.transpose(0, 2, 1)[..., None] + pv
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
@@ -101,6 +115,11 @@ def ring_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
 
     ba = batch_axis if _mesh_axis_size(mesh, batch_axis) > 1 else None
     ha = head_axis if _mesh_axis_size(mesh, head_axis) > 1 else None
+    # kv arrives UNREPEATED (GQA): head-TP sharding needs the kv head dim
+    # divisible too, else repeat up front and lose the hop saving
+    h_deg = _mesh_axis_size(mesh, head_axis)
+    if ha is not None and k.shape[2] % h_deg != 0:
+        k, v = repeat_kv(k, v, q.shape[2] // k.shape[2])
     spec = P(ba, seq_axis, ha, None)
 
     # Pallas flash kernel as the per-block ring body (the S_loc×S_loc
@@ -154,6 +173,7 @@ def ulysses_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
         return jax_ops.fused_attention(q, k, v, causal=causal, scale=scale,
                                        mesh=mesh)
     H = q.shape[2]
+    Hkv = k.shape[2]
     h_deg = _mesh_axis_size(mesh, head_axis)
     # the all_to_all splits each shard's LOCAL heads (H / head_degree) n
     # ways — check divisibility at that granularity, not globally
@@ -163,6 +183,13 @@ def ulysses_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
             q, k, v, mesh=mesh, causal=causal, scale=scale,
             seq_axis=seq_axis, batch_axis=batch_axis, head_axis=head_axis,
         )
+    # GQA kv can ride the exchange unrepeated only if ITS head count
+    # divides the head-TP degree AND its local heads split n ways;
+    # otherwise repeat up front
+    kv_tp_ok = Hkv % h_deg == 0 if h_deg > 1 and H % h_deg == 0 else True
+    local_kv = Hkv // h_deg if Hkv % h_deg == 0 else Hkv
+    if Hkv != H and (local_kv % n != 0 or not kv_tp_ok):
+        k, v = repeat_kv(k, v, H // Hkv)
     jax_ops.LAST_ATTENTION_KERNEL = "ulysses_all_to_all"
 
     ba = batch_axis if _mesh_axis_size(mesh, batch_axis) > 1 else None
@@ -217,10 +244,10 @@ def ring_attention_lowering(attrs, inputs, params, ctx):
 
         q = apply_rope(q, attrs.rope_theta)
         k = apply_rope(k, attrs.rope_theta)
-    if attrs.num_kv != attrs.num_heads:
-        rep = attrs.num_heads // attrs.num_kv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA kv stays UNREPEATED into the seq-parallel cores: the ring
+    # ppermutes (fwd k/v, bwd k/v + dk/dv accumulators) and the Ulysses
+    # exchanges then move 1/rep of the bytes; each path repeats locally
+    # where its math needs full heads
     seq_attn = (
         ulysses_dot_product_attention
         if getattr(attrs, "seq_mode", "ring") == "ulysses"

@@ -239,10 +239,12 @@ class CostModel:
             # way through its comm tasks). ADDITIVE with the seq-parallel
             # term below: a head+seq combined view pays both collectives.
             attn_events = []
+            h_deg = 1  # head-TP degree, needed by the ulysses kv pricing
             wo = view.weight_specs.get("wo")
             if wo and len(wo) >= 1 and wo[0]:
                 deg_wo = axes_degree(wo[0])
                 if deg_wo > 1:
+                    h_deg = deg_wo
                     attn_events.append((tuple(wo[0]),
                                         self.machine.all_reduce_time(
                         node.outputs[0].global_bytes(), deg_wo,
@@ -273,12 +275,23 @@ class CostModel:
                         # bwd: reduce-scatter of dq/dk/dv, same bytes
                         attn_events.append((seq_axes, (bwd - 1.0) * gather))
                 elif getattr(a, "seq_mode", "ring") == "ulysses":
-                    # leg 1 moves q + full-head KV (the lowering repeats
-                    # GQA KV to num_heads before the exchange); leg 2
-                    # moves only the attention output (q-sized)
-                    kv_full = 2 * b * s * a.num_heads * hd * dt
+                    # leg 1 moves q + KV: UNREPEATED GQA kv when the
+                    # lowering can keep it so — the condition here MUST
+                    # mirror ulysses_dot_product_attention's (per-shard
+                    # kv heads under head-TP must split the seq degree),
+                    # or the search underprices the exchange. leg 2
+                    # moves only the attention output (q-sized).
+                    kv_tp_ok = (a.num_kv % h_deg == 0
+                                if h_deg > 1 and a.num_heads % h_deg == 0
+                                else True)
+                    local_kv = (a.num_kv // h_deg
+                                if a.num_kv % h_deg == 0 else a.num_kv)
+                    kv_heads_ex = (a.num_kv
+                                   if local_kv % deg == 0 and kv_tp_ok
+                                   else a.num_heads)
+                    kv_ex = 2 * b * s * kv_heads_ex * hd * dt
                     leg1 = self.machine.all_to_all_time(
-                        q_bytes + kv_full, deg, axes=seq_axes
+                        q_bytes + kv_ex, deg, axes=seq_axes
                     )
                     leg2 = self.machine.all_to_all_time(
                         q_bytes, deg, axes=seq_axes

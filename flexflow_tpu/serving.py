@@ -381,6 +381,15 @@ class GenerationServer:
         self.ff = ff
         self.slots = int(slots)
         self.max_len = int(max_len)
+        # learned-position models (GPT-2/BERT-style): serving past the
+        # position table would silently clamp to the last row in-jit —
+        # refuse at construction, same contract as FFModel.generate
+        rows = ff.position_table_rows()
+        if rows is not None and self.max_len > rows:
+            raise ValueError(
+                f"max_len ({self.max_len}) exceeds the model's learned "
+                f"position table ({rows} rows); rebuild with a longer "
+                "seq_len or lower max_len")
         self.eos_id = eos_id
         ex = ff.executor
         self._step = ex.decode_fn()

@@ -160,7 +160,7 @@ def test_flash_shard_map_grads_match(monkeypatch):
                                    rtol=1e-4, atol=1e-4)
 
 
-def _ring_setup(monkeypatch, B=2, S=512, H=2, D=8, n=4):
+def _ring_setup(monkeypatch, B=2, S=512, H=2, D=8, n=4, Hkv=None):
     monkeypatch.setenv("FF_TPU_FLASH_INTERPRET", "1")
     import jax
     import jax.numpy as jnp
@@ -170,8 +170,8 @@ def _ring_setup(monkeypatch, B=2, S=512, H=2, D=8, n=4):
     mesh = Mesh(devs, ("data", "seq"))
     rs = np.random.RandomState(0)
     q = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
-    k = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
-    v = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, S, Hkv or H, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, S, Hkv or H, D), jnp.float32)
     return mesh, q, k, v
 
 
@@ -220,3 +220,45 @@ def test_ring_pallas_flash_grads_match(monkeypatch):
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_ring_gqa_unrepeated_kv_matches_full():
+    """GQA kv rides the ring UNREPEATED (Hkv < H blocks on every
+    ppermute hop): forward AND dk/dv — which must fold the rep q-head
+    contributions back per kv head — equal the full-attention reference.
+    No monkeypatch fixture here so the test composes both kernel paths."""
+    import jax
+    import pytest as _pytest
+
+    mp = _pytest.MonkeyPatch()
+    try:
+        from flexflow_tpu.ops import jax_ops
+        from flexflow_tpu.parallel.ring import ring_dot_product_attention
+
+        mesh, q, k, v = _ring_setup(mp, S=512, H=4, Hkv=2)
+
+        def loss_ring(q, k, v):
+            with mesh:
+                o = ring_dot_product_attention(q, k, v, mesh=mesh,
+                                               causal=True, scale=0.3)
+            return (o * o).sum()
+
+        def loss_ref(q, k, v):
+            o = jax_ops._dot_product_attention(q, k, v, True, 0.3)
+            return (o * o).sum()
+
+        with mesh:
+            out = jax.jit(lambda q, k, v: ring_dot_product_attention(
+                q, k, v, mesh=mesh, causal=True, scale=0.3))(q, k, v)
+        assert jax_ops.LAST_ATTENTION_KERNEL == "ring_pallas_flash"
+        ref = jax_ops._dot_product_attention(q, k, v, True, 0.3)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        g1 = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        assert g1[1].shape == k.shape and g1[2].shape == v.shape
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+    finally:
+        mp.undo()
