@@ -381,17 +381,31 @@ def _persist_green(res: dict) -> None:
         _log(f"could not persist green result: {e}")
 
 
+_GREEN_MAX_AGE_S = float(os.environ.get("FLEXFLOW_BENCH_GREEN_MAX_AGE",
+                                        str(7 * 24 * 3600)))
+
+
 def _emit_last_green_or(diagnostic: dict, exit_code: int,
-                        want: str | None = None) -> None:
+                        want: "str | tuple | None" = None) -> None:
     """Backend unreachable: prefer the persisted green artifact (labeled as
     cached) over a 0.0 diagnostic; exit 0 on cache hit so drivers record
-    the parsed line. `want` (a config name like "1b") refuses a cached
+    the parsed line. `want` (a config name like "1b", or a tuple of
+    acceptable configs for the combined-gate fallbacks) refuses a cached
     result measured at a DIFFERENT config — a 1b request must never be
-    answered with a 200m number."""
+    answered with a 200m number. Artifacts older than _GREEN_MAX_AGE_S
+    (default 7 days) are refused too: a week-old number presented as
+    current would mask a real regression for an entire round."""
     try:
         with open(_GREEN_PATH) as f:
             res = json.load(f)
-        if want is not None and f"_{want}_" not in res.get("metric", ""):
+        if want is not None:
+            wanted = (want,) if isinstance(want, str) else tuple(want)
+            if not any(f"_{w}_" in res.get("metric", "") for w in wanted):
+                res = {}
+        age = time.time() - res.get("_captured_unix", 0)
+        if res and age > _GREEN_MAX_AGE_S:
+            _log(f"cached green result is {age / 86400:.1f} days old "
+                 "(> max age); refusing it")
             res = {}
         if res.get("value", 0) > 0:
             res["cached"] = True
@@ -545,7 +559,7 @@ def main():
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
             "error": "backend init hang: jax.devices() never returned "
                      "within any probe deadline (tunnel down?)",
-        }, exit_code=3)
+        }, exit_code=3, want=("1b", "200m"))
         return
 
     if os.environ.get("FLEXFLOW_BENCH_SMOKE"):
@@ -590,7 +604,7 @@ def main():
                 "metric": "llama_train_tokens_per_sec",
                 "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
                 "error": "200m failed and no budget for 1b",
-            }, exit_code=4)
+            }, exit_code=4, want=("1b", "200m"))
         return
     res1b = _run_config("1b", side_timeout=600)
     if res1b is None:
@@ -600,7 +614,7 @@ def main():
                 "metric": "llama_train_tokens_per_sec",
                 "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
                 "error": "both 200m and 1b failed",
-            }, exit_code=4)
+            }, exit_code=4, want=("1b", "200m"))
         return
     if res200 is not None:
         res1b["config_200m"] = {k: res200[k] for k in

@@ -27,7 +27,9 @@ def main(argv=None):
         jax.config.update("jax_platforms", platform)
         if "--cpu-devices" in argv:
             i = argv.index("--cpu-devices")
-            jax.config.update("jax_num_cpu_devices", int(argv[i + 1]))
+            from flexflow_tpu.parallel.compat import ensure_cpu_devices
+
+            ensure_cpu_devices(int(argv[i + 1]))
             del argv[i:i + 2]
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)

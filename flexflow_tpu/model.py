@@ -1181,13 +1181,20 @@ class FFModel:
                       warmup=warmup)
 
     def serve_generation(self, slots: int = 4, max_len: int = 512,
-                         eos_id=None, seed: int = 0):
+                         eos_id=None, seed: int = 0, paged: bool = False,
+                         page_size: int = 64, num_pages=None,
+                         preemption: bool = True):
         """Continuous-batching autoregressive generation endpoint (KV-cache
-        decode with per-slot positions — flexflow_tpu.serving)."""
+        decode with per-slot positions — flexflow_tpu.serving). With
+        `paged=True` the KV cache is a block-paged pool shared by all
+        requests (flexflow_tpu.paged): HBM scales with tokens in flight,
+        admission is by free-page budget, and page pressure preempts and
+        requeues the youngest request."""
         from flexflow_tpu.serving import serve_generation as _sg
 
         return _sg(self, slots=slots, max_len=max_len, eos_id=eos_id,
-                   seed=seed)
+                   seed=seed, paged=paged, page_size=page_size,
+                   num_pages=num_pages, preemption=preemption)
 
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],
                 batch_size: Optional[int] = None) -> np.ndarray:

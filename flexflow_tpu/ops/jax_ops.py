@@ -320,11 +320,25 @@ def _mha(attrs, inputs, params, ctx):
         k = k + params["bk"].astype(dt)
         v = v + params["bv"].astype(dt)
     if ctx.kv_cache is not None:
-        out, kc, vc = cached_attention(
-            q, k, v, ctx.kv_cache["k"], ctx.kv_cache["v"],
-            ctx.cache_position, scale=1.0 / (hd**0.5),
-            rope_theta=attrs.rope_theta if attrs.rope else None,
-        )
+        if ctx.page_tables is not None:
+            # paged decode: the cache is a global page pool and this
+            # slot's rows are reached through its page table
+            # (flexflow_tpu.paged.attention — Pallas kernel or gather
+            # fallback, selected like flash_attention is)
+            from flexflow_tpu.paged.attention import paged_cached_attention
+
+            out, kc, vc = paged_cached_attention(
+                q, k, v, ctx.kv_cache["k"], ctx.kv_cache["v"],
+                ctx.page_tables, ctx.cache_position,
+                scale=1.0 / (hd**0.5),
+                rope_theta=attrs.rope_theta if attrs.rope else None,
+            )
+        else:
+            out, kc, vc = cached_attention(
+                q, k, v, ctx.kv_cache["k"], ctx.kv_cache["v"],
+                ctx.cache_position, scale=1.0 / (hd**0.5),
+                rope_theta=attrs.rope_theta if attrs.rope else None,
+            )
         ctx.cache_updates["k"] = kc
         ctx.cache_updates["v"] = vc
     else:

@@ -34,6 +34,10 @@ class LowerCtx:
     # buffers into cache_updates
     kv_cache: Optional[dict] = None
     cache_position: Optional[object] = None
+    # paged decode (flexflow_tpu.paged): kv_cache buffers are a global
+    # page POOL (num_pages, page_size, Hkv, D) and page_tables maps each
+    # decode slot's positions onto pool pages ((slots, max_pages) int32)
+    page_tables: Optional[object] = None
     cache_updates: Dict[str, object] = dataclasses.field(default_factory=dict)
     # lowering writes non-trainable state updates here (BatchNorm running
     # stats, Cache buffers): key = weight name within the op

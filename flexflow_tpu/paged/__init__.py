@@ -1,0 +1,31 @@
+"""Paged KV-cache + continuous-batching generation subsystem.
+
+vLLM-style block paging, TPU-idiomatically: a global pool of fixed-size
+KV pages with per-request page tables (host-side numpy bookkeeping,
+int32 device mirrors), a paged-attention decode path (Pallas TPU kernel
+with a pure-JAX gather fallback), and a continuous-batching scheduler
+that admits by free-page budget instead of fixed dense slots.
+
+Layering:
+  pool.py       host-side page allocator/free-list/defrag (plain numpy)
+  attention.py  paged decode attention (Pallas kernel + jnp.take fallback)
+  scheduler.py  PagedGenerationServer (admission, preemption, metrics)
+
+See docs/paged.md for the page-table layout and scheduler policy.
+"""
+
+from flexflow_tpu.paged.attention import (
+    paged_attention_available,
+    paged_cached_attention,
+    paged_gather_attention,
+)
+from flexflow_tpu.paged.pool import PagePool
+from flexflow_tpu.paged.scheduler import PagedGenerationServer
+
+__all__ = [
+    "PagePool",
+    "PagedGenerationServer",
+    "paged_attention_available",
+    "paged_cached_attention",
+    "paged_gather_attention",
+]

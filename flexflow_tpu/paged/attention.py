@@ -1,0 +1,217 @@
+"""Paged decode attention: gather K/V through a page table.
+
+Two selectable paths, chosen exactly the way ops/pallas/flash_attention
+picks its kernel (backend probe + env kill switch + shape gate):
+
+  * a Pallas TPU kernel whose grid walks (batch, kv head, page) with the
+    page table and per-slot positions SCALAR-PREFETCHED, so each page's
+    K/V block DMAs straight from its pooled HBM location into VMEM — no
+    gathered copy of the sequence ever materializes. GQA is handled by
+    grouping the q heads of one kv head into a single (rep, D) block, so
+    kv pages are read once per GROUP (not per q head) and never repeated.
+  * a pure-JAX `jnp.take` fallback (`pool[page_table]` gather + masked
+    dot-product attention) that runs anywhere and is the reference the
+    kernel is validated against.
+
+The decode step is S=1 by construction (prefill runs through the dense
+cached path and its rows are scattered into pages afterwards —
+scheduler.py), so q is (B, 1, H, D) here.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def paged_attention_available(head_dim: int, page_size: int,
+                              interpret: bool = False,
+                              dtype=jnp.float32) -> bool:
+    """True when the Pallas paged kernel supports these shapes on this
+    backend. FF_TPU_NO_PAGED=1 disables the kernel everywhere (A/B runs
+    and kernel-bug escape hatch, like FF_TPU_NO_FLASH). On real TPUs the
+    head dim must be a lane multiple (the kernel reads lane-aligned D
+    blocks; smaller head dims take the gather fallback, mirroring the
+    flash bshd gate) and pages must tile the sublane dim AT THE POOL'S
+    DTYPE — (8, 128) tiles for fp32 but (16, 128) for bf16/fp16 and
+    (32, 128) for int8/fp8, so a bf16 pool needs page_size % 16 == 0."""
+    if os.environ.get("FF_TPU_NO_PAGED") == "1":
+        return False
+    if interpret:
+        return True
+    itemsize = jnp.dtype(dtype).itemsize
+    if itemsize > 4:
+        return False  # 8-byte dtypes have no TPU tiling story
+    sublane = 8 * (4 // max(itemsize, 1))
+    if head_dim % LANES != 0 or page_size % sublane != 0:
+        return False
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# pure-JAX fallback (and numerical reference)
+
+
+def paged_gather_attention(q, kc_pages, vc_pages, page_tables, pos, *,
+                           scale: float):
+    """q: (B, S, H, D); kc/vc_pages: (N, P, Hkv, D); page_tables:
+    (B, max_pages) int32; pos: (B,) int32 — the absolute position of each
+    row's FIRST query token. Gathers every table-mapped page and attends
+    with the same absolute-position mask as the dense cached path (rows
+    past a slot's write head — including everything in the null page —
+    stay masked)."""
+    B, S, _, D = q.shape
+    Hkv = kc_pages.shape[2]
+    dt = q.dtype
+    kg = kc_pages[page_tables].reshape(B, -1, Hkv, D)
+    vg = vc_pages[page_tables].reshape(B, -1, Hkv, D)
+    qpos = pos[:, None] + jnp.arange(S)[None, :]            # (B, S)
+    kpos = jnp.arange(kg.shape[1])                          # (T,)
+    mask = kpos[None, None, :] <= qpos[:, :, None]          # (B, S, T)
+    from flexflow_tpu.ops.jax_ops import _dot_product_attention
+
+    return _dot_product_attention(q, kg.astype(dt), vg.astype(dt),
+                                  causal=False, scale=scale, mask=mask)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: grid (B, Hkv, n_pages); page table + positions prefetched
+
+
+def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, scale, page_size,
+                         n_pages):
+    b, j = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # pages wholly past the slot's write head contribute nothing — skip
+    # their MXU work entirely (the masked-out math would be exp(-inf)=0)
+    @pl.when(j * page_size <= pos_ref[b])
+    def _():
+        q = q_ref[...]                       # (rep, D)
+        k = k_ref[...]                       # (P, D)
+        v = v_ref[...]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        kpos = j * page_size + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos <= pos_ref[b], s, NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        l_prev = l_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = lax.dot_general(p.astype(v.dtype), v,
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _():
+        l_safe = jnp.maximum(l_scr[:, 0:1], 1e-30)
+        o_ref[...] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_flash_decode(q, kc_pages, vc_pages, page_tables, pos, *,
+                       scale: float, interpret: bool = False):
+    """Pallas paged-attention decode step. q: (B, 1, H, D); kc/vc_pages:
+    (N, P, Hkv, D); page_tables: (B, max_pages); pos: (B,). The page
+    table rides scalar prefetch, so each grid step's BlockSpec index map
+    resolves `pt[b, j]` BEFORE the DMA — K/V stream page-by-page from
+    their pooled locations."""
+    B, S, H, D = q.shape
+    if S != 1:
+        raise ValueError(f"paged decode is single-token (S=1), got S={S}")
+    N, P, Hkv, _ = kc_pages.shape
+    rep = H // Hkv
+    n_pages = page_tables.shape[1]
+    qr = q[:, 0].reshape(B, Hkv, rep, D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, n_pages),
+        in_specs=[
+            pl.BlockSpec((None, None, rep, D),
+                         lambda b, g, j, pt, ps: (b, g, 0, 0)),
+            pl.BlockSpec((None, P, None, D),
+                         lambda b, g, j, pt, ps: (pt[b, j], 0, g, 0)),
+            pl.BlockSpec((None, P, None, D),
+                         lambda b, g, j, pt, ps: (pt[b, j], 0, g, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, rep, D),
+                               lambda b, g, j, pt, ps: (b, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, LANES), jnp.float32),
+            pltpu.VMEM((rep, LANES), jnp.float32),
+            pltpu.VMEM((rep, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale, page_size=P,
+                          n_pages=n_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep, D), q.dtype),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), pos.astype(jnp.int32), qr,
+      kc_pages, vc_pages)
+    return out.reshape(B, 1, H, D)
+
+
+# ---------------------------------------------------------------------------
+# the lowering entry: rope + page write + attend (mirrors cached_attention)
+
+
+def paged_cached_attention(q, k, v, cache_k, cache_v, page_tables, pos, *,
+                           scale: float, rope_theta: Optional[float] = None):
+    """One paged decode step, the drop-in analog of
+    ops.jax_ops.cached_attention: rope at each slot's absolute position,
+    scatter the new K/V row into its slot's current page, attend over the
+    table-mapped pages. Idle slots (page table all-null, pos 0) write
+    into the null page and read garbage that their mask discards.
+
+    Returns (attention output, new k pool, new v pool)."""
+    from flexflow_tpu.ops.jax_ops import apply_rope
+
+    if q.shape[1] != 1:
+        raise ValueError(
+            f"paged decode is single-token (S=1), got S={q.shape[1]}; "
+            "prefill runs through the dense cached path and its rows are "
+            "scattered into pages (paged/scheduler.py)")
+    P = cache_k.shape[1]
+    pos_v = jnp.asarray(pos)
+    if rope_theta is not None:
+        q = apply_rope(q, rope_theta, pos_offset=pos_v)
+        k = apply_rope(k, rope_theta, pos_offset=pos_v)
+    B = q.shape[0]
+    rows = jnp.arange(B)
+    page = page_tables[rows, pos_v // P]                  # (B,)
+    off = pos_v % P
+    kc = cache_k.at[page, off].set(k[:, 0].astype(cache_k.dtype))
+    vc = cache_v.at[page, off].set(v[:, 0].astype(cache_v.dtype))
+
+    force_interp = os.environ.get("FF_TPU_FLASH_INTERPRET") == "1"
+    if paged_attention_available(q.shape[-1], P, interpret=force_interp,
+                                 dtype=kc.dtype):
+        out = paged_flash_decode(q, kc, vc, page_tables, pos_v,
+                                 scale=scale, interpret=force_interp)
+    else:
+        out = paged_gather_attention(q, kc, vc, page_tables, pos_v,
+                                     scale=scale)
+    return out, kc, vc

@@ -1,0 +1,106 @@
+"""Host-side page-pool bookkeeping for the paged KV cache.
+
+All allocation state is plain numpy/python on the host; the device only
+ever sees int32 page tables (one row per decode slot), so the jitted
+decode step stays a single compiled program regardless of which requests
+hold which pages. Page 0 is reserved as the NULL page: unallocated page
+table entries point at it, and idle decode slots write their garbage
+K/V row into it (those rows sit past every live request's position and
+are masked by the absolute-position attention mask).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class PagePool:
+    """Fixed-size page allocator over `num_pages` KV pages of `page_size`
+    tokens each. Page 0 is never handed out (the null page), so usable
+    capacity is `num_pages - 1` pages."""
+
+    def __init__(self, num_pages: int, page_size: int,
+                 max_pages_per_seq: int):
+        if num_pages < 2:
+            raise ValueError(f"need >= 2 pages (one is the null page), "
+                             f"got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        # LIFO free list: freshly freed pages are reused first (their HBM
+        # is warm) — order is a host-side detail, invisible to the device
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._owner: Dict[int, int] = {}  # page id -> owner token
+
+    # -- accounting -----------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold `n_tokens` cache rows."""
+        return -(-int(n_tokens) // self.page_size)
+
+    # -- alloc / free ---------------------------------------------------
+
+    def alloc(self, n: int, owner: int = -1) -> Optional[List[int]]:
+        """Allocate `n` pages for `owner`, or None when the pool cannot
+        satisfy the request (callers queue or preempt — never partial)."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._owner[p] = owner
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p in self._owner:
+                del self._owner[p]
+                self._free.append(p)
+
+    # -- defrag ---------------------------------------------------------
+
+    def defrag(self) -> tuple:
+        """Compact allocated pages to the low end of the pool. Returns
+        (perm, old_to_new):
+
+          perm[new_id] = old_id  — gather indices for moving the DEVICE
+          pool buffers (`new_pool = old_pool[perm]`);
+          old_to_new[old_id]     — rewrite for every live page table
+          (`table = old_to_new[table]`; null stays null).
+
+        Pure bookkeeping here; the caller owns applying both sides
+        atomically (the scheduler does this between decode ticks, when no
+        jitted program is in flight)."""
+        allocated = sorted(self._owner)
+        perm = np.arange(self.num_pages, dtype=np.int32)
+        old_to_new = np.arange(self.num_pages, dtype=np.int32)
+        new_owner: Dict[int, int] = {}
+        for new_id, old_id in enumerate(allocated, start=1):
+            perm[new_id] = old_id
+            old_to_new[old_id] = new_id
+            new_owner[new_id] = self._owner[old_id]
+        # remaining slots of perm point at the (now free) old pages, keeping
+        # perm a true permutation; their content is garbage either way
+        free_old = [p for p in range(1, self.num_pages)
+                    if p not in self._owner]
+        for i, old_id in zip(range(len(allocated) + 1, self.num_pages),
+                             free_old):
+            perm[i] = old_id
+        self._owner = new_owner
+        self._free = list(range(self.num_pages - 1, len(allocated), -1))
+        return perm, old_to_new

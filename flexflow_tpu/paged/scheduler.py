@@ -1,0 +1,294 @@
+"""Continuous-batching scheduler over the paged KV cache.
+
+Replaces the dense GenerationServer's slot-only admission with admission
+by FREE-PAGE BUDGET: a request is admitted when a decode slot is free
+AND the pool can hold its prompt's pages; it grows one page at a time as
+it decodes; page pressure preempts the youngest other request (its pages
+are freed and it requeues at the FRONT of the queue with prompt +
+generated prefix, so re-prefill resumes exactly where it stopped).
+EOS/max-new free pages and slot immediately. All bookkeeping is host
+numpy; the jitted decode step sees only int32 page tables and positions,
+so it compiles ONCE for the (slots, max_pages) shape.
+
+Decode flow per tick:
+  1. admit queued requests into free slots while pages last (FIFO;
+     preempted requests re-enter ahead of the queue)
+  2. grow: slots whose next write position crosses a page boundary
+     allocate a page, preempting under pressure
+  3. one jitted paged decode step for the whole slot pool (idle slots
+     write their garbage row into the null page)
+  4. sample, append, finish/free
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from flexflow_tpu.paged.pool import PagePool
+from flexflow_tpu.serving import _GenerationServerBase, _GenRequest
+
+
+class PagedGenerationServer(_GenerationServerBase):
+    """Continuous batching over the block-paged KV cache
+    (serve_generation(..., paged=True)). Same public surface and sampling
+    as the dense GenerationServer; HBM scales with the page pool instead
+    of slots x max_len, so short sequences leave room to admit more
+    concurrent work than the dense layout could hold."""
+
+    def __init__(self, ff, slots: int = 4, max_len: int = 512,
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 page_size: int = 64, num_pages: Optional[int] = None,
+                 preemption: bool = True):
+        import jax
+
+        super().__init__(ff, slots, max_len, eos_id, seed)
+        self.page_size = int(page_size)
+        self.max_pages_per_seq = -(-self.max_len // self.page_size)
+        # prefill runs through the DENSE one-slot cache, page-aligned so
+        # its rows reshape straight into (max_pages, page_size) pages
+        self._prefill_len = self.max_pages_per_seq * self.page_size
+        if num_pages is None:
+            # default pool matches the dense layout's capacity (+ null
+            # page); size it DOWN to oversubscribe slots against HBM
+            num_pages = self.slots * self.max_pages_per_seq + 1
+        self.pool = PagePool(num_pages, self.page_size,
+                             self.max_pages_per_seq)
+        self.preemption = bool(preemption)
+        ex = ff.executor
+        self._step = ex.paged_decode_fn()
+        self._prefill_step = ex.decode_fn()
+        self._caches = ex.init_paged_kv_cache(num_pages, self.page_size)
+        self._prefill_caches = ex.init_kv_cache(1, self._prefill_len)
+        self._tables = np.zeros((self.slots, self.max_pages_per_seq),
+                                np.int32)
+        self._admit_order: List[int] = []  # live slots, oldest first
+        self._requeue: List[_GenRequest] = []  # preempted, ahead of queue
+        self._defrag_req = threading.Event()
+        self.preemptions = 0
+        self.defrags = 0
+        self.peak_active = 0
+        self._request_metrics: List[dict] = []
+
+        mpps, P = self.max_pages_per_seq, self.page_size
+
+        @jax.jit
+        def scatter_pages(pool_buf, rows, page_ids):
+            # rows: (1, prefill_len, Hkv, D) dense prefill cache; the
+            # first len(page_ids) page-sized row blocks land on the
+            # request's pages (page_ids length is static per prompt-page
+            # count, so this compiles once per count, like the dense
+            # server's bucketed prefill)
+            full = rows[0].reshape(mpps, P, *rows.shape[2:])
+            return pool_buf.at[page_ids].set(full[: page_ids.shape[0]])
+
+        self._scatter_pages = scatter_pages
+        self._start()
+
+    # -- capacity ---------------------------------------------------------
+
+    def _check_capacity(self, prompt: np.ndarray, max_new_tokens: int):
+        super()._check_capacity(prompt, max_new_tokens)
+        need = self.pool.pages_for(len(prompt) + max_new_tokens)
+        if need > self.pool.capacity:
+            raise ValueError(
+                f"request needs {need} pages at its longest "
+                f"({len(prompt)}+{max_new_tokens} tokens, page_size="
+                f"{self.page_size}) but the pool only holds "
+                f"{self.pool.capacity}; raise num_pages")
+
+    def metrics(self) -> dict:
+        """Aggregate serving metrics + the per-request records of every
+        COMPLETED request (queue time, prefill/decode tokens, pages)."""
+        return {
+            "requests_served": self._served,
+            "decode_steps": self._steps,
+            "preemptions": self.preemptions,
+            "defrags": self.defrags,
+            "peak_active": self.peak_active,
+            "pages_in_use": self.pool.pages_in_use,
+            "free_pages": self.pool.free_pages,
+            "requests": list(self._request_metrics),
+        }
+
+    def request_defrag(self):
+        """Ask the loop to compact the page pool between ticks (host
+        bookkeeping + one device gather per cache buffer)."""
+        self._defrag_req.set()
+
+    # -- slot lifecycle ---------------------------------------------------
+
+    def _release_slot(self, slot: int, req: _GenRequest,
+                      completed: bool = False):
+        self.pool.free(req.pages)
+        req.pages = []
+        self._tables[slot] = 0
+        if slot in self._admit_order:
+            self._admit_order.remove(slot)
+        if completed:  # cancellations (stop/_drain) are not records
+            self._request_metrics.append(req.metrics())
+        super()._release_slot(slot, req, completed)
+
+    def _evict(self, slot: int):
+        """Preempt: free the victim's pages and requeue it (front); its
+        future stays pending and its re-prefill recomputes the freed K/V
+        from prompt + generated prefix (req.seq_tokens() — the prompt
+        itself is never mutated, so repeated preemptions of the same
+        request cannot double-fold the prefix)."""
+        req = self._active[slot]
+        self.pool.free(req.pages)
+        req.pages = []
+        self._tables[slot] = 0
+        self._active[slot] = None
+        if slot in self._admit_order:
+            self._admit_order.remove(slot)
+        req.preemptions += 1
+        self.preemptions += 1
+        self._requeue.insert(0, req)
+
+    def _admit(self, req: _GenRequest, slot: int):
+        """Allocate the prompt's pages, then the shared bucketed prefill
+        (_admit_common) with a page-scatter instead of a slot-scatter."""
+        import jax
+        import jax.numpy as jnp
+
+        n = len(req.seq_tokens())
+        pages = self.pool.alloc(self.pool.pages_for(n), owner=slot)
+        ids = jnp.asarray(np.asarray(pages, np.int32))
+
+        def scatter(upd):
+            for key, rows in upd.items():
+                self._caches[key] = jax.tree.map(
+                    lambda buf, r: self._scatter_pages(buf, r, ids),
+                    self._caches[key], rows)
+
+        req.pages = pages
+        req.peak_pages = max(req.peak_pages, len(pages))
+        self._admit_common(req, slot,
+                           min(self._bucket(n), self._prefill_len),
+                           scatter)
+        self._tables[slot] = 0
+        self._tables[slot, :len(pages)] = pages
+        self._admit_order.append(slot)
+        self._finish_if_done(slot)
+
+    def _pop_next(self) -> Optional[_GenRequest]:
+        if self._requeue:
+            return self._requeue.pop(0)
+        try:
+            return self._queue.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _push_back(self, req: _GenRequest):
+        self._requeue.insert(0, req)
+
+    # -- page growth / preemption ----------------------------------------
+
+    def _ensure_pages(self):
+        """Before a tick, every live slot whose NEXT write position
+        crosses into an unallocated page gets one; pool pressure preempts
+        the youngest OTHER live request (`preemption=False` requeues the
+        starved request itself — a stall, never a wrong answer)."""
+        for slot in list(self._admit_order):
+            req = self._active[slot]
+            if req is None:
+                continue
+            if req.pos // self.page_size < len(req.pages):
+                continue
+            while True:
+                got = self.pool.alloc(1, owner=slot)
+                if got is not None:
+                    req.pages.append(got[0])
+                    req.peak_pages = max(req.peak_pages, len(req.pages))
+                    self._tables[slot, len(req.pages) - 1] = got[0]
+                    break
+                victims = [s for s in self._admit_order if s != slot]
+                if self.preemption and victims:
+                    self._evict(victims[-1])  # youngest other request
+                else:
+                    self._evict(slot)  # stall self until pages free up
+                    break
+
+    def _apply_defrag(self):
+        import jax
+
+        perm, old_to_new = self.pool.defrag()
+        self._caches = {
+            key: jax.tree.map(lambda b: b[perm], bufs)
+            for key, bufs in self._caches.items()
+        }
+        self._tables = old_to_new[self._tables]
+        for s in self._admit_order:
+            req = self._active[s]
+            if req is not None:
+                req.pages = [int(old_to_new[p]) for p in req.pages]
+        self.defrags += 1
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _loop_body(self, tr, ntr):
+        import jax
+        import jax.numpy as jnp
+
+        while not self._stop.is_set():
+            if self._defrag_req.is_set():
+                self._defrag_req.clear()
+                self._apply_defrag()
+            # admission: free slot + prompt's pages available, FIFO (a
+            # too-big head request blocks later ones — no starvation)
+            admitted = False
+            for slot in range(self.slots):
+                if self._active[slot] is not None:
+                    continue
+                req = self._pop_next()
+                if req is None:
+                    break
+                if (self.pool.pages_for(len(req.seq_tokens()))
+                        > self.pool.free_pages):
+                    self._push_back(req)
+                    break
+                self._admit(req, slot)
+                admitted = True
+            live = [s for s in range(self.slots)
+                    if self._active[s] is not None]
+            self.peak_active = max(self.peak_active, len(live))
+            if not live:
+                if not admitted:
+                    time.sleep(0.001)
+                continue
+            self._ensure_pages()
+            live = [s for s in range(self.slots)
+                    if self._active[s] is not None]
+            if not live:
+                continue
+            pos = np.array([self._active[s].pos if self._active[s] else 0
+                            for s in range(self.slots)], np.int32)
+            probs, upd = self._step(
+                tr, ntr, self._caches, jnp.asarray(self._tables),
+                jnp.asarray(pos), jnp.asarray(self._tokens)[:, None])
+            self._caches = upd
+            temps = np.array(
+                [self._active[s].temperature if self._active[s] else 0.0
+                 for s in range(self.slots)], np.float32)
+            self._rng, sub = jax.random.split(self._rng)
+            toks = np.asarray(self._pick(probs[:, -1, :],
+                                         jnp.asarray(temps), sub))
+            self._steps += 1
+            for s in live:
+                req = self._active[s]
+                req.pos += 1
+                req.tokens.append(int(toks[s]))
+                self._tokens[s] = toks[s]
+                self._finish_if_done(s)
+
+    def _drain(self):
+        super()._drain()
+        for req in self._requeue:
+            if not req.future.done():
+                req.future.cancel()
+        self._requeue.clear()

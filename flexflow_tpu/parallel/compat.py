@@ -20,3 +20,20 @@ def shard_map(fn, mesh, in_specs, out_specs, check_vma: bool = True):
         kw = {} if check_vma else {"check_rep": False}
         return legacy(fn, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, **kw)
+
+
+def ensure_cpu_devices(n: int) -> None:
+    """Force `n` virtual CPU devices, on any jax version. jax >= 0.5 has
+    the jax_num_cpu_devices config; older jax falls back to the XLA host
+    platform flag, which is honored as long as the backend has not been
+    initialized yet (any pre-set count flag is replaced, not appended —
+    XLA_FLAGS parsing is last-wins)."""
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:  # older jax (< 0.5)
+        import os
+
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)

@@ -185,15 +185,20 @@ def ulysses_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
         )
     # GQA kv can ride the exchange unrepeated only if ITS head count
     # divides the head-TP degree AND its local heads split n ways;
-    # otherwise repeat up front
-    kv_tp_ok = Hkv % h_deg == 0 if h_deg > 1 and H % h_deg == 0 else True
-    local_kv = Hkv // h_deg if Hkv % h_deg == 0 else Hkv
+    # otherwise repeat up front. Divide Hkv by h_deg only when the head
+    # dim is actually TP-sharded (`ha is not None` below): with heads
+    # unsharded every device holds ALL Hkv heads, and dividing anyway
+    # made local_kv % n fail spuriously — forcing an unnecessary kv
+    # repeat that the exchange then paid for (ADVICE r5).
+    head_tp = h_deg > 1 and H % h_deg == 0
+    kv_tp_ok = Hkv % h_deg == 0 if head_tp else True
+    local_kv = Hkv // h_deg if head_tp and Hkv % h_deg == 0 else Hkv
     if Hkv != H and (local_kv % n != 0 or not kv_tp_ok):
         k, v = repeat_kv(k, v, H // Hkv)
     jax_ops.LAST_ATTENTION_KERNEL = "ulysses_all_to_all"
 
     ba = batch_axis if _mesh_axis_size(mesh, batch_axis) > 1 else None
-    ha = head_axis if h_deg > 1 and H % h_deg == 0 else None
+    ha = head_axis if head_tp else None
     spec = P(ba, seq_axis, ha, None)
 
     def fn(ql, kl, vl):

@@ -109,6 +109,7 @@ class Executor:
         self._eval_step = None
         self._forward = None
         self._decode_fn = None
+        self._paged_decode_fn = None
         # remat="hidden": recompute MLP hidden activations in backward
         # instead of saving them (SwiGLU gate/up/silu/mul diamonds and
         # Linear(+activation)->Linear expansion chains). At LLM shapes the
@@ -411,12 +412,16 @@ class Executor:
 
     def run_forward(self, trainable, nontrainable, inputs: Sequence, *,
                     training: bool, rng, skip_sink_softmax: bool = False,
-                    kv_caches=None, cache_position=None, cache_out=None):
+                    kv_caches=None, cache_position=None, cache_out=None,
+                    page_tables=None):
         """Topo-order lowering. Returns (sink output, state_updates, aux_loss).
         With `skip_sink_softmax` the final Softmax node passes its input
         (raw logits) through — used when the loss fuses the softmax.
         `kv_caches`/`cache_position` switch attention nodes into
-        autoregressive cache mode; updated buffers land in `cache_out`."""
+        autoregressive cache mode; updated buffers land in `cache_out`.
+        `page_tables` additionally switches the cache mode to PAGED:
+        kv_caches are global page pools and each slot's rows are reached
+        through its (slots, max_pages) int32 table row."""
         values: Dict[Tuple[int, int], Any] = {}
         if len(inputs) != len(self.input_nodes):
             raise ValueError(
@@ -455,6 +460,7 @@ class Executor:
                 kv_cache=(kv_caches.get(key) if kv_caches is not None
                           else None),
                 cache_position=cache_position,
+                page_tables=page_tables,
             )
             if (
                 skip_sink_softmax
@@ -654,6 +660,63 @@ class Executor:
                 "RING_ATTENTION, or a PIPELINE composite)"
             )
         return caches
+
+    def init_paged_kv_cache(self, num_pages: int, page_size: int,
+                            dtype=None):
+        """Per-attention-node paged K/V POOLS for the paged decode path
+        (flexflow_tpu.paged): (num_pages, page_size, Hkv, D) buffers
+        shared by every request through per-slot page tables, so HBM
+        scales with TOKENS IN FLIGHT instead of slots x max_len. PIPELINE
+        composites keep their layer-scan threaded dense caches and are
+        not paged (their cache lives inside the scan carry)."""
+        caches = {}
+        for n in self.topo:
+            if n.op_type == OpType.PIPELINE:
+                raise ValueError(
+                    "paged decode does not support PIPELINE composite "
+                    "graphs (their KV cache is threaded through the layer "
+                    "scan); serve with paged=False"
+                )
+            if n.op_type not in (OpType.MULTIHEAD_ATTENTION,
+                                 OpType.RING_ATTENTION):
+                continue
+            ins = self.graph.input_shapes(n)
+            dt = dtype
+            if dt is None:
+                dt = ins[0].dtype.jnp_dtype if ins else jnp.bfloat16
+            shape = (num_pages, page_size, n.attrs.num_kv, n.attrs.kdim)
+            caches[node_key(n)] = {
+                "k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)
+            }
+        if not caches:
+            raise ValueError(
+                "paged decode needs attention nodes (MULTIHEAD_ATTENTION "
+                "or RING_ATTENTION)"
+            )
+        return caches
+
+    def paged_decode_fn(self):
+        """jitted (params, pools, page_tables, pos, ids) ->
+        (probs, new_pools): one single-token decode step through the
+        PAGED cached-attention lowering. Compiled once for the
+        (slots, max_pages) table shape; admission/free/preemption only
+        ever change table CONTENTS, so the program never recompiles."""
+        if self._paged_decode_fn is not None:
+            return self._paged_decode_fn
+
+        def step(trainable, nontrainable, caches, page_tables, pos,
+                 *inputs):
+            cache_out = {}
+            out, _, _ = self.run_forward(
+                trainable, nontrainable, inputs, training=False,
+                rng=jax.random.key(0), kv_caches=caches,
+                cache_position=pos, cache_out=cache_out,
+                page_tables=page_tables,
+            )
+            return out, cache_out
+
+        self._paged_decode_fn = jax.jit(step)
+        return self._paged_decode_fn
 
     def decode_fn(self):
         """jitted (params, caches, pos, ids) -> (probs, new_caches): one

@@ -239,12 +239,10 @@ class CostModel:
             # way through its comm tasks). ADDITIVE with the seq-parallel
             # term below: a head+seq combined view pays both collectives.
             attn_events = []
-            h_deg = 1  # head-TP degree, needed by the ulysses kv pricing
             wo = view.weight_specs.get("wo")
             if wo and len(wo) >= 1 and wo[0]:
                 deg_wo = axes_degree(wo[0])
                 if deg_wo > 1:
-                    h_deg = deg_wo
                     attn_events.append((tuple(wo[0]),
                                         self.machine.all_reduce_time(
                         node.outputs[0].global_bytes(), deg_wo,
@@ -280,12 +278,20 @@ class CostModel:
                     # mirror ulysses_dot_product_attention's (per-shard
                     # kv heads under head-TP must split the seq degree),
                     # or the search underprices the exchange. leg 2
-                    # moves only the attention output (q-sized).
-                    kv_tp_ok = (a.num_kv % h_deg == 0
-                                if h_deg > 1 and a.num_heads % h_deg == 0
-                                else True)
+                    # moves only the attention output (q-sized). h_deg
+                    # comes from the MESH head axis exactly as the
+                    # lowering reads it (_mesh_axis_size(mesh, "model")),
+                    # NOT from the view's wo sharding: the lowering
+                    # shards q/k/v heads whenever the mesh axis exists
+                    # and divides the heads, whether or not wo is
+                    # sharded, so wo-derived pricing drifted from the
+                    # bytes actually exchanged (ADVICE r5).
+                    h_deg = self.axis_sizes.get("model", 1)
+                    head_tp = h_deg > 1 and a.num_heads % h_deg == 0
+                    kv_tp_ok = (a.num_kv % h_deg == 0 if head_tp else True)
                     local_kv = (a.num_kv // h_deg
-                                if a.num_kv % h_deg == 0 else a.num_kv)
+                                if head_tp and a.num_kv % h_deg == 0
+                                else a.num_kv)
                     kv_heads_ex = (a.num_kv
                                    if local_kv % deg == 0 and kv_tp_ok
                                    else a.num_heads)

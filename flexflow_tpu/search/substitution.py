@@ -614,7 +614,13 @@ def make_cancel_parallel_ops() -> GraphXfer:
 
 
 def default_xfers(axis_sizes: Dict[str, int],
-                  full_corpus: Optional[bool] = None) -> List[GraphXfer]:
+                  full_corpus: Optional[bool] = None,
+                  stats_out: Optional[Dict] = None) -> List[GraphXfer]:
+    """`stats_out`: optionally receives the active-vs-full declarative-
+    corpus counts (corpus_rules_full/active/excluded) recorded by the
+    corpus load below — attached here, at the resolution site, so every
+    search entry point that resolves the default set gets the
+    observability for free (ADVICE r5)."""
     # linear+activation fusion comes from the JSON corpus
     # (fuse_linear_{relu,gelu,sigmoid,tanh,silu}); registering the
     # hand-coded make_fuse_linear_activation too would double-match every
@@ -640,6 +646,10 @@ def default_xfers(axis_sizes: Dict[str, int],
     from flexflow_tpu.search.xfer_engine import default_decl_xfers
 
     xf += default_decl_xfers(axis_sizes, full_corpus=full_corpus)
+    if stats_out is not None:
+        from flexflow_tpu.search import xfer_engine
+
+        stats_out.update(xfer_engine.last_corpus_counts)
     return xf
 
 
@@ -715,7 +725,7 @@ def sequence_unity_search(
     whole-graph pool itself (graph_optimize adds the winner-vs-baseline
     pair instead)."""
     all_xfers = (xfers if xfers is not None
-                 else default_xfers(cost.axis_sizes))
+                 else default_xfers(cost.axis_sizes, stats_out=stats_out))
     if stats_out is not None:
         # the honest whole-graph baseline: the UNREWRITTEN input at its
         # ViewDP-optimal views, captured before the global pre-pass can
@@ -904,7 +914,8 @@ def unity_search(
     its ViewDP-optimal views)."""
     from flexflow_tpu.search.dp import ViewDP
 
-    xfers = xfers if xfers is not None else default_xfers(cost.axis_sizes)
+    xfers = (xfers if xfers is not None
+             else default_xfers(cost.axis_sizes, stats_out=stats_out))
     if stats_out is not None:
         # corpus-size observability: a truncated (active-set) or inflated
         # corpus shows up in gate records next to wall_s

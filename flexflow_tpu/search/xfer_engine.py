@@ -706,6 +706,11 @@ ACTIVE_RULES_PATH = os.path.join(os.path.dirname(__file__), "rules",
 _ACTIVE_CACHE: Dict[str, Optional[set]] = {}
 _active_gating_logged = False
 
+# active-vs-full corpus counts of the MOST RECENT default_decl_xfers call;
+# the substitution search copies these into its stats_out next to n_xfers
+# so gate records show whether a search ran gated or full (ADVICE r5)
+last_corpus_counts: Dict[str, int] = {}
+
 
 def _active_rule_set() -> Optional[set]:
     """Cached active-rule names, or None when no active file exists (the
@@ -731,6 +736,10 @@ def default_decl_xfers(axis_sizes: Dict[str, int],
             "(fusions, cancellations, conv/embedding parallelization); "
             "regenerate with `python -m flexflow_tpu.search.xfer_engine`"
         )
+        last_corpus_counts.clear()
+        last_corpus_counts.update(
+            corpus_rules_full=0, corpus_rules_active=0,
+            corpus_rules_excluded=0)
         return []
     if full_corpus is None:
         full_corpus = os.environ.get("FF_TPU_FULL_CORPUS") == "1"
@@ -740,18 +749,27 @@ def default_decl_xfers(axis_sizes: Dict[str, int],
     else:
         with open(DEFAULT_RULES_PATH) as f:
             raw = _RULES_CACHE[DEFAULT_RULES_PATH] = json.load(f)
+    full_count = len(raw)
     if active is not None:
+        n_active = len(active & {r["name"] for r in raw})
         global _active_gating_logged
         if not _active_gating_logged:
+            # WARNING, not INFO: a gated corpus changes what the search can
+            # discover, and the default logging config must surface it
             import logging
 
-            logging.getLogger(__name__).info(
+            logging.getLogger(__name__).warning(
                 "substitution corpus gated to %d/%d active rules "
-                "(coverage-demonstrated on the BASELINE+Inception configs; "
-                "FF_TPU_FULL_CORPUS=1 or full_corpus=True restores all)",
-                len(active & {r["name"] for r in raw}), len(raw))
+                "(%d excluded — coverage-demonstrated on the "
+                "BASELINE+Inception configs; FF_TPU_FULL_CORPUS=1 or "
+                "full_corpus=True restores all)",
+                n_active, full_count, full_count - n_active)
             _active_gating_logged = True
         raw = [r for r in raw if r["name"] in active]
+    last_corpus_counts.clear()
+    last_corpus_counts.update(
+        corpus_rules_full=full_count, corpus_rules_active=len(raw),
+        corpus_rules_excluded=full_count - len(raw))
     out = []
     for r in raw:
         ax = r.get("requires_axis")

@@ -349,7 +349,8 @@ def http_serve(server: Server, port: int = 8000, model_name: str = "model"):
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "temperature", "future", "tokens",
-                 "pos")
+                 "pos", "pages", "submit_t", "admit_t", "prefill_tokens",
+                 "peak_pages", "preemptions")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -358,23 +359,46 @@ class _GenRequest:
         self.future: Future = Future()
         self.tokens: List[int] = []
         self.pos = 0  # next cache write position for this slot
+        # paged-path bookkeeping / per-request metrics
+        self.pages: List[int] = []      # pool pages held (paged only)
+        self.submit_t = time.monotonic()
+        self.admit_t: Optional[float] = None
+        self.prefill_tokens = 0
+        self.peak_pages = 0
+        self.preemptions = 0
+
+    def seq_tokens(self) -> np.ndarray:
+        """prompt + generated-so-far: what a (re-)prefill must feed. For a
+        fresh request this is just the prompt; for a preempted requeue it
+        re-derives the full context WITHOUT mutating the prompt (folding
+        tokens into the prompt double-counted them on a second
+        preemption)."""
+        if not self.tokens:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    def metrics(self) -> dict:
+        """Per-request serving metrics (queue time covers submit -> LAST
+        admission, so a preempted request's requeue wait counts too)."""
+        return {
+            "queue_time_s": (self.admit_t - self.submit_t
+                             if self.admit_t is not None else None),
+            "prefill_tokens": self.prefill_tokens,
+            "decode_tokens": len(self.tokens),
+            "pages_held_peak": self.peak_pages,
+            "preemptions": self.preemptions,
+        }
 
 
-class GenerationServer:
-    """Continuous batching over the KV-cache decode path (beyond the
-    reference triton/ backend, which serves stateless forwards only).
+class _GenerationServerBase:
+    """Shared chassis of the dense and paged generation servers: request
+    queue + stop/drain contract, temperature/greedy sampling, prompt
+    validation, and the learned-position-table guard — so the two decode
+    paths can never drift apart on the serving surface."""
 
-    A fixed pool of `slots` shares one jitted single-token decode step with
-    PER-SLOT cache positions (ops/jax_ops.py cached-attention vector-pos
-    path). Each tick admits queued requests into free slots (one bucketed
-    prefill per admission scatters the prompt's K/V into the slot's cache
-    rows), then advances every active slot one token. Finished sequences
-    (EOS or their max_new_tokens) free their slot immediately — no
-    batch-drain barrier, the defining property of continuous batching.
-    """
-
-    def __init__(self, ff, slots: int = 4, max_len: int = 512,
-                 eos_id: Optional[int] = None, seed: int = 0):
+    def __init__(self, ff, slots: int, max_len: int,
+                 eos_id: Optional[int], seed: int):
         import jax
         import jax.numpy as jnp
 
@@ -391,18 +415,8 @@ class GenerationServer:
                 f"position table ({rows} rows); rebuild with a longer "
                 "seq_len or lower max_len")
         self.eos_id = eos_id
-        ex = ff.executor
-        self._step = ex.decode_fn()
         self._params = ff._params
-        self._caches = ex.init_kv_cache(self.slots, self.max_len)
-        # one-slot prefill caches per bucketed prompt length share the big
-        # pool's dtype/shape suffix, so rows scatter straight in
-        self._prefill_caches = ex.init_kv_cache(1, self.max_len)
         self._rng = jax.random.key(seed)
-
-        @jax.jit
-        def scatter_slot(big, row, slot):
-            return jax.tree.map(lambda b, r: b.at[slot].set(r[0]), big, row)
 
         @jax.jit
         def pick(probs_last, temps, rng):
@@ -414,7 +428,6 @@ class GenerationServer:
                 jnp.int32)
             return jnp.where(temps > 0.0, sampled, greedy)
 
-        self._scatter = scatter_slot
         self._pick = pick
         self._queue: "queue.Queue[_GenRequest]" = queue.Queue()
         self._active: List[Optional[_GenRequest]] = [None] * self.slots
@@ -425,10 +438,21 @@ class GenerationServer:
         self._running = True
         self._served = 0
         self._steps = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def _start(self):
+        """Subclasses call this LAST in __init__ (the loop thread must not
+        observe a half-built server)."""
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     # -- public API ------------------------------------------------------
+
+    def _check_capacity(self, prompt: np.ndarray, max_new_tokens: int):
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_len ({self.max_len})")
 
     def submit(self, prompt_ids: np.ndarray, max_new_tokens: int,
                temperature: float = 0.0) -> Future:
@@ -438,14 +462,11 @@ class GenerationServer:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("prompt must contain at least one token")
-        if len(prompt) + max_new_tokens > self.max_len:
-            raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds max_len ({self.max_len})")
+        self._check_capacity(prompt, max_new_tokens)
         req = _GenRequest(prompt, max_new_tokens, temperature)
         with self._lock:
             if not self._running:
-                raise RuntimeError("GenerationServer is stopped")
+                raise RuntimeError(f"{type(self).__name__} is stopped")
             self._queue.put(req)
         return req.future
 
@@ -472,7 +493,7 @@ class GenerationServer:
     def decode_steps(self) -> int:
         return self._steps
 
-    # -- scheduler loop --------------------------------------------------
+    # -- shared scheduler pieces -----------------------------------------
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -481,31 +502,44 @@ class GenerationServer:
             b *= 2
         return b
 
-    def _admit(self, req: _GenRequest, slot: int):
-        """Bucketed prefill into `slot`: pad the prompt right (pad rows land
-        at kpos > the slot's qpos, so they are masked until overwritten by
-        real decode writes), scatter the K/V rows, pick the first token from
-        the last REAL prompt position."""
+    def _admit_common(self, req: _GenRequest, slot: int, padded_len: int,
+                      scatter_rows):
+        """Bucketed prefill + first-token sample, shared by the dense and
+        paged admits so their sampling/rng discipline can never drift:
+        pad the prompt right (pad rows land at kpos > the slot's qpos, so
+        they are masked until overwritten by real decode writes), hand
+        the prefill K/V rows to `scatter_rows` (dense slot-scatter or
+        paged page-scatter), pick the first token from the last REAL
+        prompt position, and stamp the request's admission bookkeeping."""
         import jax
         import jax.numpy as jnp
 
         tr, ntr = self._params
-        n = len(req.prompt)
-        padded = np.zeros((1, min(self._bucket(n), self.max_len)), np.int32)
-        padded[0, :n] = req.prompt
-        probs, upd = self._step(tr, ntr, self._prefill_caches, 0,
-                                jnp.asarray(padded))
-        for key, rows in upd.items():
-            self._caches[key] = self._scatter(self._caches[key], rows, slot)
+        seq = req.seq_tokens()
+        n = len(seq)
+        padded = np.zeros((1, padded_len), np.int32)
+        padded[0, :n] = seq
+        probs, upd = self._prefill_step(tr, ntr, self._prefill_caches, 0,
+                                        jnp.asarray(padded))
+        scatter_rows(upd)
         self._rng, sub = jax.random.split(self._rng)
         tok = int(np.asarray(self._pick(
             probs[:, n - 1, :],
             jnp.full((1,), req.temperature, jnp.float32), sub))[0])
+        req.admit_t = time.monotonic()
+        req.prefill_tokens = n
         req.pos = n
         req.tokens.append(tok)
         self._tokens[slot] = tok
         self._active[slot] = req
-        self._finish_if_done(slot)
+
+    def _release_slot(self, slot: int, req: _GenRequest,
+                      completed: bool = False):
+        """Subclass hook: reclaim per-slot resources (paged frees pages).
+        `completed` distinguishes a finished request from a cancellation
+        (stop()/_drain) — the finish criteria live ONLY in
+        _finish_if_done."""
+        self._active[slot] = None
 
     def _finish_if_done(self, slot: int):
         req = self._active[slot]
@@ -515,21 +549,94 @@ class GenerationServer:
         if self.eos_id is not None and req.tokens and req.tokens[-1] == self.eos_id:
             done = True
         if done:
-            self._active[slot] = None
+            self._release_slot(slot, req, completed=True)
             self._served += 1
             req.future.set_result(np.asarray(req.tokens, np.int32))
 
     def _loop(self):
-        import jax
-        import jax.numpy as jnp
-
-        tr, ntr = self._params
         try:
-            self._loop_body(tr, ntr)
+            self._loop_body(*self._params)
         finally:
             # runs on ANY exit — including a decode-step exception — so
             # blocked callers always unblock instead of hanging forever
             self._drain()
+
+    def _loop_body(self, tr, ntr):
+        raise NotImplementedError
+
+    def _drain(self):
+        """Cancel whatever is still queued or mid-decode so callers
+        unblock — a truncated sequence must not look like a completed one.
+        Runs on the loop thread at exit AND on the stop() caller's thread
+        after join, so a submit racing stop() still gets resolved."""
+        for s in range(self.slots):
+            req = self._active[s]
+            if req is not None:
+                self._release_slot(s, req)
+                if not req.future.done():
+                    req.future.cancel()
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.cancel()
+
+
+class GenerationServer(_GenerationServerBase):
+    """Continuous batching over the KV-cache decode path (beyond the
+    reference triton/ backend, which serves stateless forwards only).
+
+    A fixed pool of `slots` shares one jitted single-token decode step with
+    PER-SLOT cache positions (ops/jax_ops.py cached-attention vector-pos
+    path). Each tick admits queued requests into free slots (one bucketed
+    prefill per admission scatters the prompt's K/V into the slot's cache
+    rows), then advances every active slot one token. Finished sequences
+    (EOS or their max_new_tokens) free their slot immediately — no
+    batch-drain barrier, the defining property of continuous batching.
+
+    Each slot's cache is a DENSE max_len buffer; for HBM that scales with
+    tokens in flight instead of slots x max_len, see
+    flexflow_tpu.paged.PagedGenerationServer (serve_generation(paged=True)).
+    """
+
+    def __init__(self, ff, slots: int = 4, max_len: int = 512,
+                 eos_id: Optional[int] = None, seed: int = 0):
+        import jax
+
+        super().__init__(ff, slots, max_len, eos_id, seed)
+        ex = ff.executor
+        self._step = ex.decode_fn()
+        self._prefill_step = self._step  # one fn, two input shapes
+        self._caches = ex.init_kv_cache(self.slots, self.max_len)
+        # one-slot prefill caches per bucketed prompt length share the big
+        # pool's dtype/shape suffix, so rows scatter straight in
+        self._prefill_caches = ex.init_kv_cache(1, self.max_len)
+
+        @jax.jit
+        def scatter_slot(big, row, slot):
+            return jax.tree.map(lambda b, r: b.at[slot].set(r[0]), big, row)
+
+        self._scatter = scatter_slot
+        self._start()
+
+    # -- scheduler loop --------------------------------------------------
+
+    def _admit(self, req: _GenRequest, slot: int):
+        """Bucketed prefill into `slot` (_admit_common), scattering the
+        one-slot prefill cache's K/V rows into the slot's dense rows."""
+
+        def scatter(upd):
+            for key, rows in upd.items():
+                self._caches[key] = self._scatter(self._caches[key], rows,
+                                                  slot)
+
+        self._admit_common(
+            req, slot,
+            min(self._bucket(len(req.seq_tokens())), self.max_len),
+            scatter)
+        self._finish_if_done(slot)
 
     def _loop_body(self, tr, ntr):
         import jax
@@ -572,30 +679,27 @@ class GenerationServer:
                 self._tokens[s] = toks[s]
                 self._finish_if_done(s)
 
-    def _drain(self):
-        """Cancel whatever is still queued or mid-decode so callers
-        unblock — a truncated sequence must not look like a completed one.
-        Runs on the loop thread at exit AND on the stop() caller's thread
-        after join, so a submit racing stop() still gets resolved."""
-        for s in range(self.slots):
-            req = self._active[s]
-            if req is not None:
-                self._active[s] = None
-                if not req.future.done():
-                    req.future.cancel()
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if not req.future.done():
-                req.future.cancel()
-
 
 def serve_generation(ff, slots: int = 4, max_len: int = 512,
-                     eos_id: Optional[int] = None, seed: int = 0
-                     ) -> GenerationServer:
+                     eos_id: Optional[int] = None, seed: int = 0,
+                     paged: bool = False, page_size: int = 64,
+                     num_pages: Optional[int] = None,
+                     preemption: bool = True) -> "_GenerationServerBase":
     """Continuous-batching generation endpoint over a compiled causal-LM
-    FFModel (KV-cache decode path required — see FFModel.generate)."""
+    FFModel (KV-cache decode path required — see FFModel.generate).
+
+    `paged=True` serves through the block-paged KV cache
+    (flexflow_tpu.paged): HBM scales with the page pool (`num_pages` x
+    `page_size` tokens shared by all requests) instead of
+    slots x max_len, admission is by free-page budget, and page pressure
+    preempts+requeues the youngest request (`preemption=False` queues
+    instead). Dense and paged paths share sampling, the position-table
+    guard, and the submit/stop contract."""
+    if paged:
+        from flexflow_tpu.paged.scheduler import PagedGenerationServer
+
+        return PagedGenerationServer(
+            ff, slots=slots, max_len=max_len, eos_id=eos_id, seed=seed,
+            page_size=page_size, num_pages=num_pages, preemption=preemption)
     return GenerationServer(ff, slots=slots, max_len=max_len, eos_id=eos_id,
                             seed=seed)

@@ -19,4 +19,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5) has no jax_num_cpu_devices option; the
+    # XLA_FLAGS host-platform-device-count above already provides the 8
+    # devices as long as jax was not initialized before this file ran
+    pass

@@ -14,7 +14,13 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+
+import os  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from flexflow_tpu.parallel.compat import ensure_cpu_devices  # noqa: E402
+
+ensure_cpu_devices(4)
 
 import numpy as np  # noqa: E402
 

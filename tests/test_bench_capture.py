@@ -64,3 +64,43 @@ def test_smoke_results_never_persist(tmp_path, monkeypatch):
     bench._persist_green({"metric": "llama_smoke_train_tokens_per_sec",
                           "value": 9.0})
     assert not (tmp_path / "last_green.json").exists()
+
+
+def test_fallback_refuses_stale_artifact(tmp_path, capsys, monkeypatch):
+    """A green result older than the max-age cutoff must NOT be emitted as
+    a current number (a week-old cache would mask a real regression)."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_GREEN_PATH",
+                        str(tmp_path / "last_green.json"))
+    bench._persist_green({"metric": "llama_1b_train_tokens_per_sec",
+                          "value": 99.0, "unit": "tokens/s",
+                          "vs_baseline": 1.2})
+    saved = json.loads((tmp_path / "last_green.json").read_text())
+    saved["_captured_unix"] -= 8 * 24 * 3600  # 8 days old
+    (tmp_path / "last_green.json").write_text(json.dumps(saved))
+    try:
+        bench._emit_last_green_or(
+            {"metric": "llama_1b_train_tokens_per_sec", "value": 0.0},
+            exit_code=4, want="1b")
+    except SystemExit as e:
+        assert e.code == 4
+    else:
+        raise AssertionError("expected SystemExit on stale artifact")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 0.0 and "cached" not in out
+
+
+def test_combined_fallback_accepts_either_gate_config(tmp_path, capsys,
+                                                      monkeypatch):
+    """The combined-gate fallback paths pass want=("1b","200m"): a cached
+    200m result answers them, but a smoke/other metric never does."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_GREEN_PATH",
+                        str(tmp_path / "last_green.json"))
+    bench._persist_green({"metric": "llama_200m_train_tokens_per_sec",
+                          "value": 55.0, "unit": "tokens/s",
+                          "vs_baseline": 1.1})
+    bench._emit_last_green_or({"value": 0.0}, exit_code=4,
+                              want=("1b", "200m"))
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["cached"] is True and out["value"] == 55.0

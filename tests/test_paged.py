@@ -1,0 +1,356 @@
+"""Paged KV-cache + continuous batching (flexflow_tpu.paged).
+
+Parity contract: the paged decode path must be TOKEN-IDENTICAL to the
+dense GenerationServer / FFModel.generate on the same prompts (greedy),
+and logits-identical at the decode-step level — the page indirection is
+a memory layout, never a numerics change.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+from flexflow_tpu.ffconst import DataType
+from flexflow_tpu.models.llama import LlamaConfig, build_llama
+from flexflow_tpu.paged.pool import PagePool
+
+
+def _causal_lm(kv_heads=2, seed=7):
+    """Tiny causal LM; kv_heads=2 is GQA (4 q heads), 4 is MHA."""
+    lcfg = LlamaConfig(vocab_size=512, dim=64, layers=2, heads=4,
+                      kv_heads=kv_heads, hidden=128, rope_theta=10000.0)
+    ff = FFModel(FFConfig(batch_size=1, seed=seed))
+    build_llama(ff, lcfg, batch_size=1, seq_len=8, dtype=DataType.FLOAT)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff, lcfg
+
+
+# ---------------------------------------------------------------------------
+# page pool bookkeeping (host-side numpy)
+
+
+def test_page_pool_alloc_free_accounting():
+    pool = PagePool(num_pages=8, page_size=4, max_pages_per_seq=4)
+    assert pool.capacity == 7 and pool.free_pages == 7
+    a = pool.alloc(3, owner=0)
+    b = pool.alloc(2, owner=1)
+    assert len(a) == 3 and len(b) == 2 and 0 not in a + b  # null reserved
+    assert pool.free_pages == 2 and pool.pages_in_use == 5
+    assert pool.alloc(3) is None  # never partial
+    assert pool.free_pages == 2
+    pool.free(a)
+    assert pool.free_pages == 5
+    assert pool.pages_for(1) == 1 and pool.pages_for(4) == 1
+    assert pool.pages_for(5) == 2
+
+
+def test_page_pool_defrag_compacts_and_remaps():
+    pool = PagePool(num_pages=10, page_size=4, max_pages_per_seq=4)
+    a = pool.alloc(2, owner=0)
+    b = pool.alloc(3, owner=1)
+    pool.free(a)  # fragment: b's pages no longer contiguous from 1
+    perm, old_to_new = pool.defrag()
+    # b's pages land on 1..3, every old page appears exactly once in perm
+    assert sorted(old_to_new[p] for p in b) == [1, 2, 3]
+    assert sorted(perm.tolist()) == list(range(10))
+    assert old_to_new[0] == 0 and perm[0] == 0  # null page fixed
+    # perm is consistent with old_to_new on allocated pages
+    for p in b:
+        assert perm[old_to_new[p]] == p
+    assert pool.pages_in_use == 3 and pool.free_pages == 6
+    # post-defrag allocations come from the compacted free set
+    c = pool.alloc(6, owner=2)
+    assert c is not None and len(set(c) & {1, 2, 3}) == 0
+
+
+# ---------------------------------------------------------------------------
+# kernel vs gather reference (interpret mode; the same validation pattern
+# as test_pallas_flash)
+
+
+@pytest.mark.parametrize("H,Hkv", [(8, 2), (4, 4)])  # GQA and MHA
+def test_paged_kernel_matches_gather_reference(H, Hkv):
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.paged.attention import (
+        paged_flash_decode,
+        paged_gather_attention,
+    )
+
+    B, D, P, N, MAXP = 3, 32, 8, 12, 4
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.float32)
+    kc = jax.random.normal(ks[1], (N, P, Hkv, D), jnp.float32)
+    vc = jax.random.normal(ks[2], (N, P, Hkv, D), jnp.float32)
+    # ragged rows at different depths, incl. one spilling into page 4
+    pt = jnp.asarray(np.array([[1, 2, 3, 0], [4, 5, 0, 0],
+                               [6, 7, 8, 9]], np.int32))
+    pos = jnp.asarray(np.array([18, 9, 30], np.int32))
+    scale = 1.0 / np.sqrt(D)
+    ref = paged_gather_attention(q, kc, vc, pt, pos, scale=scale)
+    got = paged_flash_decode(q, kc, vc, pt, pos, scale=scale,
+                             interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# decode-step logits parity (executor level): dense cache vs page pool
+
+
+def test_paged_decode_logits_match_dense():
+    import jax.numpy as jnp
+
+    ff, lcfg = _causal_lm()
+    ex = ff.executor
+    tr, ntr = ff._params
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, lcfg.vocab_size, (1, 5)).astype(np.int32)
+    P, MAXP = 4, 4  # max_len 16
+
+    dense = ex.init_kv_cache(1, 16)
+    step = ex.decode_fn()
+    probs, dense = step(tr, ntr, dense, 0, jnp.asarray(prompt))
+
+    pools = ex.init_paged_kv_cache(9, P)
+    # scatter the dense prefill rows into pages [1, 2] (5 tokens -> 2 pages)
+    ids = jnp.asarray(np.array([1, 2], np.int32))
+    for key in pools:
+        pools[key] = {
+            n: pools[key][n].at[ids].set(
+                dense[key][n][0].reshape(MAXP, P, *dense[key][n].shape[2:])[:2])
+            for n in ("k", "v")
+        }
+    tables = jnp.asarray(np.array([[1, 2, 3, 0]], np.int32))
+    pstep = ex.paged_decode_fn()
+
+    tok = jnp.argmax(probs[:, 4, :], axis=-1).astype(jnp.int32)
+    for pos in range(5, 8):  # crosses no page boundary until pos 8
+        probs_d, dense = step(tr, ntr, dense, pos, tok[:, None])
+        probs_p, pools = pstep(tr, ntr, pools, tables,
+                               jnp.asarray(np.array([pos], np.int32)),
+                               tok[:, None])
+        np.testing.assert_allclose(np.asarray(probs_p[:, -1]),
+                                   np.asarray(probs_d[:, -1]),
+                                   atol=1e-5, rtol=1e-5)
+        tok = jnp.argmax(probs_d[:, -1, :], axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# served-token parity vs dense generate()
+
+
+@pytest.mark.parametrize("kv_heads", [2, 4])  # GQA and MHA
+def test_paged_server_matches_dense_generate(kv_heads):
+    """Greedy continuous batching through the page pool emits EXACTLY the
+    tokens one-at-a-time generate() emits — with prompts SPANNING page
+    boundaries (page_size=4, prompts up to 8 tokens) and staggered
+    lengths, so page-table indirection, prefill scatter, growth, and
+    stale-page masking all have to be right."""
+    ff, lcfg = _causal_lm(kv_heads=kv_heads)
+    rs = np.random.RandomState(1)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 8, 5, 2, 6)]
+    want = [ff.generate(p[None, :], max_new_tokens=5)[0] for p in prompts]
+    server = ff.serve_generation(slots=2, max_len=32, paged=True,
+                                 page_size=4)
+    try:
+        futs = [server.submit(p, max_new_tokens=5) for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert server.requests_served == len(prompts)
+    assert server.decode_steps < 25  # continuous, not serial
+
+
+def test_paged_temperature_sampling_matches_dense_server():
+    """Dense and paged servers share ONE sampling implementation and rng
+    discipline: with the same seed and a single in-flight request, their
+    sampled (temperature>0) streams are identical."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(3)
+    p = rs.randint(0, lcfg.vocab_size, (4,)).astype(np.int32)
+    dense = ff.serve_generation(slots=2, max_len=16, seed=5)
+    try:
+        want = dense.generate(p, max_new_tokens=6, temperature=0.9)
+    finally:
+        dense.stop()
+    paged = ff.serve_generation(slots=2, max_len=16, seed=5, paged=True,
+                                page_size=4)
+    try:
+        got = paged.generate(p, max_new_tokens=6, temperature=0.9)
+    finally:
+        paged.stop()
+    np.testing.assert_array_equal(want, got)
+
+
+# ---------------------------------------------------------------------------
+# scheduler policy: admission by page budget, exhaustion, preemption
+
+
+def test_page_pool_exhaustion_queues():
+    """A pool that only fits ONE request serializes: later submissions
+    queue for pages (never fail, never corrupt), and every request still
+    matches dense generate()."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(2)
+    prompts = [rs.randint(0, lcfg.vocab_size, (5,)).astype(np.int32)
+               for _ in range(3)]
+    want = [ff.generate(p[None, :], max_new_tokens=3)[0] for p in prompts]
+    # capacity 2 pages (8 tokens); each request needs 2 pages at its peak
+    server = ff.serve_generation(slots=4, max_len=16, paged=True,
+                                 page_size=4, num_pages=3)
+    try:
+        futs = [server.submit(p, max_new_tokens=3) for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    m = server.metrics()
+    assert m["requests_served"] == 3
+    assert m["peak_active"] == 1  # pages, not slots, bounded concurrency
+    assert m["pages_in_use"] == 0  # everything returned to the pool
+
+
+def test_preemption_requeues_and_stays_correct():
+    """Page pressure preempts the youngest request; it requeues with its
+    prompt + generated prefix and still produces the dense-identical
+    greedy continuation."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(2)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 6, 4, 7)]
+    want = [ff.generate(p[None, :], max_new_tokens=6)[0] for p in prompts]
+    # 2 slots want up to 2*ceil(13/4)=8 pages at their peak; pool holds 5
+    server = ff.serve_generation(slots=2, max_len=16, paged=True,
+                                 page_size=4, num_pages=6)
+    try:
+        futs = [server.submit(p, max_new_tokens=6) for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        server.stop()
+    for i, (w, g) in enumerate(zip(want, got)):
+        np.testing.assert_array_equal(w, g, err_msg=f"request {i}")
+    m = server.metrics()
+    assert m["preemptions"] > 0, "pool pressure never preempted"
+    assert m["requests_served"] == 4
+    # per-request metrics: the preempted request recorded its requeue
+    assert sum(r["preemptions"] for r in m["requests"]) == m["preemptions"]
+    for r in m["requests"]:
+        assert r["queue_time_s"] >= 0 and r["decode_tokens"] == 6
+        assert r["pages_held_peak"] >= 1
+
+
+def test_paged_admits_more_concurrency_than_dense_layout():
+    """THE paging win (acceptance criterion): with the pool sized to the
+    HBM of only TWO dense max_len slots, short requests still run FOUR
+    abreast — concurrency beyond what the dense slots x max_len layout
+    could hold — and everything matches dense greedy output."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(4)
+    prompts = [rs.randint(0, lcfg.vocab_size, (3,)).astype(np.int32)
+               for _ in range(6)]
+    want = [ff.generate(p[None, :], max_new_tokens=8)[0] for p in prompts]
+    max_len, page_size, num_pages = 16, 4, 9
+    # dense-equivalent capacity of this pool: (9-1)*4 = 32 cached tokens
+    # = 2 slots of max_len 16
+    dense_equiv_slots = (num_pages - 1) * page_size // max_len
+    assert dense_equiv_slots == 2
+    server = ff.serve_generation(slots=4, max_len=max_len, paged=True,
+                                 page_size=page_size, num_pages=num_pages)
+    try:
+        futs = [server.submit(p, max_new_tokens=8) for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    m = server.metrics()
+    assert m["requests_served"] == 6
+    assert m["peak_active"] > dense_equiv_slots, (
+        f"paged pool admitted only {m['peak_active']} concurrent requests; "
+        f"a dense layout with the same HBM holds {dense_equiv_slots}")
+
+
+def test_defrag_compacts_pool_mid_stream():
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(5)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 6)]
+    want = [ff.generate(p[None, :], max_new_tokens=6)[0] for p in prompts]
+    server = ff.serve_generation(slots=2, max_len=16, paged=True,
+                                 page_size=4)
+    try:
+        futs = [server.submit(p, max_new_tokens=6) for p in prompts]
+        server.request_defrag()
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert server.defrags >= 1
+
+
+def test_paged_submit_contract():
+    """Shared submit surface: bad requests rejected, page-capacity guard,
+    submit after stop raises."""
+    ff, _ = _causal_lm()
+    server = ff.serve_generation(slots=1, max_len=16, paged=True,
+                                 page_size=4, num_pages=3)
+    try:
+        with pytest.raises(ValueError):
+            server.submit(np.array([1, 2], np.int32), max_new_tokens=0)
+        with pytest.raises(ValueError):
+            server.submit(np.array([], np.int32), max_new_tokens=2)
+        with pytest.raises(ValueError):  # max_len guard (shared with dense)
+            server.submit(np.arange(15, dtype=np.int32), max_new_tokens=5)
+        with pytest.raises(ValueError):  # page-pool capacity guard
+            server.submit(np.arange(9, dtype=np.int32), max_new_tokens=3)
+    finally:
+        server.stop()
+    with pytest.raises(RuntimeError):
+        server.submit(np.array([1, 2], np.int32), max_new_tokens=2)
+
+
+@pytest.mark.slow
+def test_paged_stress_many_requests_long_sequences():
+    """Heavy soak (excluded from the tier-1 CPU gate): TPU-sized pages,
+    many overlapping requests, repeated pool churn — greedy output stays
+    dense-identical throughout."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(6)
+    prompts = [rs.randint(0, lcfg.vocab_size, (int(n),)).astype(np.int32)
+               for n in rs.randint(2, 40, size=20)]
+    want = [ff.generate(p[None, :], max_new_tokens=24)[0] for p in prompts]
+    server = ff.serve_generation(slots=8, max_len=64, paged=True,
+                                 page_size=8, num_pages=25)
+    try:
+        futs = [server.submit(p, max_new_tokens=24) for p in prompts]
+        got = [f.result(timeout=600) for f in futs]
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert server.metrics()["pages_in_use"] == 0
+
+
+def test_requeue_prefix_never_double_folds():
+    """Regression (caught by the stress soak): a request preempted TWICE
+    must not fold its generated prefix into the prompt twice. The prompt
+    is immutable; re-prefill context is always seq_tokens() = prompt +
+    tokens-so-far, idempotent across any number of preemptions."""
+    from flexflow_tpu.serving import _GenRequest
+
+    prompt = np.array([7, 8, 9], np.int32)
+    req = _GenRequest(prompt, max_new=8, temperature=0.0)
+    req.tokens = [1, 2, 3]
+    np.testing.assert_array_equal(req.seq_tokens(),
+                                  [7, 8, 9, 1, 2, 3])  # first preemption
+    req.tokens.append(4)  # decoded further after re-admission
+    np.testing.assert_array_equal(req.seq_tokens(),
+                                  [7, 8, 9, 1, 2, 3, 4])  # second one
+    np.testing.assert_array_equal(req.prompt, prompt)  # never mutated

