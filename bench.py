@@ -320,6 +320,96 @@ def bench_naive(x, y) -> float:
     return BATCH * SEQ / dt
 
 
+def bench_decode() -> dict:
+    """Serving-side benchmark (bench.py --decode): paged continuous-
+    batching decode throughput, then speculative decoding on a
+    repetitive-prompt fixture (a token-cyclic model, so the n-gram
+    drafter's acceptance is exercised for real). Runs in-process — CPU
+    under --smoke, any backend otherwise — and reports decode tokens/sec
+    plus the speculation acceptance metrics, so BENCH json covers
+    serving, not just training step time."""
+    from flexflow_tpu import FFConfig, FFModel, LossType
+    from flexflow_tpu.ffconst import DataType
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+    from flexflow_tpu.spec import SpecConfig
+
+    smoke = bool(os.environ.get("FLEXFLOW_BENCH_SMOKE"))
+    if smoke:
+        lcfg = LlamaConfig.tiny(vocab=128)
+        n_req, max_new, max_len, page = 6, 16, 64, 8
+    else:
+        lcfg = LlamaConfig(vocab_size=8192, dim=512, layers=6, heads=8,
+                           kv_heads=4, hidden=1408, rope_theta=10000.0)
+        n_req, max_new, max_len, page = 16, 128, 512, 64
+    _log(f"decode bench: building model (vocab={lcfg.vocab_size}, "
+         f"dim={lcfg.dim}, layers={lcfg.layers})")
+    ff = FFModel(FFConfig(batch_size=1, seed=0))
+    build_llama(ff, lcfg, batch_size=1, seq_len=8, dtype=DataType.FLOAT)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    rs = np.random.RandomState(0)
+
+    def run_server(prompts, speculate=None):
+        server = ff.serve_generation(slots=4, max_len=max_len, paged=True,
+                                     page_size=page, speculate=speculate)
+        try:
+            # warm every compile off the clock: both prefill buckets the
+            # 4..16-token prompts can hit (8 and 16) plus the decode step
+            server.generate(prompts[0][:3], max_new_tokens=2)
+            server.generate(np.tile(prompts[0], 4)[:16], max_new_tokens=2)
+            warm = server.metrics().get("speculative", {})
+            t0 = time.perf_counter()
+            futs = [server.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+            outs = [f.result(timeout=1200) for f in futs]
+            dt = time.perf_counter() - t0
+            metrics = server.metrics()
+            sm = metrics.get("speculative")
+            if sm:
+                # report the TIMED window only: subtract the warm-up
+                # requests' raw counters and re-derive the two rates
+                for k in ("steps", "draft_tokens", "accepted_tokens",
+                          "emitted_tokens"):
+                    sm[k] -= warm.get(k, 0)
+                sm["acceptance_rate"] = (sm["accepted_tokens"]
+                                         / sm["draft_tokens"]
+                                         if sm["draft_tokens"] else 0.0)
+                sm["accepted_tokens_per_step"] = (sm["emitted_tokens"]
+                                                  / sm["steps"]
+                                                  if sm["steps"] else 0.0)
+        finally:
+            server.stop()
+        toks = sum(len(o) for o in outs)
+        return toks / dt, toks, metrics
+
+    prompts = [rs.randint(0, lcfg.vocab_size, (rs.randint(4, 17),))
+               .astype(np.int32) for _ in range(n_req)]
+    _log("decode bench: plain paged serving")
+    tps, toks, _ = run_server(prompts)
+
+    # repetitive fixture: token-cyclic model (shared with tests/test_spec)
+    from flexflow_tpu.spec.fixtures import make_token_cyclic
+
+    make_token_cyclic(ff)
+    _log("decode bench: speculative serving on the repetitive fixture")
+    spec_tps, _spec_toks, m = run_server(
+        prompts, speculate=SpecConfig(width=2, depth=4))
+    sm = m["speculative"]
+    return {
+        "metric": "paged_decode_tokens_per_sec",
+        "value": round(tps, 2),
+        "unit": "tokens/s",
+        "requests": n_req,
+        "decode_tokens": toks,
+        "speculative": {
+            "tokens_per_sec": round(spec_tps, 2),
+            "acceptance_rate": round(sm["acceptance_rate"], 4),
+            "accepted_tokens_per_step": round(
+                sm["accepted_tokens_per_step"], 4),
+            "fixture": "token-cyclic model (repetitive greedy stream)",
+        },
+    }
+
+
 def _configure_child_platform() -> None:
     plat = os.environ.get("FLEXFLOW_BENCH_PLATFORM")
     if plat:
@@ -529,6 +619,13 @@ def main():
                      "[--config 1b|200m]")
         os.environ["FLEXFLOW_BENCH_PLATFORM"] = sys.argv[i + 1]
         del sys.argv[i:i + 2]
+    if "--decode" in sys.argv:
+        # serving-side bench: in-process, no subprocess orchestration (it
+        # has no naive-baseline side and is CPU-capable under --smoke)
+        sys.argv.remove("--decode")
+        _configure_child_platform()
+        print(json.dumps(bench_decode()))
+        return
     only_config = None
     if "--config" in sys.argv:
         i = sys.argv.index("--config")

@@ -1183,18 +1183,22 @@ class FFModel:
     def serve_generation(self, slots: int = 4, max_len: int = 512,
                          eos_id=None, seed: int = 0, paged: bool = False,
                          page_size: int = 64, num_pages=None,
-                         preemption: bool = True):
+                         preemption: bool = True, speculate=None):
         """Continuous-batching autoregressive generation endpoint (KV-cache
         decode with per-slot positions — flexflow_tpu.serving). With
         `paged=True` the KV cache is a block-paged pool shared by all
         requests (flexflow_tpu.paged): HBM scales with tokens in flight,
         admission is by free-page budget, and page pressure preempts and
-        requeues the youngest request."""
+        requeues the youngest request. `speculate=SpecConfig(...)` (with
+        paged=True) adds speculative tree decoding (flexflow_tpu.spec):
+        drafted token trees verified in one step, greedy output
+        token-identical, up to depth+1 tokens emitted per step."""
         from flexflow_tpu.serving import serve_generation as _sg
 
         return _sg(self, slots=slots, max_len=max_len, eos_id=eos_id,
                    seed=seed, paged=paged, page_size=page_size,
-                   num_pages=num_pages, preemption=preemption)
+                   num_pages=num_pages, preemption=preemption,
+                   speculate=speculate)
 
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],
                 batch_size: Optional[int] = None) -> np.ndarray:

@@ -120,9 +120,11 @@ def _batch_matmul(attrs, inputs, params, ctx):
 
 def apply_rope(x, theta: float, pos_offset=0):
     """Rotary position embedding, half-split (rotate_half) convention.
-    x: (B, S, H, D). `pos_offset` is a scalar, or a (B,) vector of per-row
+    x: (B, S, H, D). `pos_offset` is a scalar, a (B,) vector of per-row
     offsets (continuous-batching decode: every slot sits at its own
-    absolute position).
+    absolute position), or a (B, S) matrix of ABSOLUTE per-token
+    positions (speculative tree verify: sibling draft nodes share a
+    depth, so the flat node axis is not a position axis).
 
     Angles and sin/cos are computed in fp32 (position precision), but the
     rotation itself runs in the ACTIVATION dtype: upcasting the whole
@@ -135,8 +137,12 @@ def apply_rope(x, theta: float, pos_offset=0):
         raise ValueError(f"RoPE requires an even head dim, got {D}")
     d2 = D // 2
     freqs = theta ** (-jnp.arange(0, d2, dtype=jnp.float32) / d2)
-    off = jnp.asarray(pos_offset, jnp.float32).reshape(-1, 1)  # (B|1, 1)
-    pos = jnp.arange(S, dtype=jnp.float32)[None, :] + off      # (B|1, S)
+    off = jnp.asarray(pos_offset, jnp.float32)
+    if off.ndim == 2:
+        pos = off                                          # (B, S) absolute
+    else:
+        off = off.reshape(-1, 1)                           # (B|1, 1)
+        pos = jnp.arange(S, dtype=jnp.float32)[None, :] + off  # (B|1, S)
     ang = pos[:, :, None] * freqs[None, None, :]  # (B|1, S, d2)
     cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
     sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
@@ -320,7 +326,22 @@ def _mha(attrs, inputs, params, ctx):
         k = k + params["bk"].astype(dt)
         v = v + params["bv"].astype(dt)
     if ctx.kv_cache is not None:
-        if ctx.page_tables is not None:
+        if ctx.page_tables is not None and ctx.spec_mask is not None:
+            # speculative tree verify (flexflow_tpu.spec): score a whole
+            # drafted token tree in one step — nodes write rows at
+            # pos + node, rope at pos + depth, and attend under the
+            # ancestor visibility mask
+            from flexflow_tpu.paged.attention import (
+                paged_cached_tree_attention,
+            )
+
+            out, kc, vc = paged_cached_tree_attention(
+                q, k, v, ctx.kv_cache["k"], ctx.kv_cache["v"],
+                ctx.page_tables, ctx.cache_position, ctx.spec_depths,
+                ctx.spec_mask, scale=1.0 / (hd**0.5),
+                rope_theta=attrs.rope_theta if attrs.rope else None,
+            )
+        elif ctx.page_tables is not None:
             # paged decode: the cache is a global page pool and this
             # slot's rows are reached through its page table
             # (flexflow_tpu.paged.attention — Pallas kernel or gather
@@ -402,6 +423,10 @@ def _element_binary(attrs, inputs, params, ctx):
         if pos.ndim == 0:
             rows = lax.dynamic_slice_in_dim(b, pos, a.shape[1], axis=0)
             b = rows[None]
+        elif ctx.spec_depths is not None:
+            # tree verify: node j sits at absolute position pos + depth
+            # (sibling branches share a row of the table)
+            b = b[pos[:, None] + ctx.spec_depths]
         else:
             # continuous batching: per-row positions, single-token steps
             b = b[pos][:, None]

@@ -17,7 +17,10 @@ See docs/paged.md for the page-table layout and scheduler policy.
 from flexflow_tpu.paged.attention import (
     paged_attention_available,
     paged_cached_attention,
+    paged_cached_tree_attention,
     paged_gather_attention,
+    paged_tree_verify,
+    tree_visibility_mask,
 )
 from flexflow_tpu.paged.pool import PagePool
 from flexflow_tpu.paged.scheduler import PagedGenerationServer
@@ -27,5 +30,8 @@ __all__ = [
     "PagedGenerationServer",
     "paged_attention_available",
     "paged_cached_attention",
+    "paged_cached_tree_attention",
     "paged_gather_attention",
+    "paged_tree_verify",
+    "tree_visibility_mask",
 ]

@@ -16,11 +16,19 @@ picks its kernel (backend probe + env kill switch + shape gate):
 The decode step is S=1 by construction (prefill runs through the dense
 cached path and its rows are scattered into pages afterwards —
 scheduler.py), so q is (B, 1, H, D) here.
+
+A third path extends both for SPECULATIVE tree verify
+(flexflow_tpu.spec): the step scores a whole token tree per slot in one
+pass — S = max_nodes queries whose visibility is committed-rows plus the
+query's own ancestor path (tree attention). The Pallas tree kernel
+reuses the scalar-prefetched page walk with a per-page mask block; the
+gather fallback is selected by the same availability gate.
 """
 
 from __future__ import annotations
 
 import functools
+import logging
 import os
 from typing import Optional
 
@@ -33,6 +41,21 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 LANES = 128
 
+logger = logging.getLogger(__name__)
+_fallback_logged: set = set()
+
+
+def _reject(reason: str) -> bool:
+    """Log the CONCRETE kernel-rejection reason once per reason (the
+    flash-attention selection discipline: a silent fallback looks like a
+    10x paged-decode slowdown with no explanation in any log)."""
+    if reason not in _fallback_logged:
+        _fallback_logged.add(reason)
+        logger.info(
+            "paged attention: Pallas kernel rejected (%s); using the "
+            "jnp.take gather fallback", reason)
+    return False
+
 
 def paged_attention_available(head_dim: int, page_size: int,
                               interpret: bool = False,
@@ -44,18 +67,30 @@ def paged_attention_available(head_dim: int, page_size: int,
     blocks; smaller head dims take the gather fallback, mirroring the
     flash bshd gate) and pages must tile the sublane dim AT THE POOL'S
     DTYPE — (8, 128) tiles for fp32 but (16, 128) for bf16/fp16 and
-    (32, 128) for int8/fp8, so a bf16 pool needs page_size % 16 == 0."""
+    (32, 128) for int8/fp8, so a bf16 pool needs page_size % 16 == 0.
+    Rejections log their concrete reason once (head_dim/page_size/dtype/
+    backend) instead of silently falling back."""
     if os.environ.get("FF_TPU_NO_PAGED") == "1":
-        return False
+        return _reject("FF_TPU_NO_PAGED=1 kill switch set")
     if interpret:
         return True
-    itemsize = jnp.dtype(dtype).itemsize
+    dt = jnp.dtype(dtype)
+    itemsize = dt.itemsize
     if itemsize > 4:
-        return False  # 8-byte dtypes have no TPU tiling story
+        return _reject(
+            f"pool dtype {dt.name} is 8-byte (no TPU tiling story)")
     sublane = 8 * (4 // max(itemsize, 1))
-    if head_dim % LANES != 0 or page_size % sublane != 0:
-        return False
-    return jax.default_backend() == "tpu"
+    if head_dim % LANES != 0:
+        return _reject(
+            f"head_dim={head_dim} is not a multiple of the {LANES}-lane "
+            "tile")
+    if page_size % sublane != 0:
+        return _reject(
+            f"page_size={page_size} does not tile the {sublane}-row "
+            f"sublane dim at pool dtype {dt.name}")
+    if jax.default_backend() != "tpu":
+        return _reject(f"backend is {jax.default_backend()!r}, not tpu")
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -214,4 +249,175 @@ def paged_cached_attention(q, k, v, cache_k, cache_v, page_tables, pos, *,
     else:
         out = paged_gather_attention(q, kc, vc, page_tables, pos_v,
                                      scale=scale)
+    return out, kc, vc
+
+
+# ---------------------------------------------------------------------------
+# speculative tree verify (flexflow_tpu.spec): score a token tree per slot
+# in ONE pass. Tree node j's K/V row lands at cache row pos + j; queries
+# see committed rows (kpos < pos) plus their own ancestor path.
+
+
+def tree_visibility_mask(page_tables, pos, anc_mask, page_size: int):
+    """(B, T, L) bool visibility for tree verify, L = max_pages x P.
+    anc_mask is the (B, T, T) ancestor-or-self relation of the flattened
+    tree; row kpos is visible to query q when it is committed
+    (kpos < pos) or holds a tree node on q's root path. Everything else —
+    padding nodes' rows, stale rows from earlier (wider) trees, the null
+    page — stays masked."""
+    B, T, _ = anc_mask.shape
+    L = page_tables.shape[1] * page_size
+    kpos = jnp.arange(L)
+    rel = jnp.broadcast_to(kpos[None, None, :] - pos[:, None, None],
+                           (B, T, L))
+    in_tree = (rel >= 0) & (rel < T)
+    anc = jnp.take_along_axis(anc_mask, jnp.clip(rel, 0, T - 1), axis=2)
+    return (kpos[None, None, :] < pos[:, None, None]) | (in_tree & anc)
+
+
+def paged_tree_gather_attention(q, kc_pages, vc_pages, page_tables, mask, *,
+                                scale: float):
+    """Pure-JAX tree-verify reference: gather every table-mapped page and
+    attend under the precomputed (B, T, L) visibility mask. q is
+    (B, T, H, D) — T tree nodes, not sequence positions."""
+    B, T, _, D = q.shape
+    Hkv = kc_pages.shape[2]
+    dt = q.dtype
+    kg = kc_pages[page_tables].reshape(B, -1, Hkv, D)
+    vg = vc_pages[page_tables].reshape(B, -1, Hkv, D)
+    from flexflow_tpu.ops.jax_ops import _dot_product_attention
+
+    return _dot_product_attention(q, kg.astype(dt), vg.astype(dt),
+                                  causal=False, scale=scale, mask=mask)
+
+
+def _paged_tree_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, mask_ref,
+                       o_ref, m_scr, l_scr, acc_scr, *, scale, page_size,
+                       n_pages, tree):
+    b, j = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # visible rows reach at most pos + tree - 1 (committed prefix + the
+    # tree's own rows); pages wholly past that contribute nothing
+    @pl.when(j * page_size <= pos_ref[b] + tree - 1)
+    def _():
+        q = q_ref[...]                       # (rep, T, D)
+        k = k_ref[...]                       # (P, D)
+        v = v_ref[...]
+        s = lax.dot_general(q, k, (((2,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        s = s + mask_ref[...][None]          # additive (T, P) mask block
+        m_prev = m_scr[:, :, 0:1]
+        l_prev = l_scr[:, :, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=2, keepdims=True)
+        pv = lax.dot_general(p.astype(v.dtype), v,
+                             (((2,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _():
+        l_safe = jnp.maximum(l_scr[:, :, 0:1], 1e-30)
+        o_ref[...] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_tree_verify(q, kc_pages, vc_pages, page_tables, pos, mask, *,
+                      scale: float, interpret: bool = False):
+    """Pallas tree-verify step. q: (B, T, H, D) tree-node queries;
+    kc/vc_pages: (N, P, Hkv, D); mask: (B, T, L) bool visibility
+    (tree_visibility_mask). Same scalar-prefetched page walk as
+    paged_flash_decode — each grid step DMAs one page's K/V from its
+    pooled HBM location — plus one (T, P) mask block per page, so the
+    gathered sequence never materializes and the tree structure rides a
+    VMEM-resident additive mask."""
+    B, T, H, D = q.shape
+    N, P, Hkv, _ = kc_pages.shape
+    rep = H // Hkv
+    n_pages = page_tables.shape[1]
+    qr = q.transpose(0, 2, 1, 3).reshape(B, Hkv, rep, T, D)
+    add_mask = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, n_pages),
+        in_specs=[
+            pl.BlockSpec((None, None, rep, T, D),
+                         lambda b, g, j, pt, ps: (b, g, 0, 0, 0)),
+            pl.BlockSpec((None, P, None, D),
+                         lambda b, g, j, pt, ps: (pt[b, j], 0, g, 0)),
+            pl.BlockSpec((None, P, None, D),
+                         lambda b, g, j, pt, ps: (pt[b, j], 0, g, 0)),
+            pl.BlockSpec((None, T, P),
+                         lambda b, g, j, pt, ps: (b, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((None, None, rep, T, D),
+                               lambda b, g, j, pt, ps: (b, g, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, T, LANES), jnp.float32),
+            pltpu.VMEM((rep, T, LANES), jnp.float32),
+            pltpu.VMEM((rep, T, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_tree_kernel, scale=scale, page_size=P,
+                          n_pages=n_pages, tree=T),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep, T, D), q.dtype),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), pos.astype(jnp.int32), qr,
+      kc_pages, vc_pages, add_mask)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, T, H, D)
+
+
+def paged_cached_tree_attention(q, k, v, cache_k, cache_v, page_tables,
+                                pos, depths, anc_mask, *, scale: float,
+                                rope_theta: Optional[float] = None):
+    """One speculative TREE-VERIFY step — the multi-node analog of
+    paged_cached_attention. q/k/v carry T tree nodes per slot; node j's
+    rope position is pos + depths[b, j] (siblings share a depth, so
+    alternative branches are scored at the SAME absolute position), its
+    K/V row is written at cache row pos + j, and attention runs under the
+    ancestor visibility mask. Accept/rollback afterwards is pure index
+    bookkeeping: the scheduler copies the accepted path's rows onto the
+    contiguous committed positions (Executor.paged_commit_fn) and
+    advances pos — rejected rows sit past the new write head, masked
+    exactly like any stale page content.
+
+    Returns (attention output, new k pool, new v pool)."""
+    from flexflow_tpu.ops.jax_ops import apply_rope
+
+    B, T = q.shape[0], q.shape[1]
+    P = cache_k.shape[1]
+    pos_v = jnp.asarray(pos)
+    positions = pos_v[:, None] + depths                    # (B, T)
+    if rope_theta is not None:
+        q = apply_rope(q, rope_theta, pos_offset=positions)
+        k = apply_rope(k, rope_theta, pos_offset=positions)
+    L = page_tables.shape[1] * P
+    rows = jnp.minimum(pos_v[:, None] + jnp.arange(T)[None, :], L - 1)
+    bidx = jnp.arange(B)[:, None]
+    page = page_tables[bidx, rows // P]                    # (B, T)
+    off = rows % P
+    kc = cache_k.at[page, off].set(k.astype(cache_k.dtype))
+    vc = cache_v.at[page, off].set(v.astype(cache_v.dtype))
+
+    mask = tree_visibility_mask(page_tables, pos_v, anc_mask, P)
+    force_interp = os.environ.get("FF_TPU_FLASH_INTERPRET") == "1"
+    if paged_attention_available(q.shape[-1], P, interpret=force_interp,
+                                 dtype=kc.dtype):
+        out = paged_tree_verify(q, kc, vc, page_tables, pos_v, mask,
+                                scale=scale, interpret=force_interp)
+    else:
+        out = paged_tree_gather_attention(q, kc, vc, page_tables, mask,
+                                          scale=scale)
     return out, kc, vc

@@ -43,12 +43,18 @@ class PagedGenerationServer(_GenerationServerBase):
     def __init__(self, ff, slots: int = 4, max_len: int = 512,
                  eos_id: Optional[int] = None, seed: int = 0,
                  page_size: int = 64, num_pages: Optional[int] = None,
-                 preemption: bool = True):
+                 preemption: bool = True, table_slack_tokens: int = 0):
         import jax
 
         super().__init__(ff, slots, max_len, eos_id, seed)
         self.page_size = int(page_size)
-        self.max_pages_per_seq = -(-self.max_len // self.page_size)
+        # table_slack_tokens widens every page table beyond max_len —
+        # speculative verify (flexflow_tpu.spec) writes its draft tree's
+        # rows past the committed head, so the table must address up to
+        # max_len + max_nodes rows even though pos never exceeds max_len
+        self.table_slack = int(table_slack_tokens)
+        self.max_pages_per_seq = -(
+            -(self.max_len + self.table_slack) // self.page_size)
         # prefill runs through the DENSE one-slot cache, page-aligned so
         # its rows reshape straight into (max_pages, page_size) pages
         self._prefill_len = self.max_pages_per_seq * self.page_size
@@ -72,7 +78,6 @@ class PagedGenerationServer(_GenerationServerBase):
         self.preemptions = 0
         self.defrags = 0
         self.peak_active = 0
-        self._request_metrics: List[dict] = []
 
         mpps, P = self.max_pages_per_seq, self.page_size
 
@@ -91,9 +96,15 @@ class PagedGenerationServer(_GenerationServerBase):
 
     # -- capacity ---------------------------------------------------------
 
+    def _peak_rows(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Cache rows a request touches at its deepest point (subclass
+        hook: speculative verify adds its tree's scratch rows)."""
+        return prompt_len + max_new_tokens
+
     def _check_capacity(self, prompt: np.ndarray, max_new_tokens: int):
         super()._check_capacity(prompt, max_new_tokens)
-        need = self.pool.pages_for(len(prompt) + max_new_tokens)
+        need = self.pool.pages_for(self._peak_rows(len(prompt),
+                                                   max_new_tokens))
         if need > self.pool.capacity:
             raise ValueError(
                 f"request needs {need} pages at its longest "
@@ -102,18 +113,18 @@ class PagedGenerationServer(_GenerationServerBase):
                 f"{self.pool.capacity}; raise num_pages")
 
     def metrics(self) -> dict:
-        """Aggregate serving metrics + the per-request records of every
-        COMPLETED request (queue time, prefill/decode tokens, pages)."""
-        return {
-            "requests_served": self._served,
-            "decode_steps": self._steps,
+        """Aggregate serving metrics + the per-request records of the
+        last MAX_REQUEST_RECORDS completed requests (queue time,
+        prefill/decode tokens, pages — see _GenerationServerBase)."""
+        m = super().metrics()
+        m.update({
             "preemptions": self.preemptions,
             "defrags": self.defrags,
             "peak_active": self.peak_active,
             "pages_in_use": self.pool.pages_in_use,
             "free_pages": self.pool.free_pages,
-            "requests": list(self._request_metrics),
-        }
+        })
+        return m
 
     def request_defrag(self):
         """Ask the loop to compact the page pool between ticks (host
@@ -129,8 +140,6 @@ class PagedGenerationServer(_GenerationServerBase):
         self._tables[slot] = 0
         if slot in self._admit_order:
             self._admit_order.remove(slot)
-        if completed:  # cancellations (stop/_drain) are not records
-            self._request_metrics.append(req.metrics())
         super()._release_slot(slot, req, completed)
 
     def _evict(self, slot: int):
@@ -189,24 +198,30 @@ class PagedGenerationServer(_GenerationServerBase):
 
     # -- page growth / preemption ----------------------------------------
 
+    def _pages_target(self, req: _GenRequest) -> int:
+        """Pages a live slot must hold BEFORE the next tick (subclass
+        hook: speculative verify needs its whole tree's rows covered, not
+        just the next write position)."""
+        return min(self.pool.pages_for(req.pos + 1), self.max_pages_per_seq)
+
     def _ensure_pages(self):
-        """Before a tick, every live slot whose NEXT write position
-        crosses into an unallocated page gets one; pool pressure preempts
-        the youngest OTHER live request (`preemption=False` requeues the
-        starved request itself — a stall, never a wrong answer)."""
+        """Before a tick, every live slot grows to its _pages_target
+        (base: the page holding the next write position); pool pressure
+        preempts the youngest OTHER live request (`preemption=False`
+        requeues the starved request itself — a stall, never a wrong
+        answer)."""
         for slot in list(self._admit_order):
             req = self._active[slot]
             if req is None:
                 continue
-            if req.pos // self.page_size < len(req.pages):
-                continue
-            while True:
+            target = self._pages_target(req)
+            while req is self._active[slot] and len(req.pages) < target:
                 got = self.pool.alloc(1, owner=slot)
                 if got is not None:
                     req.pages.append(got[0])
                     req.peak_pages = max(req.peak_pages, len(req.pages))
                     self._tables[slot, len(req.pages) - 1] = got[0]
-                    break
+                    continue
                 victims = [s for s in self._admit_order if s != slot]
                 if self.preemption and victims:
                     self._evict(victims[-1])  # youngest other request
@@ -231,60 +246,100 @@ class PagedGenerationServer(_GenerationServerBase):
 
     # -- scheduler loop ----------------------------------------------------
 
-    def _loop_body(self, tr, ntr):
+    def _admission_pages(self, req: _GenRequest) -> int:
+        """Free pages required before admitting `req`: the prompt's rows
+        PLUS the first decode tick's write row (an exact-page-multiple
+        prompt would otherwise admit and immediately preempt for its
+        first tick's page). Subclass hook: speculative verify instead
+        requires the whole first verify tree to fit."""
+        return self.pool.pages_for(len(req.seq_tokens()) + 1)
+
+    def _outstanding_growth(self) -> int:
+        """Pages the already-live slots still need to reach their
+        _pages_target — admission must not hand them out (a slot admitted
+        this tick would otherwise trigger a first-tick preemption when
+        _ensure_pages collects the debt)."""
+        debt = 0
+        for s in self._admit_order:
+            req = self._active[s]
+            if req is not None:
+                debt += max(0, self._pages_target(req) - len(req.pages))
+        return debt
+
+    def _admit_pending(self) -> bool:
+        """Admission: free slot + the request's page budget available
+        (net of pages live slots are still owed), FIFO (a too-big head
+        request blocks later ones — no starvation). Returns whether
+        anything was admitted."""
+        admitted = False
+        for slot in range(self.slots):
+            if self._active[slot] is not None:
+                continue
+            req = self._pop_next()
+            if req is None:
+                break
+            if (self._admission_pages(req) + self._outstanding_growth()
+                    > self.pool.free_pages):
+                self._push_back(req)
+                break
+            self._admit(req, slot)
+            admitted = True
+        return admitted
+
+    def _live(self) -> List[int]:
+        return [s for s in range(self.slots) if self._active[s] is not None]
+
+    def _tick_prep(self) -> Optional[List[int]]:
+        """Shared tick prologue (base and speculative loops): defrag if
+        requested, admit, grow pages. Returns the live slots to decode,
+        or None when this tick should be skipped (nothing live; sleeps
+        briefly when nothing was admitted either)."""
+        if self._defrag_req.is_set():
+            self._defrag_req.clear()
+            self._apply_defrag()
+        admitted = self._admit_pending()
+        live = self._live()
+        self.peak_active = max(self.peak_active, len(live))
+        if not live:
+            if not admitted:
+                time.sleep(0.001)
+            return None
+        self._ensure_pages()  # may preempt: recompute live after
+        return self._live() or None
+
+    def _decode_tick(self, live, tr, ntr):
+        """One plain single-token decode tick for the whole slot pool
+        (also dispatched by the speculative server when no live slot can
+        use a tree — all-sampled ticks skip the tree-verify FLOPs)."""
         import jax
         import jax.numpy as jnp
 
+        pos = np.array([self._active[s].pos if self._active[s] else 0
+                        for s in range(self.slots)], np.int32)
+        probs, upd = self._step(
+            tr, ntr, self._caches, jnp.asarray(self._tables),
+            jnp.asarray(pos), jnp.asarray(self._tokens)[:, None])
+        self._caches = upd
+        temps = np.array(
+            [self._active[s].temperature if self._active[s] else 0.0
+             for s in range(self.slots)], np.float32)
+        self._rng, sub = jax.random.split(self._rng)
+        toks = np.asarray(self._pick(probs[:, -1, :],
+                                     jnp.asarray(temps), sub))
+        self._steps += 1
+        for s in live:
+            req = self._active[s]
+            req.pos += 1
+            req.tokens.append(int(toks[s]))
+            self._tokens[s] = toks[s]
+            self._finish_if_done(s)
+
+    def _loop_body(self, tr, ntr):
         while not self._stop.is_set():
-            if self._defrag_req.is_set():
-                self._defrag_req.clear()
-                self._apply_defrag()
-            # admission: free slot + prompt's pages available, FIFO (a
-            # too-big head request blocks later ones — no starvation)
-            admitted = False
-            for slot in range(self.slots):
-                if self._active[slot] is not None:
-                    continue
-                req = self._pop_next()
-                if req is None:
-                    break
-                if (self.pool.pages_for(len(req.seq_tokens()))
-                        > self.pool.free_pages):
-                    self._push_back(req)
-                    break
-                self._admit(req, slot)
-                admitted = True
-            live = [s for s in range(self.slots)
-                    if self._active[s] is not None]
-            self.peak_active = max(self.peak_active, len(live))
-            if not live:
-                if not admitted:
-                    time.sleep(0.001)
+            live = self._tick_prep()
+            if live is None:
                 continue
-            self._ensure_pages()
-            live = [s for s in range(self.slots)
-                    if self._active[s] is not None]
-            if not live:
-                continue
-            pos = np.array([self._active[s].pos if self._active[s] else 0
-                            for s in range(self.slots)], np.int32)
-            probs, upd = self._step(
-                tr, ntr, self._caches, jnp.asarray(self._tables),
-                jnp.asarray(pos), jnp.asarray(self._tokens)[:, None])
-            self._caches = upd
-            temps = np.array(
-                [self._active[s].temperature if self._active[s] else 0.0
-                 for s in range(self.slots)], np.float32)
-            self._rng, sub = jax.random.split(self._rng)
-            toks = np.asarray(self._pick(probs[:, -1, :],
-                                         jnp.asarray(temps), sub))
-            self._steps += 1
-            for s in live:
-                req = self._active[s]
-                req.pos += 1
-                req.tokens.append(int(toks[s]))
-                self._tokens[s] = toks[s]
-                self._finish_if_done(s)
+            self._decode_tick(live, tr, ntr)
 
     def _drain(self):
         super()._drain()

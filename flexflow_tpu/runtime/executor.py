@@ -110,6 +110,8 @@ class Executor:
         self._forward = None
         self._decode_fn = None
         self._paged_decode_fn = None
+        self._verify_fn = None
+        self._paged_commit_fn = None
         # remat="hidden": recompute MLP hidden activations in backward
         # instead of saving them (SwiGLU gate/up/silu/mul diamonds and
         # Linear(+activation)->Linear expansion chains). At LLM shapes the
@@ -413,7 +415,7 @@ class Executor:
     def run_forward(self, trainable, nontrainable, inputs: Sequence, *,
                     training: bool, rng, skip_sink_softmax: bool = False,
                     kv_caches=None, cache_position=None, cache_out=None,
-                    page_tables=None):
+                    page_tables=None, spec_tree=None):
         """Topo-order lowering. Returns (sink output, state_updates, aux_loss).
         With `skip_sink_softmax` the final Softmax node passes its input
         (raw logits) through — used when the loss fuses the softmax.
@@ -421,7 +423,11 @@ class Executor:
         autoregressive cache mode; updated buffers land in `cache_out`.
         `page_tables` additionally switches the cache mode to PAGED:
         kv_caches are global page pools and each slot's rows are reached
-        through its (slots, max_pages) int32 table row."""
+        through its (slots, max_pages) int32 table row. `spec_tree`
+        (a (depths, ancestor_mask) pair — flexflow_tpu.spec) further
+        switches the paged step into speculative TREE VERIFY: the inputs
+        carry a whole drafted token tree per slot and attention applies
+        the ancestor visibility mask."""
         values: Dict[Tuple[int, int], Any] = {}
         if len(inputs) != len(self.input_nodes):
             raise ValueError(
@@ -431,6 +437,8 @@ class Executor:
             values[(n.guid, 0)] = x
         state_updates: Dict[str, Dict[str, Any]] = {}
         aux_loss = 0.0
+        spec_depths, spec_mask = spec_tree if spec_tree is not None else (
+            None, None)
         remat_groups = self._remat_groups if training else {}
         for n in self.topo:
             if n.op_type == OpType.INPUT:
@@ -461,6 +469,8 @@ class Executor:
                           else None),
                 cache_position=cache_position,
                 page_tables=page_tables,
+                spec_depths=spec_depths,
+                spec_mask=spec_mask,
             )
             if (
                 skip_sink_softmax
@@ -717,6 +727,63 @@ class Executor:
 
         self._paged_decode_fn = jax.jit(step)
         return self._paged_decode_fn
+
+    def verify_fn(self):
+        """jitted (params, pools, page_tables, pos, depths, tree_mask,
+        ids) -> (probs, new_pools): one speculative TREE-VERIFY step
+        (flexflow_tpu.spec). `ids` is (slots, max_nodes) — every slot's
+        flattened draft tree, node 0 the last sampled token — `depths`
+        the (slots, max_nodes) node depths and `tree_mask` the
+        (slots, max_nodes, max_nodes) ancestor relation. Node j's K/V row
+        is written at cache row pos + j; probs[:, j] is the model's
+        next-token distribution after the path root..j, so acceptance is
+        a host-side argmax walk. Compiled once for the (slots, max_nodes)
+        shape — tree CONTENTS (tokens/parents) change per step, the
+        program never recompiles."""
+        if self._verify_fn is not None:
+            return self._verify_fn
+
+        def step(trainable, nontrainable, caches, page_tables, pos,
+                 depths, tree_mask, *inputs):
+            cache_out = {}
+            out, _, _ = self.run_forward(
+                trainable, nontrainable, inputs, training=False,
+                rng=jax.random.key(0), kv_caches=caches,
+                cache_position=pos, cache_out=cache_out,
+                page_tables=page_tables, spec_tree=(depths, tree_mask),
+            )
+            return out, cache_out
+
+        self._verify_fn = jax.jit(step)
+        return self._verify_fn
+
+    def paged_commit_fn(self):
+        """jitted (pools, page_tables, src, dst) -> pools: copy the
+        accepted tree path's K/V rows onto the contiguous committed
+        positions (speculative rollback, flexflow_tpu.spec). src/dst are
+        (slots, C) int32 cache-row positions resolved through each slot's
+        page table; unused entries point a row at itself (a no-op copy),
+        so one fixed-shape program serves every acceptance outcome.
+        Rejected rows are NOT touched — they sit past the advanced write
+        head and are masked like any stale page content."""
+        if self._paged_commit_fn is not None:
+            return self._paged_commit_fn
+
+        def commit(caches, page_tables, src, dst):
+            bidx = jnp.arange(src.shape[0])[:, None]
+            out = {}
+            for key, bufs in caches.items():
+                P = bufs["k"].shape[1]
+                sp, so = page_tables[bidx, src // P], src % P
+                dp, do = page_tables[bidx, dst // P], dst % P
+                out[key] = {
+                    n: bufs[n].at[dp, do].set(bufs[n][sp, so])
+                    for n in ("k", "v")
+                }
+            return out
+
+        self._paged_commit_fn = jax.jit(commit)
+        return self._paged_commit_fn
 
     def decode_fn(self):
         """jitted (params, caches, pos, ids) -> (probs, new_caches): one

@@ -244,14 +244,22 @@ _DTYPE_TO_V2 = {"float32": "FP32", "float64": "FP64", "int32": "INT32",
 _V2_TO_DTYPE = {v: k for k, v in _DTYPE_TO_V2.items()}
 
 
-def http_serve(server: Server, port: int = 8000, model_name: str = "model"):
+def http_serve(server: Server, port: int = 8000, model_name: str = "model",
+               generation_server=None):
     """Expose a Server over HTTP with the KServe v2 JSON surface the
     reference's triton backend speaks (triton/README.md):
 
       GET  /v2/health/ready                 -> 200
       GET  /v2/models/<name>               -> metadata
+      GET  /v2/models/<name>/metrics       -> serving metrics JSON
       POST /v2/models/<name>/infer         -> {"inputs": [{"name","shape",
                                                "datatype","data"}...]}
+
+    The metrics endpoint serves the batcher's counters and — when a
+    `generation_server` (serve_generation) is attached — its aggregate +
+    per-request generation metrics (queue times, pages, preemptions,
+    speculative acceptance rates), so operators scrape what was
+    previously reachable only from Python.
 
     Returns the ThreadingHTTPServer (serve_forever on a thread; call
     .shutdown() to stop). Stdlib-only — no server framework in the image.
@@ -291,6 +299,13 @@ def http_serve(server: Server, port: int = 8000, model_name: str = "model"):
                     "platform": "flexflow_tpu",
                     "requests_served": server.requests_served,
                 })
+            elif self.path == f"/v2/models/{model_name}/metrics":
+                payload = {
+                    "server": {"requests_served": server.requests_served},
+                }
+                if generation_server is not None:
+                    payload["generation"] = generation_server.metrics()
+                self._send(200, payload)
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -350,7 +365,8 @@ def http_serve(server: Server, port: int = 8000, model_name: str = "model"):
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "temperature", "future", "tokens",
                  "pos", "pages", "submit_t", "admit_t", "prefill_tokens",
-                 "peak_pages", "preemptions")
+                 "peak_pages", "preemptions", "spec_steps", "spec_drafted",
+                 "spec_accepted", "spec_emitted")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -366,6 +382,12 @@ class _GenRequest:
         self.prefill_tokens = 0
         self.peak_pages = 0
         self.preemptions = 0
+        # speculative decoding (flexflow_tpu.spec): verify steps run for
+        # this request, draft tokens proposed/accepted, tokens emitted
+        self.spec_steps = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
 
     def seq_tokens(self) -> np.ndarray:
         """prompt + generated-so-far: what a (re-)prefill must feed. For a
@@ -381,7 +403,7 @@ class _GenRequest:
     def metrics(self) -> dict:
         """Per-request serving metrics (queue time covers submit -> LAST
         admission, so a preempted request's requeue wait counts too)."""
-        return {
+        m = {
             "queue_time_s": (self.admit_t - self.submit_t
                              if self.admit_t is not None else None),
             "prefill_tokens": self.prefill_tokens,
@@ -389,6 +411,18 @@ class _GenRequest:
             "pages_held_peak": self.peak_pages,
             "preemptions": self.preemptions,
         }
+        if self.spec_steps:
+            m.update({
+                "spec_steps": self.spec_steps,
+                "spec_draft_tokens": self.spec_drafted,
+                "spec_accepted_tokens": self.spec_accepted,
+                "spec_acceptance_rate": (
+                    self.spec_accepted / self.spec_drafted
+                    if self.spec_drafted else 0.0),
+                "spec_accepted_tokens_per_step": (
+                    self.spec_emitted / self.spec_steps),
+            })
+        return m
 
 
 class _GenerationServerBase:
@@ -396,6 +430,11 @@ class _GenerationServerBase:
     queue + stop/drain contract, temperature/greedy sampling, prompt
     validation, and the learned-position-table guard — so the two decode
     paths can never drift apart on the serving surface."""
+
+    # per-request metric records kept for metrics(); bounded so a
+    # long-running server (and the HTTP metrics scrape) cannot grow
+    # without limit — oldest records drop first
+    MAX_REQUEST_RECORDS = 1024
 
     def __init__(self, ff, slots: int, max_len: int,
                  eos_id: Optional[int], seed: int):
@@ -438,6 +477,7 @@ class _GenerationServerBase:
         self._running = True
         self._served = 0
         self._steps = 0
+        self._request_metrics: List[dict] = []
         self._thread: Optional[threading.Thread] = None
 
     def _start(self):
@@ -493,6 +533,18 @@ class _GenerationServerBase:
     def decode_steps(self) -> int:
         return self._steps
 
+    def metrics(self) -> dict:
+        """Aggregate serving metrics + per-request records of the last
+        MAX_REQUEST_RECORDS COMPLETED requests (subclasses extend: paged
+        adds pool/preemption counters, speculative adds acceptance
+        rates). This dict is what http_serve's /v2/models/<name>/metrics
+        endpoint serves."""
+        return {
+            "requests_served": self._served,
+            "decode_steps": self._steps,
+            "requests": list(self._request_metrics),
+        }
+
     # -- shared scheduler pieces -----------------------------------------
 
     @staticmethod
@@ -538,7 +590,13 @@ class _GenerationServerBase:
         """Subclass hook: reclaim per-slot resources (paged frees pages).
         `completed` distinguishes a finished request from a cancellation
         (stop()/_drain) — the finish criteria live ONLY in
-        _finish_if_done."""
+        _finish_if_done. Completed requests record their per-request
+        metrics (cancellations are not records)."""
+        if completed:
+            self._request_metrics.append(req.metrics())
+            if len(self._request_metrics) > self.MAX_REQUEST_RECORDS:
+                del self._request_metrics[
+                    :len(self._request_metrics) - self.MAX_REQUEST_RECORDS]
         self._active[slot] = None
 
     def _finish_if_done(self, slot: int):
@@ -684,7 +742,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                      eos_id: Optional[int] = None, seed: int = 0,
                      paged: bool = False, page_size: int = 64,
                      num_pages: Optional[int] = None,
-                     preemption: bool = True) -> "_GenerationServerBase":
+                     preemption: bool = True,
+                     speculate=None) -> "_GenerationServerBase":
     """Continuous-batching generation endpoint over a compiled causal-LM
     FFModel (KV-cache decode path required — see FFModel.generate).
 
@@ -694,7 +753,25 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
     slots x max_len, admission is by free-page budget, and page pressure
     preempts+requeues the youngest request (`preemption=False` queues
     instead). Dense and paged paths share sampling, the position-table
-    guard, and the submit/stop contract."""
+    guard, and the submit/stop contract.
+
+    `speculate=SpecConfig(...)` (requires paged=True) turns each decode
+    tick into a speculative TREE-VERIFY step (flexflow_tpu.spec): a
+    drafter proposes a token tree, one forward pass scores every node,
+    and the longest verified path commits — greedy output stays
+    token-identical to the non-speculative paged path while emitting up
+    to depth+1 tokens per step."""
+    if speculate is not None:
+        if not paged:
+            raise ValueError(
+                "speculative decoding rides the paged KV cache (rollback "
+                "is a position rewind, not a cache copy); pass paged=True")
+        from flexflow_tpu.spec.server import SpeculativePagedServer
+
+        return SpeculativePagedServer(
+            ff, speculate, slots=slots, max_len=max_len, eos_id=eos_id,
+            seed=seed, page_size=page_size, num_pages=num_pages,
+            preemption=preemption)
     if paged:
         from flexflow_tpu.paged.scheduler import PagedGenerationServer
 

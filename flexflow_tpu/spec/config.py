@@ -1,0 +1,76 @@
+"""Speculative decoding configuration.
+
+One SpecConfig describes the whole speculation policy: which drafter
+proposes tokens, and the token-tree shape (`width` distinct branches,
+each up to `depth` tokens deep) the verifier scores in one forward pass.
+The tree is padded to a FIXED node count (`max_nodes`) so the jitted
+verify step compiles once per server, exactly like the paged decode
+step compiles once for the (slots, max_pages) table shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class SpecConfig:
+    """Speculation policy for `serve_generation(paged=True, speculate=...)`.
+
+    drafter: "ngram" (prompt-lookup, zero extra weights), "model" (a
+      second compiled FFModel driven through its own Executor — set
+      `draft_model`), or a `flexflow_tpu.spec.drafter.Drafter` instance.
+    width: max distinct branches drafted per verify step (the token tree
+      branches at the root; chains sharing a prefix merge into a trie).
+    depth: max drafted tokens per branch — also the upper bound on
+      tokens ACCEPTED per step (plus one bonus token sampled from the
+      verifier's own logits, so every step emits >= 1 token).
+    min_ngram/max_ngram: prompt-lookup match lengths for the "ngram"
+      drafter (longest match wins; recency breaks ties).
+    """
+
+    drafter: object = "ngram"
+    width: int = 2
+    depth: int = 4
+    min_ngram: int = 1
+    max_ngram: int = 3
+    draft_model: Optional[object] = None
+
+    def __post_init__(self):
+        if self.width < 1:
+            raise ValueError(f"width must be >= 1, got {self.width}")
+        if self.depth < 1:
+            raise ValueError(f"depth must be >= 1, got {self.depth}")
+        if not (1 <= self.min_ngram <= self.max_ngram):
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{self.min_ngram}..{self.max_ngram}")
+
+    @property
+    def max_nodes(self) -> int:
+        """Fixed verify-step tree size: the root (the last sampled token,
+        whose K/V row is written by the verify step itself) plus up to
+        width x depth drafted nodes."""
+        return 1 + self.width * self.depth
+
+    def build_drafter(self):
+        from flexflow_tpu.spec.drafter import (
+            DraftModelDrafter,
+            Drafter,
+            NgramDrafter,
+        )
+
+        if isinstance(self.drafter, Drafter):
+            return self.drafter
+        if self.drafter == "ngram":
+            return NgramDrafter(min_n=self.min_ngram, max_n=self.max_ngram)
+        if self.drafter == "model":
+            if self.draft_model is None:
+                raise ValueError(
+                    'drafter="model" needs a compiled draft FFModel in '
+                    "SpecConfig.draft_model")
+            return DraftModelDrafter(self.draft_model)
+        raise ValueError(
+            f"unknown drafter {self.drafter!r} (want 'ngram', 'model', or "
+            "a Drafter instance)")

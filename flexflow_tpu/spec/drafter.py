@@ -1,0 +1,92 @@
+"""Pluggable token drafters behind one interface.
+
+A drafter proposes candidate continuations of a request's committed
+context; the tree-verify step then scores every proposal in ONE model
+forward and the scheduler keeps the longest verified path. Drafters run
+on the HOST between decode ticks — they never enter the jitted step, so
+a bad draft can cost throughput but never correctness.
+
+  NgramDrafter       prompt-lookup: zero extra weights, CPU-testable —
+                     the tier-1 drafter
+  DraftModelDrafter  a second (small) compiled FFModel driven through
+                     its own Executor's cached decode path
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+class Drafter:
+    """Interface: propose up to `width` candidate continuations (each at
+    most `depth` tokens) of `context` (the request's prompt + generated
+    tokens so far, INCLUDING the yet-unverified last sampled token)."""
+
+    def draft(self, context: np.ndarray, width: int,
+              depth: int) -> List[np.ndarray]:
+        raise NotImplementedError
+
+
+class NgramDrafter(Drafter):
+    """Prompt-lookup decoding: find earlier occurrences of the context's
+    trailing n-gram and propose what followed them. Longer matches are
+    tried first (they predict better); among equal-length matches the
+    most RECENT occurrence wins (repetitive generation cycles are caught
+    as soon as they repeat once). Branches are deduplicated by first
+    token, so the resulting token tree branches at the root."""
+
+    def __init__(self, min_n: int = 1, max_n: int = 3):
+        if not (1 <= min_n <= max_n):
+            raise ValueError(f"need 1 <= min_n <= max_n, got {min_n}..{max_n}")
+        self.min_n = int(min_n)
+        self.max_n = int(max_n)
+
+    def draft(self, context: np.ndarray, width: int,
+              depth: int) -> List[np.ndarray]:
+        ctx = np.asarray(context, np.int32).reshape(-1)
+        n = len(ctx)
+        chains: List[np.ndarray] = []
+        seen_first: set = set()
+        for ng in range(min(self.max_n, n - 1), self.min_n - 1, -1):
+            suffix = ctx[n - ng:]
+            # vectorized match scan (this runs per live slot per decode
+            # tick — a Python loop over positions would grow with context
+            # length inside the serving hot path): windows[i] == ctx[i:i+ng]
+            windows = np.lib.stride_tricks.sliding_window_view(ctx, ng)
+            hits = np.nonzero((windows[:n - ng] == suffix).all(axis=1))[0]
+            for i in hits[::-1]:  # most recent match first
+                cont = ctx[i + ng:i + ng + depth]
+                if len(cont) == 0:
+                    continue
+                first = int(cont[0])
+                if first in seen_first:
+                    continue
+                seen_first.add(first)
+                chains.append(np.asarray(cont, np.int32))
+                if len(chains) >= width:
+                    return chains
+        return chains
+
+
+class DraftModelDrafter(Drafter):
+    """Small-draft-model speculation: greedy-decode `depth` tokens from a
+    SECOND compiled FFModel (its own Executor, its own KV caches). One
+    chain per step — model drafters express confidence through depth, not
+    branching. The draft model's decode recompiles per bucketed context
+    length, so this drafter is for real accelerators (tests mark it
+    `slow`); the scheduler only sees the Drafter interface either way."""
+
+    def __init__(self, draft_ff):
+        if getattr(draft_ff, "executor", None) is None:
+            raise ValueError(
+                "DraftModelDrafter needs a COMPILED FFModel (call "
+                ".compile() on the draft model first)")
+        self.ff = draft_ff
+
+    def draft(self, context: np.ndarray, width: int,
+              depth: int) -> List[np.ndarray]:
+        ctx = np.asarray(context, np.int32).reshape(1, -1)
+        out = self.ff.generate(ctx, max_new_tokens=depth)
+        return [np.asarray(out[0], np.int32)]

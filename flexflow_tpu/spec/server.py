@@ -1,0 +1,222 @@
+"""Speculative continuous batching over the paged KV cache.
+
+Each decode tick becomes a TREE-VERIFY step: a host-side drafter proposes
+a token tree per live slot (flexflow_tpu.spec.drafter), one jitted
+forward scores every node under the tree-attention mask
+(Executor.verify_fn), and a greedy host-side walk accepts the longest
+verified path. Rollback is nearly free on the paged cache: the accepted
+path's K/V rows are copied onto the contiguous committed positions
+(Executor.paged_commit_fn — one fixed-shape gather/scatter), `pos`
+advances by the tokens emitted, and every rejected row simply sits past
+the new write head where the absolute-position mask already hides it.
+No page is copied, no cache is rebuilt.
+
+Tick flow (vs the base scheduler's one-token step):
+  1. admit (base policy, but the page gate also covers the tree width)
+  2. grow pages to cover pos + max_nodes rows (tree scratch included)
+  3. draft: trailing-context trees per live slot, padded to max_nodes
+  4. ONE verify step for the whole slot pool
+  5. accept: greedy argmax walk per slot; temperature>0 slots take only
+     the root's sample (exactness under sampling needs rejection
+     sampling — not implemented), so they decode at 1 token/step
+  6. commit accepted rows, advance pos, append tokens, finish/free
+
+Greedy output is token-identical to the non-speculative paged path by
+construction: every emitted token is the model's argmax continuation of
+its own committed prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from flexflow_tpu.paged.scheduler import PagedGenerationServer
+from flexflow_tpu.serving import _GenRequest
+from flexflow_tpu.spec.config import SpecConfig
+
+
+class SpeculativePagedServer(PagedGenerationServer):
+    """PagedGenerationServer whose decode tick verifies a drafted token
+    tree (serve_generation(paged=True, speculate=SpecConfig(...))). Same
+    public surface, admission, preemption, and defrag as the paged
+    server; only the tick body and the page-budget accounting change."""
+
+    def __init__(self, ff, spec: SpecConfig, slots: int = 4,
+                 max_len: int = 512, eos_id: Optional[int] = None,
+                 seed: int = 0, page_size: int = 64,
+                 num_pages: Optional[int] = None, preemption: bool = True):
+        if not isinstance(spec, SpecConfig):
+            raise TypeError(
+                f"speculate must be a SpecConfig, got {type(spec).__name__}")
+        self.spec = spec
+        self.drafter = spec.build_drafter()
+        ex = ff.executor
+        self._verify = ex.verify_fn()
+        self._commit = ex.paged_commit_fn()
+        self.spec_steps = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
+        # the page tables must address max_len + max_nodes rows: a verify
+        # at pos close to max_len writes its tree past the committed head
+        super().__init__(ff, slots=slots, max_len=max_len, eos_id=eos_id,
+                         seed=seed, page_size=page_size,
+                         num_pages=num_pages, preemption=preemption,
+                         table_slack_tokens=spec.max_nodes)
+
+    # -- page accounting: the tree's scratch rows count --------------------
+
+    def _table_rows(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+    def _peak_rows(self, prompt_len: int, max_new_tokens: int) -> int:
+        # deepest verify runs at pos <= prompt+max_new-1 and touches
+        # max_nodes rows beyond it
+        return min(prompt_len + max_new_tokens - 1 + self.spec.max_nodes,
+                   self._table_rows())
+
+    def _admission_pages(self, req: _GenRequest) -> int:
+        # admit only when prompt + first verify tree fit, so admission
+        # cannot preempt on its very first tick
+        return self.pool.pages_for(
+            min(len(req.seq_tokens()) + self.spec.max_nodes,
+                self._table_rows()))
+
+    def _pages_target(self, req: _GenRequest) -> int:
+        return min(self.pool.pages_for(req.pos + self.spec.max_nodes),
+                   self.max_pages_per_seq)
+
+    # -- metrics -----------------------------------------------------------
+
+    def metrics(self) -> dict:
+        m = super().metrics()
+        m["speculative"] = {
+            "steps": self.spec_steps,
+            "draft_tokens": self.spec_drafted,
+            "accepted_tokens": self.spec_accepted,
+            "emitted_tokens": self.spec_emitted,
+            "acceptance_rate": (self.spec_accepted / self.spec_drafted
+                                if self.spec_drafted else 0.0),
+            "accepted_tokens_per_step": (self.spec_emitted / self.spec_steps
+                                         if self.spec_steps else 0.0),
+        }
+        return m
+
+    # -- the speculative tick ----------------------------------------------
+
+    def _loop_body(self, tr, ntr):
+        import jax
+        import jax.numpy as jnp
+
+        from flexflow_tpu.spec.tree import (
+            accept_greedy,
+            ancestor_masks,
+            build_tree,
+        )
+
+        T = self.spec.max_nodes
+        C = self.spec.depth + 1  # max rows committed per tick (path+bonus)
+        while not self._stop.is_set():
+            live = self._tick_prep()
+            if live is None:
+                continue
+            if all(self._active[s].temperature > 0.0 for s in live):
+                # nothing to speculate on: sampled requests take one
+                # token per step either way, so dispatch the plain
+                # single-token tick instead of a max_nodes-wide verify
+                self._decode_tick(live, tr, ntr)
+                continue
+
+            # draft: one padded tree per live slot (host-side; idle slots
+            # carry a root-only tree into the null page). temperature>0
+            # slots skip the drafter entirely — their accept path is the
+            # root's sample only, so drafts would be paid for and thrown
+            # away (and would dilute the acceptance metrics)
+            tokens = np.zeros((self.slots, T), np.int32)
+            parents = np.full((self.slots, T), -1, np.int32)
+            depths = np.zeros((self.slots, T), np.int32)
+            trees = {}
+            for s in live:
+                req = self._active[s]
+                if req.temperature > 0.0:
+                    chains = []
+                else:
+                    chains = self.drafter.draft(req.seq_tokens(),
+                                                self.spec.width,
+                                                self.spec.depth)
+                tree = build_tree(req.tokens[-1], chains, T,
+                                  max_depth=self.spec.depth)
+                trees[s] = tree
+                tokens[s] = tree.tokens
+                parents[s] = tree.parents
+                depths[s] = tree.depths
+                drafted = tree.n_nodes - 1
+                self.spec_drafted += drafted
+                req.spec_drafted += drafted
+            anc = ancestor_masks(parents)
+            pos = np.array([self._active[s].pos if self._active[s] else 0
+                            for s in range(self.slots)], np.int32)
+
+            probs, upd = self._verify(
+                tr, ntr, self._caches, jnp.asarray(self._tables),
+                jnp.asarray(pos), jnp.asarray(depths), jnp.asarray(anc),
+                jnp.asarray(tokens))
+            self._caches = upd
+
+            # accept: greedy argmax walk. Both reductions run ON DEVICE —
+            # per-node argmaxes for the walk and the root row's _pick for
+            # temperature>0 slots (one rng split per tick, same
+            # discipline as the non-speculative servers) — so only
+            # (slots, max_nodes) + (slots,) ints cross to the host, never
+            # the (slots, max_nodes, vocab) probs
+            temps = np.array(
+                [self._active[s].temperature if self._active[s] else 0.0
+                 for s in range(self.slots)], np.float32)
+            self._rng, sub = jax.random.split(self._rng)
+            preds = np.asarray(jnp.argmax(probs, axis=-1))  # (slots, T)
+            sampled = np.asarray(self._pick(probs[:, 0, :],
+                                            jnp.asarray(temps), sub))
+            plans = {}
+            for s in live:
+                req = self._active[s]
+                if req.temperature > 0.0:
+                    plans[s] = ([0], [], int(sampled[s]))
+                else:
+                    path, emitted = accept_greedy(trees[s], preds[s])
+                    plans[s] = (path, emitted[:-1], emitted[-1])
+            self._steps += 1
+            self.spec_steps += 1
+
+            # commit: accepted path rows -> contiguous committed rows
+            # (unused entries self-copy; built before tables mutate)
+            src = np.repeat(pos[:, None], C, axis=1)
+            dst = src.copy()
+            for s in live:
+                req = self._active[s]
+                path, verified, bonus = plans[s]
+                emitted = verified + [int(bonus)]
+                emitted = emitted[:req.max_new - len(req.tokens)]
+                if self.eos_id is not None and self.eos_id in emitted:
+                    emitted = emitted[:emitted.index(self.eos_id) + 1]
+                L = len(emitted)
+                # accepted = verified draft tokens actually EMITTED (the
+                # max_new/EOS cut above must not inflate acceptance)
+                accepted = min(len(verified), L)
+                self.spec_accepted += accepted
+                req.spec_accepted += accepted
+                src[s, :L] = req.pos + np.asarray(path[:L], np.int32)
+                dst[s, :L] = req.pos + np.arange(L, dtype=np.int32)
+                req.pos += L
+                req.tokens.extend(int(t) for t in emitted)
+                self._tokens[s] = emitted[-1]
+                req.spec_steps += 1
+                req.spec_emitted += L
+                self.spec_emitted += L
+            self._caches = self._commit(self._caches,
+                                        jnp.asarray(self._tables),
+                                        jnp.asarray(src),
+                                        jnp.asarray(dst))
+            for s in live:
+                self._finish_if_done(s)

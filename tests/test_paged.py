@@ -295,6 +295,54 @@ def test_defrag_compacts_pool_mid_stream():
     assert server.defrags >= 1
 
 
+def test_concurrent_submit_under_page_pressure():
+    """Multi-threaded submit() racing the scheduler loop while the pool
+    is tight enough to preempt: every caller gets the dense-identical
+    greedy answer, the metrics are consistent, and every page returns to
+    the pool. (The submit path is lock-guarded against stop(); this
+    exercises it against admission/preemption churn.)"""
+    import threading
+
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(7)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 6, 4, 7, 3, 5, 6, 4)]
+    want = [ff.generate(p[None, :], max_new_tokens=5)[0] for p in prompts]
+    server = ff.serve_generation(slots=3, max_len=16, paged=True,
+                                 page_size=4, num_pages=7)
+    got = [None] * len(prompts)
+    errs = []
+
+    def worker(idxs):
+        try:
+            for i in idxs:
+                fut = server.submit(prompts[i], max_new_tokens=5)
+                got[i] = fut.result(timeout=120)
+        except Exception as e:  # surfaced on the main thread below
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker,
+                                    args=([i, i + 4],))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=150)
+        stuck = [t for t in threads if t.is_alive()]
+        assert not stuck, f"{len(stuck)} worker threads hung (scheduler " \
+                          "deadlock?)"
+    finally:
+        server.stop()
+    assert not errs, errs
+    for i, (w, g) in enumerate(zip(want, got)):
+        np.testing.assert_array_equal(w, g, err_msg=f"request {i}")
+    m = server.metrics()
+    assert m["requests_served"] == len(prompts)
+    assert m["pages_in_use"] == 0
+    assert len(m["requests"]) == len(prompts)
+
+
 def test_paged_submit_contract():
     """Shared submit surface: bad requests rejected, page-capacity guard,
     submit after stop raises."""
