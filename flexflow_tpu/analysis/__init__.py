@@ -1,0 +1,160 @@
+"""fflint — pass-based static analysis for strategies, the sharding
+algebra, and the substitution corpus.
+
+Unity-style search is only trustworthy while its invariants hold; round-5
+review enforced them by human advisor (two cost-model/lowering pricing
+divergences shipped, 377/408 corpus rules silently inert with no tool to
+say why). This subsystem turns those recurring review findings into a CI
+gate. Three passes ship (registered like op lowerings, so future PRs add
+passes, not frameworks):
+
+  consistency — strategy/sharding algebra per node: degrees divide dims,
+      GQA head grouping, producer/consumer resharding, and the
+      cost-model-vs-lowering comm-spec cross-check (parallel.comm_spec).
+  rulesat     — per-rule static satisfiability of the substitution corpus
+      (fireable / inert-unsatisfiable / unreachable-on-baselines, with
+      reasons), cross-validated against search.soundness instantiation.
+  hostsync    — AST lint of runtime/serving/paged/spec for jit-boundary
+      hazards (.item() device syncs in decode loops, jnp ops in host-side
+      loops, shape-dependent branches in jitted fns).
+
+CLI: tools/fflint.py (--json, --strict, per-pass selection); tier-1 gates
+on zero strict findings via tests/test_analysis.py. See docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+# severity ladder: "error" always gates the CLI exit code; "warning"
+# gates only under --strict; "info" is observability and never gates
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer finding. `where` names the subject (node, rule, or
+    file:line) so every message is actionable without re-running."""
+
+    pass_name: str
+    severity: str
+    code: str
+    where: str
+    message: str
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class AnalysisContext:
+    """Inputs a pass may consume; passes skip checks whose inputs are
+    absent (e.g. rulesat without baseline graphs skips reachability)."""
+
+    # consistency inputs: a PCG + per-node ShardingView assignment on a
+    # mesh described by axis_sizes; cost_model enables the comm cross-check
+    graph: Optional[object] = None
+    strategy: Optional[Dict] = None
+    axis_sizes: Optional[Dict[str, int]] = None
+    cost_model: Optional[object] = None
+    # a label for findings ("llama_tp_dp", "import:strategy.json", ...)
+    subject: str = ""
+    # rulesat inputs
+    rules: Optional[List[Dict]] = None
+    baseline_graphs: Optional[List] = None  # [(config_name, Graph)]
+    coverage_snapshot: Optional[Dict] = None
+    # rulesat classification output ({rule_name: {...}}), filled by the pass
+    rule_classification: Optional[Dict] = None
+    # hostsync inputs: files or directories to scan
+    src_paths: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    stats: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+
+    def extend(self, findings: List[Finding]) -> None:
+        self.findings.extend(findings)
+
+    def by_severity(self, severity: str) -> List[Finding]:
+        return [f for f in self.findings if f.severity == severity]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return self.by_severity("error")
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return self.by_severity("warning")
+
+    def gating(self, strict: bool = False) -> List[Finding]:
+        """Findings that fail the run: errors always, warnings when
+        strict."""
+        out = list(self.errors)
+        if strict:
+            out += self.warnings
+        return out
+
+    def to_json(self) -> Dict:
+        counts = {s: len(self.by_severity(s)) for s in SEVERITIES}
+        return {
+            "findings": [f.to_json() for f in self.findings],
+            "counts": counts,
+            "stats": self.stats,
+        }
+
+
+# ---------------------------------------------------------------------------
+# pass registry (the register_lowering idiom: passes are registered by
+# name; adding a pass is one decorated function, not a framework change)
+
+_PASSES: Dict[str, Callable[[AnalysisContext], List[Finding]]] = {}
+
+
+def register_pass(name: str):
+    def deco(fn):
+        fn.pass_name = name
+        _PASSES[name] = fn
+        return fn
+
+    return deco
+
+
+def get_pass(name: str) -> Callable[[AnalysisContext], List[Finding]]:
+    _ensure_registered()
+    if name not in _PASSES:
+        raise KeyError(
+            f"no analysis pass named {name!r}; available: "
+            f"{sorted(_PASSES)}"
+        )
+    return _PASSES[name]
+
+
+def available_passes() -> List[str]:
+    _ensure_registered()
+    return sorted(_PASSES)
+
+
+def _ensure_registered() -> None:
+    # imports populate the registry on first use (registry.py idiom)
+    from flexflow_tpu.analysis import consistency, hostsync, rulesat  # noqa: F401
+
+
+def run_passes(names: Optional[List[str]], ctx: AnalysisContext,
+               report: Optional[Report] = None) -> Report:
+    """Run the named passes (all registered passes when None) over one
+    context, appending to `report` when given (the CLI runs consistency
+    once per BASELINE config into a single report)."""
+    _ensure_registered()
+    report = report or Report()
+    for name in names or available_passes():
+        fn = get_pass(name)
+        findings = fn(ctx)
+        report.extend(findings)
+        st = report.stats.setdefault(name, {"findings": 0, "subjects": []})
+        st["findings"] += len(findings)
+        if ctx.subject:
+            st["subjects"].append(ctx.subject)
+    return report

@@ -1,0 +1,152 @@
+"""BASELINE configs for the static analyzer (and coverage tooling).
+
+The single home of the config list that used to live in
+tools/rule_coverage.py: each entry is (name, build(ff), mesh_shape) for
+the BASELINE.md targets plus InceptionV3 (where the concat/merge algebra
+demonstrably fires) plus a seq-parallel llama variant that exercises the
+ring/ulysses comm-spec cross-check. `build_baseline_subjects()` builds
+the PCGs with their canonical hand strategies (default DP where no hand
+strategy exists) — the subjects `fflint --strict` must run clean on.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+def baseline_configs() -> List[Tuple[str, Callable, Dict[str, int]]]:
+    """(name, build(ff) -> None, mesh_shape) per BASELINE config plus
+    InceptionV3; small layer counts — coverage and consistency depend on
+    structure, not depth."""
+    from flexflow_tpu.models.alexnet import build_alexnet_cifar10
+    from flexflow_tpu.models.bert import BertConfig, build_bert
+    from flexflow_tpu.models.inception import build_inception_v3
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+    from flexflow_tpu.models.mixtral import MixtralConfig, build_mixtral
+    from flexflow_tpu.models.resnet import build_resnet50
+
+    def alexnet(ff):
+        build_alexnet_cifar10(ff, batch_size=8)
+
+    def resnet(ff):
+        build_resnet50(ff, batch_size=8, classes=100)
+
+    def bert(ff):
+        build_bert(ff, BertConfig(vocab_size=512, hidden=64, layers=2,
+                                  heads=4, intermediate=128),
+                   batch_size=8, seq_len=64)
+
+    def llama(ff):
+        build_llama(ff, LlamaConfig(vocab_size=512, dim=64, layers=2,
+                                    heads=4, kv_heads=2, hidden=128,
+                                    rope_theta=10000.0),
+                    batch_size=8, seq_len=128)
+
+    def mixtral(ff):
+        build_mixtral(ff, MixtralConfig.tiny(), batch_size=8, seq_len=32)
+
+    def inception(ff):
+        # 75px input keeps the tiny-config search fast; every inception
+        # block's concat-of-parallel-branches structure is preserved
+        build_inception_v3(ff, batch_size=8, classes=32, image_size=75)
+
+    return [
+        ("alexnet_cifar10", alexnet, {"data": 2, "model": 4}),
+        ("resnet50", resnet, {"data": 2, "model": 4}),
+        ("bert_base", bert, {"data": 2, "model": 4}),
+        ("llama_tp_dp", llama, {"data": 2, "seq": 2, "model": 2}),
+        ("mixtral_ep", mixtral, {"data": 2, "expert": 4}),
+        ("inception_v3", inception, {"data": 2, "model": 4}),
+    ]
+
+
+def _llama_tiny_cfg():
+    from flexflow_tpu.models.llama import LlamaConfig
+
+    return LlamaConfig(vocab_size=512, dim=64, layers=2, heads=4,
+                       kv_heads=2, hidden=128, rope_theta=10000.0)
+
+
+def build_graph(build: Callable, mesh_shape: Dict[str, int]):
+    """Build one config's PCG (no search, no compile, no mesh needed)."""
+    from flexflow_tpu import FFConfig, FFModel
+
+    ff = FFModel(FFConfig(batch_size=8, mesh_shape=dict(mesh_shape)))
+    build(ff)
+    ff.graph.infer_shapes()
+    return ff.graph
+
+
+def _hand_strategy(name: str) -> Optional[Dict]:
+    """The shipped hand strategy for a config (None = default DP)."""
+    if name == "bert_base":
+        from flexflow_tpu.models.bert import (
+            BertConfig,
+            bert_attribute_parallel_strategy,
+        )
+
+        return bert_attribute_parallel_strategy(
+            BertConfig(vocab_size=512, hidden=64, layers=2, heads=4,
+                       intermediate=128))
+    if name == "llama_tp_dp":
+        from flexflow_tpu.models.llama import llama_tp_strategy
+
+        return llama_tp_strategy(_llama_tiny_cfg())
+    if name == "mixtral_ep":
+        from flexflow_tpu.models.mixtral import (
+            MixtralConfig,
+            mixtral_ep_strategy,
+        )
+
+        return mixtral_ep_strategy(MixtralConfig.tiny())
+    return None
+
+
+SP_SUBJECT_NAMES = ("llama_sp_ring", "llama_sp_ulysses")
+
+
+def known_subject_names() -> List[str]:
+    return [name for name, _, _ in baseline_configs()] + list(SP_SUBJECT_NAMES)
+
+
+def build_baseline_subjects(names: Optional[List[str]] = None):
+    """[(name, graph, strategy, axis_sizes)] for the consistency pass:
+    every BASELINE config under its canonical strategy (hand strategy
+    where one ships, default DP otherwise), plus `llama_sp_ring` /
+    `llama_sp_ulysses` — seq-parallel ring-attention builds whose views
+    must agree with the exchange the lowering emits."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.llama import build_llama, llama_tp_strategy
+    from flexflow_tpu.search.api import space_dp_strategy
+
+    if names:
+        unknown = sorted(set(names) - set(known_subject_names()))
+        if unknown:
+            # a typo must not silently validate NOTHING and report clean
+            raise ValueError(
+                f"unknown BASELINE config name(s) {unknown}; known: "
+                f"{known_subject_names()}")
+    subjects = []
+    for name, build, mesh_shape in baseline_configs():
+        if names and name not in names:
+            continue
+        graph = build_graph(build, mesh_shape)
+        strategy = _hand_strategy(name)
+        if strategy is None:
+            strategy = space_dp_strategy(graph, mesh_shape)
+        subjects.append((name, graph, strategy, dict(mesh_shape)))
+
+    sp_mesh = {"data": 2, "seq": 2, "model": 2}
+    for sp_name, seq_mode in (("llama_sp_ring", "ring"),
+                              ("llama_sp_ulysses", "ulysses")):
+        if names and sp_name not in names:
+            continue
+        ff = FFModel(FFConfig(batch_size=8, mesh_shape=dict(sp_mesh)))
+        build_llama(ff, _llama_tiny_cfg(), batch_size=8, seq_len=128,
+                    use_ring_attention=True, seq_mode=seq_mode)
+        ff.graph.infer_shapes()
+        subjects.append((sp_name, ff.graph,
+                         llama_tp_strategy(_llama_tiny_cfg(),
+                                           seq_parallel=True),
+                         dict(sp_mesh)))
+    return subjects

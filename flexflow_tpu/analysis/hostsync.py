@@ -1,0 +1,224 @@
+"""Retrace/host-sync lint — an AST pass over the serving/runtime hot
+paths (`runtime/`, `serving.py`, `paged/`, `spec/`).
+
+Flags jit-boundary hazards in DIRECT function bodies (v1 is deliberately
+non-transitive — it reads each function's own AST, not its callees):
+
+  item-sync-in-loop   (error)   `.item()` inside a loop: a per-element
+      device sync in a decode hot loop serializes the pipeline; pull the
+      whole batch once with np.asarray outside the per-token loop.
+  jnp-in-host-loop    (warning) `jnp.*`/`jax.numpy.*` calls inside a
+      loop of a NON-jitted function: each call dispatches to the device
+      from host code — per-token loops pay a dispatch per step.
+  asarray-in-loop     (info)    `np.asarray`/`np.array`/`jax.device_get`
+      inside a loop: a bulk sync per iteration — fine once per decode
+      tick, a hazard per token (observability; judge by loop granularity).
+  shape-branch-in-jit (warning) an `if`/`while` on `.shape`/`.ndim`
+      inside a jit-wrapped function: shapes are trace-time constants, so
+      the branch recompiles per shape class (fine for deliberate kernel
+      selection, a retrace storm when shapes vary per request).
+
+Suppression: any flagged line (or its enclosing loop header) carrying a
+`# fflint: host-ok` / `# fflint: ignore` comment is skipped — intentional
+per-tick syncs are annotated, not silenced globally.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from flexflow_tpu.analysis import AnalysisContext, Finding, register_pass
+
+DEFAULT_ROOTS = ("runtime", "serving.py", "paged", "spec")
+
+_SYNC_CALLS = {("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
+               ("numpy", "array"), ("jax", "device_get")}
+_DEVICE_MODULES = {"jnp", "lax"}
+
+
+def default_src_paths() -> List[str]:
+    base = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [os.path.join(base, p) for p in DEFAULT_ROOTS]
+
+
+def _dotted(node: ast.AST) -> Optional[tuple]:
+    """('np', 'asarray') for np.asarray, ('jnp', 'sum') for jnp.sum."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _jitted_names(tree: ast.Module) -> Set[str]:
+    """Function names wrapped by jax.jit in this module: decorated
+    defs and `jax.jit(step)` call sites naming a local function."""
+    jitted: Set[str] = set()
+
+    def is_jit(expr: ast.AST) -> bool:
+        d = _dotted(expr)
+        if d and d[-1] == "jit":
+            return True
+        if isinstance(expr, ast.Call):
+            # partial(jax.jit, ...) / jax.jit(fn, static_argnums=...)
+            if is_jit(expr.func):
+                return True
+            return any(is_jit(a) for a in expr.args)
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(is_jit(dec) for dec in node.decorator_list):
+                jitted.add(node.name)
+        elif isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d and d[-1] == "jit" and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                jitted.add(node.args[0].id)
+    return jitted
+
+
+def _suppressed(lines: List[str], *linenos: int) -> bool:
+    for ln in linenos:
+        if 1 <= ln <= len(lines):
+            txt = lines[ln - 1]
+            if "fflint:" not in txt:
+                continue
+            # only the exact directives suppress — a comment like
+            # '# fflint: broken, fix this' must NOT count
+            directive = txt.split("fflint:", 1)[1].strip()
+            if directive.startswith("host-ok") or \
+                    directive.startswith("ignore"):
+                return True
+    return False
+
+
+class _FnScanner(ast.NodeVisitor):
+    """Scan ONE function body (nested defs get their own scanner)."""
+
+    def __init__(self, findings, rel, lines, fn_name, jitted):
+        self.findings = findings
+        self.rel = rel
+        self.lines = lines
+        self.fn_name = fn_name
+        self.jitted = fn_name in jitted
+        self.loop_stack: List[int] = []  # header linenos
+
+    def _add(self, severity, code, lineno, msg):
+        if _suppressed(self.lines, lineno, *self.loop_stack):
+            return
+        self.findings.append(Finding(
+            "hostsync", severity, code, f"{self.rel}:{lineno}",
+            f"in {self.fn_name}(): {msg}"))
+
+    # nested function definitions are separate scopes — do not inherit
+    # the enclosing loop stack (a closure defined in a loop runs later)
+    def visit_FunctionDef(self, node):
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _loop(self, node):
+        self.loop_stack.append(node.lineno)
+        self.generic_visit(node)
+        self.loop_stack.pop()
+
+    visit_For = visit_While = _loop
+
+    def _test_touches_shape(self, test: ast.AST) -> bool:
+        return any(isinstance(n, ast.Attribute)
+                   and n.attr in ("shape", "ndim")
+                   for n in ast.walk(test))
+
+    def visit_If(self, node):
+        if self.jitted and self._test_touches_shape(node.test):
+            self._add(
+                "warning", "shape-branch-in-jit", node.lineno,
+                "branch on .shape/.ndim inside a jitted function — the "
+                "branch re-traces per shape class; hoist the decision "
+                "out of the jitted fn or make it a static_argnum")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self.jitted and self._test_touches_shape(node.test):
+            self._add(
+                "warning", "shape-branch-in-jit", node.lineno,
+                "while on .shape/.ndim inside a jitted function")
+        self._loop(node)
+
+    def visit_Call(self, node):
+        in_loop = bool(self.loop_stack)
+        d = _dotted(node.func)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args and in_loop:
+            self._add(
+                "error", "item-sync-in-loop", node.lineno,
+                ".item() inside a loop is a per-element device sync — in "
+                "a decode hot loop it serializes host and device every "
+                "token; read the whole batch once with np.asarray "
+                "outside the loop (annotate '# fflint: host-ok' if this "
+                "loop is genuinely not per-token)")
+        elif d and in_loop and not self.jitted:
+            if d[:2] in _SYNC_CALLS:
+                self._add(
+                    "info", "asarray-in-loop", node.lineno,
+                    f"{'.'.join(d)} inside a loop — one bulk device sync "
+                    "per iteration (fine per decode tick, a hazard per "
+                    "token)")
+            elif d[0] in _DEVICE_MODULES or d[:2] == ("jax", "numpy"):
+                self._add(
+                    "warning", "jnp-in-host-loop", node.lineno,
+                    f"{'.'.join(d)} inside a host-side loop dispatches "
+                    "to the device each iteration — batch it, move the "
+                    "loop into jit/scan, or annotate '# fflint: host-ok' "
+                    "for a deliberate per-tick transfer")
+        self.generic_visit(node)
+
+
+def scan_file(path: str, rel: Optional[str] = None) -> List[Finding]:
+    rel = rel or os.path.basename(path)
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("hostsync", "error", "syntax-error",
+                        f"{rel}:{e.lineno}", str(e))]
+    lines = src.splitlines()
+    jitted = _jitted_names(tree)
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scanner = _FnScanner(findings, rel, lines, node.name, jitted)
+            for child in node.body:
+                scanner.visit(child)
+    findings.sort(key=lambda f: f.where)
+    return findings
+
+
+def scan_paths(paths: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _dirs, files in os.walk(p):
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        full = os.path.join(dirpath, fn)
+                        rel = os.path.relpath(
+                            full, os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))))
+                        findings += scan_file(full, rel)
+        elif os.path.exists(p):
+            findings += scan_file(p, os.path.basename(p))
+    return findings
+
+
+@register_pass("hostsync")
+def hostsync_pass(ctx: AnalysisContext) -> List[Finding]:
+    paths = ctx.src_paths if ctx.src_paths is not None else default_src_paths()
+    return scan_paths(paths)

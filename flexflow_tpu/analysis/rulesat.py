@@ -1,0 +1,319 @@
+"""Rule-corpus satisfiability pass.
+
+For each rule in the substitution corpus, statically classify its
+`when`/`where` guards against the op-type alphabet and attr domains, then
+confirm with a dynamic witness (search.soundness.instantiate_rule — the
+same instantiation the soundness suite uses, so statically-fireable ⊇
+instantiable holds by construction):
+
+  fireable             — a concrete matching graph exists (witness found)
+  inert_unsatisfiable  — guards can never hold (unknown predicate,
+                         attr_eq on a nonexistent field, unknown unary
+                         kind, unknown mesh axis, ...) or no instantiation
+                         profile realizes the pattern; per-rule reasons
+                         are recorded
+
+Fireable rules are additionally classified for reachability on the
+BASELINE configs (direct pattern match on the built PCGs, unioned with
+the committed coverage snapshot's observed fires): a fireable rule that
+matches no baseline structure is `unreachable_on_baselines` — inert in
+practice, but not a defect (info, not error).
+
+This pass subsumes the counting logic that lived in
+tools/rule_coverage.py; the classification is written into
+docs/rule_coverage.json next to the search-measured fires/profit data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from flexflow_tpu.analysis import AnalysisContext, Finding, register_pass
+
+# mesh-axis vocabulary the repo's meshes can carry (make_mesh callers);
+# a requires_axis outside it gates the rule off every buildable mesh
+KNOWN_AXES = ("data", "data_sub", "model", "seq", "expert", "pipe")
+
+# unary kinds with a registered lowering (ops/jax_ops._element_unary);
+# a unary_kind guard outside this set matches no executable node
+UNARY_KINDS = frozenset({
+    "exp", "sin", "cos", "relu", "gelu", "sigmoid", "tanh", "elu",
+    "rsqrt", "silu", "identity", "pow", "scalar_add", "scalar_sub",
+    "scalar_multiply", "scalar_truediv",
+})
+
+
+def _attr_fields(cls) -> frozenset:
+    """Valid attribute names of an attrs class: dataclass fields plus
+    properties (kdim/num_kv are properties)."""
+    names = set()
+    if dataclasses.is_dataclass(cls):
+        names |= {f.name for f in dataclasses.fields(cls)}
+    for k in dir(cls):
+        if not k.startswith("_") and isinstance(getattr(cls, k), property):
+            names.add(k)
+    return frozenset(names)
+
+
+def _attrs_class(op_name: str):
+    from flexflow_tpu.ffconst import OpType
+    from flexflow_tpu.ops import attrs as A
+    from flexflow_tpu.search.xfer_engine import ATTRS_CLASSES
+
+    # ops the engine can match but whose attrs class is not in the
+    # rewrite-side registry
+    extra = {
+        OpType.RING_ATTENTION: A.RingAttentionAttrs,
+        OpType.GATHER: A.GatherAttrs,
+        OpType.TOPK: A.TopKAttrs,
+    }
+    try:
+        op = OpType[op_name]
+    except KeyError:
+        return None
+    return ATTRS_CLASSES.get(op) or extra.get(op)
+
+
+def _static_issues(rule: Dict):
+    """Guard conditions that can never hold, split into
+    (matcher_issues, domain_issues):
+
+    - matcher issues make find_matches reject every candidate (unknown
+      predicate, attr_eq on a nonexistent field) — a dynamic witness
+      contradicting one is a bug in THIS analyzer;
+    - domain issues admit a synthetic match the instantiation harness
+      can build but no EXECUTABLE graph can carry (a unary kind with no
+      registered lowering, an unknown activation, a mesh axis no config
+      builds) — authoritative even when a synthetic witness matches.
+    """
+    from flexflow_tpu.ffconst import ActiMode, OpType
+    from flexflow_tpu.search.xfer_engine import (
+        NODE_PREDICATES,
+        WHERE_PREDICATES,
+    )
+
+    matcher: List[str] = []
+    domain: List[str] = []
+    ax = rule.get("requires_axis")
+    if ax and ax not in KNOWN_AXES:
+        domain.append(
+            f"requires_axis={ax!r} is not a mesh axis any config builds "
+            f"({', '.join(KNOWN_AXES)})")
+    for spec in rule.get("src", {}).get("nodes", ()):
+        nid = spec.get("id", "?")
+        op_name = spec.get("type")
+        if op_name:
+            try:
+                OpType[op_name]
+            except KeyError:
+                matcher.append(f"src node {nid!r}: unknown op type "
+                               f"{op_name!r}")
+                continue
+        cls = _attrs_class(op_name) if op_name else None
+        fields = _attr_fields(cls) if cls is not None else None
+        for pname, parg in (spec.get("when") or {}).items():
+            if pname not in NODE_PREDICATES:
+                matcher.append(
+                    f"src node {nid!r}: unknown predicate {pname!r} "
+                    "(matcher rejects every candidate)")
+                continue
+            if pname == "attr_eq" and fields is not None:
+                if (not isinstance(parg, (list, tuple)) or not parg
+                        or not all(
+                            isinstance(p, (list, tuple)) and len(p) == 2
+                            for p in (parg
+                                      if isinstance(parg[0], (list, tuple))
+                                      else [parg]))):
+                    matcher.append(
+                        f"src node {nid!r}: malformed attr_eq argument "
+                        f"{parg!r} (want [field, value] or a list of "
+                        "such pairs)")
+                    continue
+                pairs = parg if isinstance(parg[0], (list, tuple)) \
+                    else [parg]
+                for f, v in pairs:
+                    if f not in fields and v is not None:
+                        matcher.append(
+                            f"src node {nid!r}: attr_eq on field {f!r} "
+                            f"which {cls.__name__} does not define")
+            elif pname == "unary_kind":
+                bad = [k for k in parg if k not in UNARY_KINDS]
+                if bad:
+                    domain.append(
+                        f"src node {nid!r}: unary_kind {bad} has no "
+                        "registered lowering — no executable node "
+                        "carries it")
+            elif pname in ("activation", "activation_in"):
+                names = [parg] if isinstance(parg, str) else list(parg)
+                bad = [n for n in names if n not in ActiMode.__members__]
+                if bad:
+                    domain.append(
+                        f"src node {nid!r}: unknown activation {bad}")
+    for w in rule.get("where", ()):
+        if w.get("kind") not in WHERE_PREDICATES:
+            matcher.append(
+                f"unknown where predicate {w.get('kind')!r} "
+                "(match check always fails)")
+    return matcher, domain
+
+
+def _dst_issues(rule: Dict) -> List[str]:
+    """Rewrite-side hygiene: a dst node with literal attrs must have a
+    registered attrs class, else apply_match raises mid-search."""
+    from flexflow_tpu.ffconst import OpType
+    from flexflow_tpu.search.xfer_engine import ATTRS_CLASSES
+
+    out = []
+    for spec in rule.get("dst", {}).get("nodes", ()):
+        attrs = spec.get("attrs")
+        if attrs is None or (isinstance(attrs, dict) and "$copy" in attrs):
+            continue
+        try:
+            op = OpType[spec["type"]]
+        except KeyError:
+            out.append(f"dst node {spec.get('id')!r}: unknown op type "
+                       f"{spec.get('type')!r}")
+            continue
+        if op not in ATTRS_CLASSES:
+            out.append(
+                f"dst node {spec.get('id')!r}: no attrs class registered "
+                f"for {op.name} — apply_match would raise at rewrite time")
+    return out
+
+
+def _witness(rule: Dict) -> Optional[int]:
+    """Smallest instantiation profile whose concrete graph the rule
+    matches (the soundness suite's instantiation, minus the numeric
+    replay), or None."""
+    from flexflow_tpu.search.soundness import instantiate_rule
+    from flexflow_tpu.search.xfer_engine import find_matches
+
+    for nd in (2, 3, 4):
+        try:
+            inst = instantiate_rule(rule, profile_nd=nd)
+            # find_matches inside the try too: a malformed guard can
+            # crash a predicate (the analyzer must classify such a rule
+            # inert, not die on it)
+            if inst is not None and find_matches(rule, inst[0]):
+                return nd
+        except Exception:
+            continue
+    return None
+
+
+def classify_rule(rule: Dict) -> Dict:
+    """Classification record for one rule (no baseline reachability —
+    that needs the built graphs, see classify_corpus)."""
+    matcher, domain = _static_issues(rule)
+    dst = _dst_issues(rule)
+    rec: Dict = {"requires_axis": rule.get("requires_axis")}
+    if domain:
+        # a guard over values outside the executable domain can still be
+        # matched by a synthetic instantiation — the domain issue wins
+        rec["status"] = "inert_unsatisfiable"
+        rec["reasons"] = domain + matcher
+    else:
+        nd = _witness(rule)
+        if nd is not None:
+            rec["status"] = "fireable"
+            rec["witness_profile_nd"] = nd
+            if matcher:
+                # dynamic witness is authoritative for matcher-level
+                # claims; a contradiction means THIS analyzer is wrong
+                # about a guard — surface it
+                rec["static_dynamic_disagreement"] = matcher
+        else:
+            rec["status"] = "inert_unsatisfiable"
+            rec["reasons"] = matcher or [
+                "no instantiation profile (2d/3d/4d) realizes a matching "
+                "graph for the src pattern under its when/where guards"
+            ]
+    if dst:
+        rec["dst_issues"] = dst
+    return rec
+
+
+def classify_corpus(rules: List[Dict],
+                    baseline_graphs=None,
+                    coverage_snapshot: Optional[Dict] = None) -> Dict[str, Dict]:
+    """{rule_name: classification}. With `baseline_graphs`
+    ([(config_name, Graph)]) fireable rules get `baseline_reach`:
+    "fires_on_baselines" when the pattern matches a built BASELINE PCG
+    directly or the committed coverage snapshot recorded a fire during
+    search (rewritten intermediate graphs can expose structure the
+    initial graph lacks), else "unreachable_on_baselines"."""
+    from flexflow_tpu.search.xfer_engine import find_matches
+
+    snapshot_fired = set()
+    for fires in (coverage_snapshot or {}).get("fires_by_config",
+                                               {}).values():
+        snapshot_fired |= set(fires)
+
+    out: Dict[str, Dict] = {}
+    for rule in rules:
+        rec = classify_rule(rule)
+        if rec["status"] == "fireable" and baseline_graphs is not None:
+            matched = []
+            for cfg_name, g in baseline_graphs:
+                try:
+                    if find_matches(rule, g):
+                        matched.append(cfg_name)
+                except Exception:
+                    pass
+            rec["matched_baseline_configs"] = matched
+            rec["snapshot_fired"] = rule["name"] in snapshot_fired
+            rec["baseline_reach"] = (
+                "fires_on_baselines"
+                if matched or rec["snapshot_fired"]
+                else "unreachable_on_baselines")
+        out[rule["name"]] = rec
+    return out
+
+
+def classification_counts(classification: Dict[str, Dict]) -> Dict[str, int]:
+    """Histogram of a classify_corpus result by effective status
+    (baseline_reach when present, else status) — the single accounting
+    used by the fflint CLI, --write-coverage, and tools/rule_coverage.py."""
+    counts: Dict[str, int] = {}
+    for rec in classification.values():
+        key = rec.get("baseline_reach") or rec["status"]
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+@register_pass("rulesat")
+def rulesat_pass(ctx: AnalysisContext) -> List[Finding]:
+    if ctx.rules is None:
+        return []
+    cls = classify_corpus(ctx.rules, baseline_graphs=ctx.baseline_graphs,
+                          coverage_snapshot=ctx.coverage_snapshot)
+    ctx.rule_classification = cls
+    findings: List[Finding] = []
+    unreachable = []
+    for name, rec in cls.items():
+        if rec["status"] == "inert_unsatisfiable":
+            findings.append(Finding(
+                "rulesat", "error", "rule-unsatisfiable", name,
+                "rule can never fire: " + "; ".join(rec["reasons"])))
+        if rec.get("dst_issues"):
+            findings.append(Finding(
+                "rulesat", "error", "rule-dst-unbuildable", name,
+                "; ".join(rec["dst_issues"])))
+        if rec.get("static_dynamic_disagreement"):
+            findings.append(Finding(
+                "rulesat", "warning", "static-dynamic-disagreement", name,
+                "static guard analysis deems the rule unsatisfiable but a "
+                "concrete witness matches — the static rules here need "
+                "fixing: " + "; ".join(rec["static_dynamic_disagreement"])))
+        if rec.get("baseline_reach") == "unreachable_on_baselines":
+            unreachable.append(name)
+    if unreachable:
+        findings.append(Finding(
+            "rulesat", "info", "rules-unreachable-on-baselines",
+            "corpus",
+            f"{len(unreachable)}/{len(cls)} fireable rules match no "
+            "BASELINE config structure (directly or in the recorded "
+            "search fires) — sound but inert in practice; per-rule "
+            "records in the classification output"))
+    return findings

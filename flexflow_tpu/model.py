@@ -592,6 +592,38 @@ class FFModel:
                 strategy = {
                     k: view_from_json(v) for k, v in _json.load(f).items()
                 }
+            # fail fast on corrupt/stale files with a named-node
+            # diagnostic instead of a cryptic lowering error: the fflint
+            # consistency pass checks the sharding algebra (degrees
+            # divide dims, GQA grouping, no duplicate axes) against THIS
+            # graph and mesh
+            import os as _os
+
+            from flexflow_tpu.analysis.consistency import check_strategy
+
+            findings = check_strategy(
+                self.graph, strategy, mesh_axes,
+                subject=_os.path.basename(cfg.import_strategy_file),
+            )
+            errors = [f for f in findings if f.severity == "error"]
+            if errors:
+                detail = "\n".join(
+                    f"  [{f.code}] {f.where}: {f.message}" for f in errors
+                )
+                raise ValueError(
+                    f"imported strategy file {cfg.import_strategy_file} "
+                    f"is inconsistent with this graph/mesh "
+                    f"({len(errors)} error(s)):\n{detail}"
+                )
+            warnings_ = [f for f in findings if f.severity == "warning"]
+            if warnings_:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "imported strategy %s: %s",
+                    cfg.import_strategy_file,
+                    "; ".join(f.message for f in warnings_),
+                )
         search_candidates: List = []
         self.search_stats = {}
         if strategy is None and not cfg.only_data_parallel and cfg.search_budget > 0:

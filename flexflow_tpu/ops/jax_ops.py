@@ -217,7 +217,9 @@ def _sharded_flash(q, k, v, mesh, causal, scale, interpret=False):
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     b_ax = "data" if sizes.get("data", 1) > 1 and B % sizes["data"] == 0 else None
     h_ax = "model" if sizes.get("model", 1) > 1 and H % sizes["model"] == 0 else None
-    if h_ax is not None and Hkv % sizes["model"] != 0:
+    from flexflow_tpu.parallel.comm_spec import flash_repeats_kv
+
+    if flash_repeats_kv(H, Hkv, sizes.get("model", 1)):
         from flexflow_tpu.parallel.ring import repeat_kv
 
         k, v = repeat_kv(k, v, H // Hkv)

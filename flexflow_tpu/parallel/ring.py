@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 
 
 from flexflow_tpu.parallel.compat import shard_map as _shard_map
+from flexflow_tpu.parallel.comm_spec import ring_repeats_kv, ulysses_plan
 
 
 def _mesh_axis_size(mesh, name: str) -> int:
@@ -117,8 +118,9 @@ def ring_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
     ha = head_axis if _mesh_axis_size(mesh, head_axis) > 1 else None
     # kv arrives UNREPEATED (GQA): head-TP sharding needs the kv head dim
     # divisible too, else repeat up front and lose the hop saving
+    # (decision shared with the cost model via parallel.comm_spec)
     h_deg = _mesh_axis_size(mesh, head_axis)
-    if ha is not None and k.shape[2] % h_deg != 0:
+    if ring_repeats_kv(q.shape[2], k.shape[2], h_deg):
         k, v = repeat_kv(k, v, q.shape[2] // k.shape[2])
     spec = P(ba, seq_axis, ha, None)
 
@@ -175,30 +177,22 @@ def ulysses_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
     H = q.shape[2]
     Hkv = k.shape[2]
     h_deg = _mesh_axis_size(mesh, head_axis)
-    # the all_to_all splits each shard's LOCAL heads (H / head_degree) n
-    # ways — check divisibility at that granularity, not globally
-    local_heads = H // h_deg if H % h_deg == 0 else H
-    if local_heads % n != 0:
+    # Exchange-shape decisions (local-head divisibility, GQA repeat —
+    # including the ADVICE-r5 rule that Hkv is divided by h_deg only under
+    # real head-TP) live in parallel.comm_spec.ulysses_plan, shared with
+    # the cost model's pricing so the two sides cannot drift.
+    plan = ulysses_plan(H, Hkv, h_deg, n)
+    if plan.fallback_to_ring:
         return ring_dot_product_attention(
             q, k, v, mesh=mesh, causal=causal, scale=scale,
             seq_axis=seq_axis, batch_axis=batch_axis, head_axis=head_axis,
         )
-    # GQA kv can ride the exchange unrepeated only if ITS head count
-    # divides the head-TP degree AND its local heads split n ways;
-    # otherwise repeat up front. Divide Hkv by h_deg only when the head
-    # dim is actually TP-sharded (`ha is not None` below): with heads
-    # unsharded every device holds ALL Hkv heads, and dividing anyway
-    # made local_kv % n fail spuriously — forcing an unnecessary kv
-    # repeat that the exchange then paid for (ADVICE r5).
-    head_tp = h_deg > 1 and H % h_deg == 0
-    kv_tp_ok = Hkv % h_deg == 0 if head_tp else True
-    local_kv = Hkv // h_deg if head_tp and Hkv % h_deg == 0 else Hkv
-    if Hkv != H and (local_kv % n != 0 or not kv_tp_ok):
+    if plan.repeat_kv:
         k, v = repeat_kv(k, v, H // Hkv)
     jax_ops.LAST_ATTENTION_KERNEL = "ulysses_all_to_all"
 
     ba = batch_axis if _mesh_axis_size(mesh, batch_axis) > 1 else None
-    ha = head_axis if head_tp else None
+    ha = head_axis if plan.head_tp else None
     spec = P(ba, seq_axis, ha, None)
 
     def fn(ql, kl, vl):

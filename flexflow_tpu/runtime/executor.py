@@ -647,7 +647,7 @@ class Executor:
         composites get layer-stacked (L, b, maxlen, kv, hd) buffers
         threaded through their layer scan."""
         caches = {}
-        for n in self.topo:
+        for n in self.topo:  # fflint: host-ok (one-time cache init)
             ins = self.graph.input_shapes(n)
             dt = dtype
             if dt is None:
@@ -680,7 +680,7 @@ class Executor:
         composites keep their layer-scan threaded dense caches and are
         not paged (their cache lives inside the scan carry)."""
         caches = {}
-        for n in self.topo:
+        for n in self.topo:  # fflint: host-ok (one-time cache init)
             if n.op_type == OpType.PIPELINE:
                 raise ValueError(
                     "paged decode does not support PIPELINE composite "

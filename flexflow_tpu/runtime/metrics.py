@@ -85,7 +85,7 @@ def compute_step_metrics(
             lbl = labels.reshape(-1).astype(jnp.int32)
         else:
             lbl = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
-    for m in measured:
+    for m in measured:  # fflint: host-ok (traced inside the jitted step)
         if m == MetricsType.ACCURACY:
             pred = jnp.argmax(lf, axis=-1)
             truth = lbl if sparse else jnp.argmax(labels, axis=-1)

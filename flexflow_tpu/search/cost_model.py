@@ -144,10 +144,11 @@ class CostModel:
         ins = _in_shapes(graph, node)
 
         def axes_degree(axes) -> int:
-            d = 1
-            for a in axes:
-                d *= self.axis_sizes.get(a, 1)
-            return d
+            from flexflow_tpu.parallel.comm_spec import (
+                axes_degree as _shared,
+            )
+
+            return _shared(axes, self.axis_sizes)
 
         if node.op_type == OpType.REDUCTION and ins:
             axes = getattr(node.attrs, "axes", ()) or ("model",)
@@ -228,86 +229,38 @@ class CostModel:
         # RING_ATTENTION instead ppermutes k/v blockwise, overlapping the
         # transfer with per-block attention compute — only the unhidden
         # remainder is charged (ulysses: two all-to-all exchange legs).
+        # WHAT is moved comes from attention_comm_spec (shared with the
+        # lowering via parallel.comm_spec and cross-checked by fflint);
+        # this loop only converts declared steps into seconds. Training
+        # doubles every seq-parallel leg: the backward of an all-gather is
+        # a reduce-scatter of the same bytes, the backward of an
+        # all-to-all is its mirror, and the ring's backward pass
+        # re-permutes k/v AND accumulates dk/dv.
         if (node.op_type in (OpType.MULTIHEAD_ATTENTION,
                              OpType.RING_ATTENTION)
                 and view is not None and node.outputs
                 and node.outputs[0].ndim >= 3):
-            # head-sharded wo is a CONTRACTION over heads: each shard
-            # produces a partial sum of the output projection and GSPMD
-            # emits an all-reduce — priced like row-TP linears (the
-            # reference prices attention head parallelism's merge the same
-            # way through its comm tasks). ADDITIVE with the seq-parallel
-            # term below: a head+seq combined view pays both collectives.
             attn_events = []
-            wo = view.weight_specs.get("wo")
-            if wo and len(wo) >= 1 and wo[0]:
-                deg_wo = axes_degree(wo[0])
-                if deg_wo > 1:
-                    attn_events.append((tuple(wo[0]),
-                                        self.machine.all_reduce_time(
-                        node.outputs[0].global_bytes(), deg_wo,
-                        axes=tuple(wo[0]),
-                    )))
-            spec = view.output_spec(0)
-            seq_axes = tuple(spec[1]) if spec and len(spec) > 1 and spec[1] else ()
-            deg = axes_degree(seq_axes)
-            if deg > 1:
-                a = node.attrs
-                b = node.outputs[0].dims[0].size
-                s = node.outputs[0].dims[1].size
-                dt = node.outputs[0].dtype.size_bytes
-                hd = a.kdim
-                q_bytes = b * s * a.num_heads * hd * dt
-                kv_bytes = 2 * b * s * a.num_kv * hd * dt
-                # training doubles every seq-parallel leg: the backward of
-                # an all-gather is a reduce-scatter of the same bytes, the
-                # backward of an all-to-all is its mirror, and the ring's
-                # backward pass re-permutes k/v AND accumulates dk/dv
-                bwd = 2.0 if training else 1.0
-                if node.op_type == OpType.MULTIHEAD_ATTENTION:
+            bwd = 2.0 if training else 1.0
+            for st in self.attention_comm_spec(graph, node, view):
+                deg = axes_degree(st.axes)
+                if st.kind == "all_reduce":
+                    attn_events.append((st.axes, self.machine.all_reduce_time(
+                        st.nbytes, deg, axes=st.axes)))
+                elif st.kind == "all_gather":
                     gather = self.machine.all_gather_time(
-                        q_bytes + kv_bytes, deg, axes=seq_axes
-                    )
-                    attn_events.append((seq_axes, gather))  # fwd all-gather
+                        st.nbytes, deg, axes=st.axes)
+                    attn_events.append((st.axes, gather))  # fwd all-gather
                     if training:
                         # bwd: reduce-scatter of dq/dk/dv, same bytes
-                        attn_events.append((seq_axes, (bwd - 1.0) * gather))
-                elif getattr(a, "seq_mode", "ring") == "ulysses":
-                    # leg 1 moves q + KV: UNREPEATED GQA kv when the
-                    # lowering can keep it so — the condition here MUST
-                    # mirror ulysses_dot_product_attention's (per-shard
-                    # kv heads under head-TP must split the seq degree),
-                    # or the search underprices the exchange. leg 2
-                    # moves only the attention output (q-sized). h_deg
-                    # comes from the MESH head axis exactly as the
-                    # lowering reads it (_mesh_axis_size(mesh, "model")),
-                    # NOT from the view's wo sharding: the lowering
-                    # shards q/k/v heads whenever the mesh axis exists
-                    # and divides the heads, whether or not wo is
-                    # sharded, so wo-derived pricing drifted from the
-                    # bytes actually exchanged (ADVICE r5).
-                    h_deg = self.axis_sizes.get("model", 1)
-                    head_tp = h_deg > 1 and a.num_heads % h_deg == 0
-                    kv_tp_ok = (a.num_kv % h_deg == 0 if head_tp else True)
-                    local_kv = (a.num_kv // h_deg
-                                if head_tp and a.num_kv % h_deg == 0
-                                else a.num_kv)
-                    kv_heads_ex = (a.num_kv
-                                   if local_kv % deg == 0 and kv_tp_ok
-                                   else a.num_heads)
-                    kv_ex = 2 * b * s * kv_heads_ex * hd * dt
-                    leg1 = self.machine.all_to_all_time(
-                        q_bytes + kv_ex, deg, axes=seq_axes
-                    )
-                    leg2 = self.machine.all_to_all_time(
-                        q_bytes, deg, axes=seq_axes
-                    )
-                    attn_events.append((seq_axes, leg1))
-                    attn_events.append((seq_axes, leg2))
-                    if training:  # backward mirrors both exchanges
-                        attn_events.append((seq_axes, (bwd - 1.0) * leg1))
-                        attn_events.append((seq_axes, (bwd - 1.0) * leg2))
-                else:
+                        attn_events.append((st.axes, (bwd - 1.0) * gather))
+                elif st.kind == "all_to_all":
+                    leg = self.machine.all_to_all_time(
+                        st.nbytes, deg, axes=st.axes)
+                    attn_events.append((st.axes, leg))
+                    if training:  # backward mirrors the exchange
+                        attn_events.append((st.axes, (bwd - 1.0) * leg))
+                elif st.kind == "ppermute":
                     # ring: per-direction unhidden remainder. Forward
                     # ppermutes k/v behind the forward blocks; backward
                     # ppermutes k/v + accumulating dk/dv (2x bytes) behind
@@ -315,8 +268,7 @@ class CostModel:
                     # compute) — each leg is latency-bound unless the
                     # transfer outruns its own phase's compute.
                     transfer = self.machine.all_gather_time(
-                        kv_bytes, deg, axes=seq_axes
-                    )
+                        st.nbytes, deg, axes=st.axes)
                     compute = self.node_compute_time(graph, node, view,
                                                      training=training)
                     lat_floor = (deg - 1) * self.machine.ici_latency
@@ -324,13 +276,13 @@ class CostModel:
                         fwd_c = compute / (1.0 + self.backward_factor)
                         bwd_c = compute - fwd_c
                         attn_events.append(
-                            (seq_axes, max(lat_floor, transfer - fwd_c)))
+                            (st.axes, max(lat_floor, transfer - fwd_c)))
                         attn_events.append(
-                            (seq_axes,
+                            (st.axes,
                              max(lat_floor, 2.0 * transfer - bwd_c)))
                     else:
                         attn_events.append(
-                            (seq_axes, max(lat_floor, transfer - compute)))
+                            (st.axes, max(lat_floor, transfer - compute)))
             attn_events = [(ax, t) for ax, t in attn_events if t > 0.0]
             if attn_events:
                 return attn_events
@@ -370,6 +322,87 @@ class CostModel:
                             axes=tuple(wspec[cdim]),
                         ))]
         return []
+
+    def attention_comm_spec(self, graph: Graph, node: Node,
+                            view: Optional[ShardingView]):
+        """Declarative collectives this model PRICES for an attention node
+        under `view`: a list of parallel.comm_spec.CommStep (kind, mesh
+        axes, global forward bytes). This is the comparison surface
+        fflint's consistency pass checks against the LOWERING's declared
+        spec (parallel.comm_spec.attention_lowered_comm_spec) — the
+        machine check for the round-5 ulysses-h_deg / ring-GQA pricing
+        divergences. The exchange-shape decisions (GQA repeat, ulysses
+        ring-fallback) come from the same `ulysses_plan`/`ring_repeats_kv`
+        helpers the lowering itself calls; h_deg comes from the MESH head
+        axis exactly as the lowering reads it (_mesh_axis_size(mesh,
+        "model")), NOT from the view's wo sharding (ADVICE r5)."""
+        from flexflow_tpu.parallel.comm_spec import (
+            CommStep,
+            ring_repeats_kv,
+            ulysses_plan,
+        )
+        from flexflow_tpu.parallel.comm_spec import (
+            axes_degree as _axes_degree,
+        )
+
+        steps = []
+        if (node.op_type not in (OpType.MULTIHEAD_ATTENTION,
+                                 OpType.RING_ATTENTION)
+                or view is None or not node.outputs
+                or node.outputs[0].ndim < 3):
+            return steps
+
+        def axes_degree(axes) -> int:
+            return _axes_degree(axes, self.axis_sizes)
+
+        # head-sharded wo is a CONTRACTION over heads: each shard produces
+        # a partial sum of the output projection and GSPMD emits an
+        # all-reduce — priced like row-TP linears. ADDITIVE with the
+        # seq-parallel exchange below: a head+seq view pays both.
+        wo = view.weight_specs.get("wo")
+        if wo and len(wo) >= 1 and wo[0]:
+            if axes_degree(wo[0]) > 1:
+                steps.append(CommStep("all_reduce", tuple(wo[0]),
+                                      node.outputs[0].global_bytes()))
+        spec = view.output_spec(0)
+        seq_axes = tuple(spec[1]) if spec and len(spec) > 1 and spec[1] else ()
+        deg = axes_degree(seq_axes)
+        if deg > 1:
+            a = node.attrs
+            b = node.outputs[0].dims[0].size
+            s = node.outputs[0].dims[1].size
+            dt = node.outputs[0].dtype.size_bytes
+            hd = a.kdim
+            q_bytes = b * s * a.num_heads * hd * dt
+            h_deg = self.axis_sizes.get("model", 1)
+            if node.op_type == OpType.MULTIHEAD_ATTENTION:
+                # GSPMD gathers q/k/v before the shard_map flash wrapper;
+                # GQA kv travels unrepeated
+                kv_bytes = 2 * b * s * a.num_kv * hd * dt
+                steps.append(CommStep("all_gather", seq_axes,
+                                      q_bytes + kv_bytes))
+                return steps
+            plan = (ulysses_plan(a.num_heads, a.num_kv, h_deg, deg)
+                    if getattr(a, "seq_mode", "ring") == "ulysses" else None)
+            if plan is not None and not plan.fallback_to_ring:
+                # leg 1 moves q + kv (unrepeated GQA when the lowering can
+                # keep it so); leg 2 moves the attention output (q-sized)
+                kv_ex = 2 * b * s * plan.kv_heads_exchanged * hd * dt
+                steps.append(CommStep("all_to_all", seq_axes,
+                                      q_bytes + kv_ex))
+                steps.append(CommStep("all_to_all", seq_axes, q_bytes))
+            else:
+                # ring path — either seq_mode="ring" or the ulysses
+                # lowering's silent fallback when local heads don't split
+                # the seq degree. A head-TP degree that does not divide
+                # the GQA kv heads repeats kv up front, so the ppermute
+                # moves full-head blocks.
+                kv_heads = (a.num_heads
+                            if ring_repeats_kv(a.num_heads, a.num_kv, h_deg)
+                            else a.num_kv)
+                steps.append(CommStep("ppermute", seq_axes,
+                                      2 * b * s * kv_heads * hd * dt))
+        return steps
 
     def weight_sync_time(self, graph: Graph, node: Node,
                          view: Optional[ShardingView]) -> float:

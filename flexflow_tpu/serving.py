@@ -721,14 +721,14 @@ class GenerationServer(_GenerationServerBase):
             # fixed shapes keep the step compiled once)
             pos = np.array([self._active[s].pos if self._active[s] else 0
                             for s in range(self.slots)], np.int32)
-            probs, upd = self._step(tr, ntr, self._caches, jnp.asarray(pos),
-                                    jnp.asarray(self._tokens)[:, None])
+            probs, upd = self._step(tr, ntr, self._caches, jnp.asarray(pos),  # fflint: host-ok (per-tick batch transfer)
+                                    jnp.asarray(self._tokens)[:, None])  # fflint: host-ok (per-tick batch transfer)
             self._caches = upd
             temps = np.array([self._active[s].temperature if self._active[s]
                               else 0.0 for s in range(self.slots)], np.float32)
             self._rng, sub = jax.random.split(self._rng)
             toks = np.asarray(self._pick(probs[:, -1, :],
-                                         jnp.asarray(temps), sub))
+                                         jnp.asarray(temps), sub))  # fflint: host-ok (per-tick batch transfer)
             self._steps += 1
             for s in live:
                 req = self._active[s]

@@ -160,9 +160,9 @@ class SpeculativePagedServer(PagedGenerationServer):
                             for s in range(self.slots)], np.int32)
 
             probs, upd = self._verify(
-                tr, ntr, self._caches, jnp.asarray(self._tables),
-                jnp.asarray(pos), jnp.asarray(depths), jnp.asarray(anc),
-                jnp.asarray(tokens))
+                tr, ntr, self._caches, jnp.asarray(self._tables),  # fflint: host-ok (per-tick batch transfer)
+                jnp.asarray(pos), jnp.asarray(depths), jnp.asarray(anc),  # fflint: host-ok (per-tick batch transfer)
+                jnp.asarray(tokens))  # fflint: host-ok (per-tick batch transfer)
             self._caches = upd
 
             # accept: greedy argmax walk. Both reductions run ON DEVICE —
@@ -175,9 +175,9 @@ class SpeculativePagedServer(PagedGenerationServer):
                 [self._active[s].temperature if self._active[s] else 0.0
                  for s in range(self.slots)], np.float32)
             self._rng, sub = jax.random.split(self._rng)
-            preds = np.asarray(jnp.argmax(probs, axis=-1))  # (slots, T)
+            preds = np.asarray(jnp.argmax(probs, axis=-1))  # (slots, T)  # fflint: host-ok (on-device reduction, one sync per tick)
             sampled = np.asarray(self._pick(probs[:, 0, :],
-                                            jnp.asarray(temps), sub))
+                                            jnp.asarray(temps), sub))  # fflint: host-ok (per-tick batch transfer)
             plans = {}
             for s in live:
                 req = self._active[s]
@@ -215,8 +215,8 @@ class SpeculativePagedServer(PagedGenerationServer):
                 req.spec_emitted += L
                 self.spec_emitted += L
             self._caches = self._commit(self._caches,
-                                        jnp.asarray(self._tables),
-                                        jnp.asarray(src),
-                                        jnp.asarray(dst))
+                                        jnp.asarray(self._tables),  # fflint: host-ok (per-tick batch transfer)
+                                        jnp.asarray(src),  # fflint: host-ok (per-tick batch transfer)
+                                        jnp.asarray(dst))  # fflint: host-ok (per-tick batch transfer)
             for s in live:
                 self._finish_if_done(s)

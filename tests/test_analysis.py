@@ -1,0 +1,532 @@
+"""fflint static-analysis subsystem (flexflow_tpu.analysis): pass
+registry, the three passes (consistency / rulesat / hostsync), the
+seeded-defect regression fixtures from ISSUE 3 (a misdeclared cost-model
+comm-spec reintroducing the ulysses h_deg bug shape, an unsatisfiable
+corpus rule, a host-sync in a decode loop), strategy-file import
+validation, and the CLI strict gate tier-1 rides on."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from flexflow_tpu.analysis import (
+    AnalysisContext,
+    Report,
+    available_passes,
+    run_passes,
+)
+from flexflow_tpu.analysis.consistency import check_strategy
+from flexflow_tpu.search.cost_model import CostModel
+from flexflow_tpu.search.machine_model import TPUMachineModel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _llama_sp_subject(seq_mode="ulysses", heads=8, kv_heads=2):
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.llama import (
+        LlamaConfig,
+        build_llama,
+        llama_tp_strategy,
+    )
+
+    cfg = LlamaConfig(vocab_size=256, dim=64, layers=1, heads=heads,
+                      kv_heads=kv_heads, hidden=128, rope_theta=10000.0)
+    mesh_shape = {"data": 2, "seq": 2, "model": 2}
+    ff = FFModel(FFConfig(batch_size=8, mesh_shape=mesh_shape))
+    build_llama(ff, cfg, batch_size=8, seq_len=128,
+                use_ring_attention=True, seq_mode=seq_mode)
+    ff.graph.infer_shapes()
+    return ff.graph, llama_tp_strategy(cfg, seq_parallel=True), mesh_shape
+
+
+def _cost_model(axis_sizes):
+    ndev = 1
+    for s in axis_sizes.values():
+        ndev *= s
+    return CostModel(TPUMachineModel.make("v5e", ndev), dict(axis_sizes))
+
+
+def test_pass_registry_has_the_three_passes():
+    assert set(available_passes()) >= {"consistency", "rulesat", "hostsync"}
+    report = run_passes(["hostsync"], AnalysisContext(src_paths=[]))
+    assert isinstance(report, Report)
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
+# consistency pass
+
+
+def test_consistency_clean_on_seq_parallel_llama():
+    graph, strategy, axis_sizes = _llama_sp_subject("ulysses")
+    findings = check_strategy(graph, strategy, axis_sizes,
+                              cost_model=_cost_model(axis_sizes))
+    assert [f for f in findings if f.severity == "error"] == []
+
+
+def test_consistency_flags_divisibility_with_named_node():
+    """kv_heads=2 sharded 4-way: execution replicates (prune_spec) while
+    the cost model prices the shard — named-node warning (warning, not
+    error: the shipped llama_tp_strategy deliberately leans on this
+    degradation, so only --strict gates it)."""
+    from flexflow_tpu.parallel.sharding import ShardingView
+
+    graph, strategy, _ = _llama_sp_subject("ring")
+    axis_sizes = {"data": 2, "seq": 2, "model": 4}
+    strategy = dict(strategy)
+    strategy["l0_attn"] = ShardingView(
+        output_specs=strategy["l0_attn"].output_specs,
+        weight_specs={"wk": ((), ("model",), ())},
+    )
+    hits = [f for f in check_strategy(graph, strategy, axis_sizes)
+            if f.code == "degree-divides"]
+    assert hits, "non-dividing shard not flagged"
+    assert all(f.severity == "warning" for f in hits)
+    assert any("l0_attn" in f.where for f in hits)
+    assert any("size 2" in f.message and "4-way" in f.message for f in hits)
+
+
+def test_consistency_flags_gqa_grouping_and_duplicate_axis():
+    from flexflow_tpu.parallel.sharding import ShardingView
+
+    graph, strategy, axis_sizes = _llama_sp_subject("ring", heads=8,
+                                                    kv_heads=8)
+    strategy = dict(strategy)
+    # wq heads over model but wo heads over seq: partial sums would mix
+    # head groups
+    strategy["l0_attn"] = ShardingView(
+        output_specs=strategy["l0_attn"].output_specs,
+        weight_specs={"wq": ((), ("model",), ()),
+                      "wo": (("seq",), (), ())},
+    )
+    findings = check_strategy(graph, strategy, axis_sizes)
+    assert any(f.code == "gqa-grouping" and "l0_attn" in f.where
+               for f in findings)
+    # duplicate axis on two dims of one spec
+    strategy["l0_gate"] = ShardingView(
+        ((("model",), (), ("model",)),))
+    findings = check_strategy(graph, strategy, axis_sizes)
+    assert any(f.code == "duplicate-axis" and "l0_gate" in f.where
+               for f in findings)
+
+
+def test_consistency_flags_stale_strategy():
+    graph, _, axis_sizes = _llama_sp_subject("ring")
+    from flexflow_tpu.parallel.sharding import ShardingView
+
+    stale = {"no_such_node": ShardingView(((("data",), (), ()),))}
+    findings = check_strategy(graph, stale, axis_sizes)
+    errs = [f for f in findings if f.code == "stale-strategy"]
+    assert errs and errs[0].severity == "error"
+    assert "no_such_node" in errs[0].message
+
+
+class _BuggyCostModel(CostModel):
+    """Regression fixture: the round-5 ulysses h_deg bug shape — the
+    exchange priced with h_deg derived from the VIEW's wo sharding
+    (unsharded wo => h_deg=1 => kv priced unrepeated) instead of the mesh
+    head axis the lowering reads."""
+
+    def attention_comm_spec(self, graph, node, view):
+        from flexflow_tpu.parallel.comm_spec import CommStep, ulysses_plan
+
+        steps = super().attention_comm_spec(graph, node, view)
+        wo = view.weight_specs.get("wo")
+        h_deg_view = 1
+        if wo and wo[0]:
+            for a in wo[0]:
+                h_deg_view *= self.axis_sizes.get(a, 1)
+        out = []
+        for st in steps:
+            a = node.attrs
+            o = node.outputs[0]
+            b, s = o.dims[0].size, o.dims[1].size
+            dt = o.dtype.size_bytes
+            q_bytes = b * s * a.num_heads * a.kdim * dt
+            if st.kind == "all_to_all" and st.nbytes > q_bytes:
+                deg = 1
+                for ax in st.axes:
+                    deg *= self.axis_sizes.get(ax, 1)
+                plan = ulysses_plan(a.num_heads, a.num_kv, h_deg_view, deg)
+                kv_ex = 2 * b * s * plan.kv_heads_exchanged * a.kdim * dt
+                out.append(CommStep(st.kind, st.axes, q_bytes + kv_ex))
+            else:
+                out.append(st)
+        return out
+
+
+def test_consistency_flags_misdeclared_comm_spec():
+    """Seeded defect 1 (ISSUE 3): GQA heads=8/kv=2 on a seq=2 x model=2
+    mesh with wo unsharded in the view — the lowering repeats kv for the
+    exchange (mesh h_deg=2 gives local_kv=1, indivisible by seq degree)
+    but the buggy model prices unrepeated kv. The comm-spec cross-check
+    must flag it; the correct model must be clean."""
+    from flexflow_tpu.parallel.sharding import ShardingView
+
+    graph, strategy, axis_sizes = _llama_sp_subject("ulysses", heads=8,
+                                                    kv_heads=2)
+    strategy = dict(strategy)
+    # keep the seq-sharded activations but drop the wo sharding — the
+    # shape where wo-derived h_deg diverges from the mesh head axis
+    old = strategy["l0_attn"]
+    strategy["l0_attn"] = ShardingView(
+        output_specs=old.output_specs,
+        weight_specs={k: v for k, v in old.weight_specs.items()
+                      if k != "wo"},
+        input_specs=old.input_specs,
+    )
+    clean = [f for f in check_strategy(graph, strategy, axis_sizes,
+                                       cost_model=_cost_model(axis_sizes))
+             if f.code == "comm-spec-mismatch"]
+    assert clean == [], [f.message for f in clean]
+    buggy = _BuggyCostModel(TPUMachineModel.make("v5e", 8),
+                            dict(axis_sizes))
+    flagged = [f for f in check_strategy(graph, strategy, axis_sizes,
+                                         cost_model=buggy)
+               if f.code == "comm-spec-mismatch"]
+    assert flagged, "buggy comm-spec not caught"
+    assert flagged[0].severity == "error"
+    assert "l0_attn" in flagged[0].where
+    assert "lowering emits" in flagged[0].message
+
+
+def test_consistency_flags_unpriced_mesh_driven_ring_exchange():
+    """A RING_ATTENTION node on a seq>1 mesh always ppermutes (the
+    lowering reads the mesh, not the view); a view that does not shard
+    the sequence prices zero comm — the cross-check catches the
+    underpricing."""
+    from flexflow_tpu.models.llama import LlamaConfig, llama_tp_strategy
+
+    graph, _, axis_sizes = _llama_sp_subject("ring")
+    cfg = LlamaConfig(vocab_size=256, dim=64, layers=1, heads=8,
+                      kv_heads=2, hidden=128, rope_theta=10000.0)
+    strategy = llama_tp_strategy(cfg, seq_parallel=False)  # no seq shard
+    flagged = [f for f in check_strategy(graph, strategy, axis_sizes,
+                                         cost_model=_cost_model(axis_sizes))
+               if f.code == "comm-spec-mismatch"]
+    assert flagged and "ppermute" in flagged[0].message
+    # the same underpricing with the attention node simply OMITTED from
+    # the strategy (no view at all -> cost model prices zero comm)
+    no_attn = {k: v for k, v in strategy.items() if k != "l0_attn"}
+    flagged = [f for f in check_strategy(graph, no_attn, axis_sizes,
+                                         cost_model=_cost_model(axis_sizes))
+               if f.code == "comm-spec-mismatch"]
+    assert flagged and "l0_attn" in flagged[0].where
+
+
+def test_cost_model_prices_ring_gqa_repeat_and_ulysses_fallback():
+    """The two real divergences the analyzer surfaced in this PR, now
+    fixed in the cost model: (a) ring under a head-TP degree that does
+    not divide the kv heads repeats kv up front, so the ppermute moves
+    full-head bytes; (b) ulysses whose local heads don't split the seq
+    degree falls back to the ring exchange — priced as ppermute, not
+    all-to-all."""
+    # (a) heads=6, kv=3, model=2: 3 % 2 != 0 -> repeat -> 6-head bytes
+    graph, strategy, _ = _llama_sp_subject("ring", heads=6, kv_heads=3)
+    axis_sizes = {"data": 2, "seq": 2, "model": 2}
+    cm = _cost_model(axis_sizes)
+    node = [n for n in graph.nodes if n.name == "l0_attn"][0]
+    steps = cm.attention_comm_spec(graph, node, strategy["l0_attn"])
+    pp = [st for st in steps if st.kind == "ppermute"]
+    assert len(pp) == 1
+    o = node.outputs[0]
+    b, s, dt = o.dims[0].size, o.dims[1].size, o.dtype.size_bytes
+    hd = node.attrs.kdim
+    assert pp[0].nbytes == 2 * b * s * 6 * hd * dt  # repeated: 6 heads
+    # (b) heads=4, model=2 -> 2 local heads; seq degree 4 won't divide
+    graph, strategy, _ = _llama_sp_subject("ulysses", heads=4, kv_heads=2)
+    axis_sizes = {"data": 1, "seq": 4, "model": 2}
+    cm = _cost_model(axis_sizes)
+    node = [n for n in graph.nodes if n.name == "l0_attn"][0]
+    steps = cm.attention_comm_spec(graph, node, strategy["l0_attn"])
+    kinds = {st.kind for st in steps if st.kind != "all_reduce"}
+    assert kinds == {"ppermute"}, steps
+
+
+# ---------------------------------------------------------------------------
+# rulesat pass
+
+
+def test_rulesat_corpus_all_fireable_and_agrees_with_soundness():
+    """Acceptance: every rule the soundness suite can instantiate is
+    classified fireable (no false 'inert' on a sound rule) — and the
+    shipped corpus contains no unsatisfiable rule."""
+    from flexflow_tpu.analysis.rulesat import classify_corpus
+    from flexflow_tpu.search.soundness import instantiate_rule
+    from flexflow_tpu.search.xfer_engine import (
+        DEFAULT_RULES_PATH,
+        find_matches,
+    )
+
+    with open(DEFAULT_RULES_PATH) as f:
+        rules = json.load(f)
+    cls = classify_corpus(rules)
+    assert len(cls) == len(rules)
+    unsat = [n for n, r in cls.items() if r["status"] != "fireable"]
+    assert unsat == [], unsat
+    # independent spot check against the soundness instantiation
+    for rule in rules[:: max(1, len(rules) // 25)]:
+        instantiable = any(
+            (inst := instantiate_rule(rule, profile_nd=nd)) is not None
+            and find_matches(rule, inst[0])
+            for nd in (2, 3, 4)
+        )
+        if instantiable:
+            assert cls[rule["name"]]["status"] == "fireable", rule["name"]
+
+
+def test_rulesat_flags_unsatisfiable_rules():
+    """Seeded defect 2 (ISSUE 3): guards that can never hold are
+    classified inert_unsatisfiable with a reason naming the guard."""
+    from flexflow_tpu.analysis.rulesat import classify_rule
+
+    def lin_rule(when, name):
+        return {
+            "name": name,
+            "src": {"nodes": [{"id": "l", "type": "LINEAR", "when": when}],
+                    "inputs": [["x", "l", 0]], "outputs": [["l", 0]]},
+            "dst": {"nodes": [{"id": "n", "type": "NOOP", "reuse": "l",
+                               "name": "{l}", "attrs": {}}],
+                    "inputs": [["x", "n", 0]], "outputs": [["n", 0]]},
+        }
+
+    rec = classify_rule(lin_rule({"attr_eq": ["bogus_field", 5]},
+                                 "bad_attr_field"))
+    assert rec["status"] == "inert_unsatisfiable"
+    assert any("bogus_field" in r for r in rec["reasons"])
+
+    rec = classify_rule(lin_rule({"definitely_unknown_pred": True},
+                                 "bad_predicate"))
+    assert rec["status"] == "inert_unsatisfiable"
+    assert any("definitely_unknown_pred" in r for r in rec["reasons"])
+
+    bad_kind = {
+        "name": "bad_unary_kind",
+        "src": {"nodes": [{"id": "u", "type": "ELEMENT_UNARY",
+                           "when": {"unary_kind": ["frobnicate"]}}],
+                "inputs": [["x", "u", 0]], "outputs": [["u", 0]]},
+        "dst": {"nodes": [{"id": "n", "type": "NOOP", "reuse": "u",
+                           "name": "{u}", "attrs": {}}],
+                "inputs": [["x", "n", 0]], "outputs": [["n", 0]]},
+    }
+    rec = classify_rule(bad_kind)
+    assert rec["status"] == "inert_unsatisfiable"
+    assert any("frobnicate" in r for r in rec["reasons"])
+
+    # a malformed guard must be CLASSIFIED, not crash the analyzer
+    for bad_arg in ([], 5, {"f": 1}, ["only_field"]):
+        rec = classify_rule(lin_rule({"attr_eq": bad_arg},
+                                     "malformed_attr_eq"))
+        assert rec["status"] == "inert_unsatisfiable", bad_arg
+        assert any("malformed" in r for r in rec["reasons"]), bad_arg
+
+    # the pass surfaces them as error findings
+    from flexflow_tpu.analysis.rulesat import rulesat_pass
+
+    ctx = AnalysisContext(rules=[lin_rule({"attr_eq": ["bogus_field", 5]},
+                                          "bad_attr_field")])
+    findings = rulesat_pass(ctx)
+    assert any(f.code == "rule-unsatisfiable" and f.severity == "error"
+               and f.where == "bad_attr_field" for f in findings)
+
+
+def test_rulesat_classification_snapshot_committed():
+    """docs/rule_coverage.json carries the per-rule classification (with
+    reachability) next to the search-measured fires/profit sections."""
+    with open(os.path.join(REPO, "docs", "rule_coverage.json")) as f:
+        snap = json.load(f)
+    cls = snap.get("classification", {})
+    assert cls.get("rules"), "classification section missing — regenerate " \
+        "with: python tools/fflint.py --passes rulesat --write-coverage"
+    assert len(cls["rules"]) == snap["corpus_size"]
+    for name, rec in cls["rules"].items():
+        assert rec["status"] in ("fireable", "inert_unsatisfiable"), name
+        assert rec["status"] == "fireable", f"{name} shipped unsatisfiable"
+        # search-observed fires must be classified reachable
+        if rec.get("snapshot_fired"):
+            assert rec["baseline_reach"] == "fires_on_baselines", name
+    assert "profit_by_config" in snap  # search-measured data preserved
+
+
+# ---------------------------------------------------------------------------
+# hostsync pass
+
+
+def test_hostsync_flags_item_sync_in_decode_loop(tmp_path):
+    """Seeded defect 3 (ISSUE 3): a per-token .item() sync in a decode
+    loop is an error; the pragma suppresses an annotated line."""
+    from flexflow_tpu.analysis.hostsync import scan_file
+
+    bad = tmp_path / "decode.py"
+    bad.write_text(textwrap.dedent("""\
+        import jax.numpy as jnp
+
+        def decode_loop(self, steps):
+            while True:
+                tok = self._step()
+                t = tok.item()
+                self.tokens.append(t)
+
+        def annotated_loop(self):
+            for x in self.batch:
+                t = x.item()  # fflint: host-ok (singleton control read)
+                self.use(t)
+
+        def non_directive_comment(self):
+            for x in self.batch:
+                t = x.item()  # fflint: broken, fix this
+                self.use(t)
+    """))
+    findings = scan_file(str(bad))
+    errs = [f for f in findings if f.code == "item-sync-in-loop"]
+    # the loose comment is NOT a directive — only host-ok/ignore suppress
+    assert len(errs) == 2, findings
+    assert all(f.severity == "error" for f in errs)
+    assert {"decode.py:6", "decode.py:16"} == {f.where.split("/")[-1]
+                                              for f in errs}
+    assert all("per-element device sync" in f.message for f in errs)
+
+
+def test_hostsync_flags_jnp_in_host_loop_and_shape_branch(tmp_path):
+    from flexflow_tpu.analysis.hostsync import scan_file
+
+    src = tmp_path / "hot.py"
+    src.write_text(textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+
+        def per_token_host_loop(tokens):
+            out = []
+            for t in tokens:
+                out.append(jnp.exp(t))
+            return out
+
+        def step(x):
+            if x.shape[0] > 4:
+                return x * 2
+            return x
+
+        step = jax.jit(step)
+    """))
+    findings = scan_file(str(src))
+    codes = {f.code for f in findings}
+    assert "jnp-in-host-loop" in codes
+    assert "shape-branch-in-jit" in codes
+    assert all(f.severity == "warning" for f in findings)
+
+
+def test_hostsync_repo_hot_paths_clean():
+    """runtime/, serving.py, paged/, spec/ carry no unannotated host-sync
+    hazards (intentional per-tick syncs are '# fflint: host-ok')."""
+    from flexflow_tpu.analysis.hostsync import default_src_paths, scan_paths
+
+    findings = scan_paths(default_src_paths())
+    gating = [f for f in findings if f.severity in ("error", "warning")]
+    assert gating == [], [(f.where, f.code) for f in gating]
+
+
+# ---------------------------------------------------------------------------
+# strategy-file import validation (model.py satellite)
+
+
+def test_import_strategy_file_corrupt_fails_with_named_node(tmp_path):
+    """A structurally-invalid view (an axis sharding two dims — GSPMD
+    rejects it at lowering) fails import with the node named, instead of
+    the cryptic XLA error it used to surface as."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+    from flexflow_tpu.parallel.sharding import ShardingView, view_to_json
+
+    bad = {
+        "l0_gate": view_to_json(ShardingView(
+            ((("model",), (), ("model",)),))),
+    }
+    path = tmp_path / "strategy.json"
+    path.write_text(json.dumps(bad))
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 2, "model": 4})
+    cfg.import_strategy_file = str(path)
+    ff = FFModel(cfg)
+    build_llama(ff, LlamaConfig.tiny(vocab=256), batch_size=8, seq_len=64)
+    with pytest.raises(ValueError) as ei:
+        ff.compile()
+    assert "l0_gate" in str(ei.value)
+    assert "duplicate-axis" in str(ei.value)
+
+
+def test_import_strategy_file_stale_fails(tmp_path):
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+    from flexflow_tpu.parallel.sharding import ShardingView, view_to_json
+
+    stale = {"renamed_node": view_to_json(
+        ShardingView(((("data",), (), ()),)))}
+    path = tmp_path / "stale.json"
+    path.write_text(json.dumps(stale))
+    cfg = FFConfig(batch_size=8, mesh_shape={"data": 2, "model": 4})
+    cfg.import_strategy_file = str(path)
+    ff = FFModel(cfg)
+    build_llama(ff, LlamaConfig.tiny(vocab=256), batch_size=8, seq_len=64)
+    with pytest.raises(ValueError) as ei:
+        ff.compile()
+    assert "renamed_node" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# CLI strict gate (the tier-1 acceptance bar: zero strict findings on all
+# BASELINE configs + the shipped corpus + the serving/runtime sources)
+
+
+def test_fflint_cli_strict_clean_on_baselines_and_corpus():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fflint.py"),
+         "--strict", "--json"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    payload = json.loads(proc.stdout)
+    assert payload["counts"]["error"] == 0
+    assert payload["counts"]["warning"] == 0
+    subjects = payload["stats"]["consistency"]["subjects"]
+    for cfg_name in ("alexnet_cifar10", "resnet50", "bert_base",
+                     "llama_tp_dp", "mixtral_ep", "inception_v3",
+                     "llama_sp_ring", "llama_sp_ulysses"):
+        assert cfg_name in subjects, subjects
+    counts = payload["stats"]["rulesat"]["classification_counts"]
+    assert counts.get("inert_unsatisfiable", 0) == 0
+    assert counts.get("fires_on_baselines", 0) > 0
+    assert sum(counts.values()) >= 400  # full corpus classified
+
+
+def test_unknown_config_name_raises_instead_of_validating_nothing():
+    """A typo'd --config must not silently check zero subjects and
+    report a corrupt strategy file as clean."""
+    from flexflow_tpu.analysis.baselines import build_baseline_subjects
+
+    with pytest.raises(ValueError) as ei:
+        build_baseline_subjects(["llama"])  # real name: llama_tp_dp
+    assert "llama_tp_dp" in str(ei.value)
+
+
+def test_fflint_cli_pass_selection_and_exit_codes(tmp_path):
+    """--passes runs only the named pass; an error finding fails the run
+    even without --strict."""
+    bad = tmp_path / "loopy.py"
+    bad.write_text("def f(xs):\n    for x in xs:\n        x.item()\n")
+    proc = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(f"""\
+            import sys
+            sys.path.insert(0, {REPO!r})
+            from flexflow_tpu.analysis import AnalysisContext, run_passes
+            report = run_passes(["hostsync"],
+                                AnalysisContext(src_paths=[{str(bad)!r}]))
+            sys.exit(1 if report.gating(strict=False) else 0)
+        """)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr

@@ -1,0 +1,205 @@
+"""fflint — static analyzer CLI for strategies, the sharding algebra, and
+the substitution corpus (flexflow_tpu.analysis).
+
+Default run (what tier-1 gates on through tests/test_analysis.py):
+  - consistency over every BASELINE config under its canonical strategy
+    (including the cost-model-vs-lowering attention comm-spec cross-check);
+  - rulesat over the shipped corpus, with reachability against the built
+    BASELINE graphs + the committed coverage snapshot;
+  - hostsync over runtime/, serving.py, paged/, spec/.
+
+Exit code: 1 when any error finding exists; --strict also gates on
+warnings. Info findings never gate.
+
+Usage:
+  python tools/fflint.py [--strict] [--json] [--passes P1,P2]
+                         [--configs C1,C2] [--strategy FILE --config NAME]
+                         [--rules FILE] [--no-baseline-reach]
+                         [--write-coverage] [--out FILE]
+
+  --strategy FILE --config NAME   validate an exported/imported strategy
+                                  file against the named BASELINE config's
+                                  graph (named-node diagnostics)
+  --write-coverage                merge the rulesat classification into
+                                  docs/rule_coverage.json (keeps the
+                                  search-measured fires/profit sections)
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COVERAGE_SNAPSHOT = os.path.join(REPO, "docs", "rule_coverage.json")
+
+
+def _consistency(report, names, strategy_file=None):
+    from flexflow_tpu.analysis import AnalysisContext, run_passes
+    from flexflow_tpu.analysis.baselines import build_baseline_subjects
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+
+    subjects = build_baseline_subjects(names)
+    graphs = []
+    for name, graph, strategy, axis_sizes in subjects:
+        if strategy_file is not None:
+            from flexflow_tpu.parallel.sharding import view_from_json
+
+            with open(strategy_file) as f:
+                strategy = {k: view_from_json(v)
+                            for k, v in json.load(f).items()}
+            name = f"{name}<{os.path.basename(strategy_file)}>"
+        ndev = 1
+        for s in axis_sizes.values():
+            ndev *= s
+        cm = CostModel(TPUMachineModel.make("v5e", ndev), axis_sizes)
+        ctx = AnalysisContext(graph=graph, strategy=strategy,
+                              axis_sizes=axis_sizes, cost_model=cm,
+                              subject=name)
+        run_passes(["consistency"], ctx, report)
+        graphs.append((name, graph))
+    return graphs
+
+
+def _rulesat(report, rules_path, baseline_graphs):
+    from flexflow_tpu.analysis import AnalysisContext, run_passes
+
+    with open(rules_path) as f:
+        rules = json.load(f)
+    snapshot = None
+    if os.path.exists(COVERAGE_SNAPSHOT):
+        with open(COVERAGE_SNAPSHOT) as f:
+            snapshot = json.load(f)
+    ctx = AnalysisContext(rules=rules, baseline_graphs=baseline_graphs,
+                          coverage_snapshot=snapshot, subject="corpus")
+    run_passes(["rulesat"], ctx, report)
+    return ctx.rule_classification or {}
+
+
+def write_coverage_classification(classification):
+    """Merge per-rule classification into docs/rule_coverage.json, keeping
+    the search-measured sections (fires/profit need real search runs)."""
+    from flexflow_tpu.analysis.rulesat import classification_counts
+
+    snap = {}
+    if os.path.exists(COVERAGE_SNAPSHOT):
+        with open(COVERAGE_SNAPSHOT) as f:
+            snap = json.load(f)
+    counts = classification_counts(classification)
+    snap["classification"] = {
+        "generated_by": "tools/fflint.py --write-coverage (rulesat pass)",
+        "counts": counts,
+        "rules": classification,
+    }
+    snap["corpus_size"] = len(classification)
+    with open(COVERAGE_SNAPSHOT, "w") as f:
+        json.dump(snap, f, indent=1, sort_keys=True)
+    return counts
+
+
+def main(argv=None):
+    from flexflow_tpu.analysis import Report, available_passes
+
+    ap = argparse.ArgumentParser(prog="fflint")
+    ap.add_argument("--strict", action="store_true",
+                    help="warnings gate the exit code too")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the full JSON report")
+    ap.add_argument("--passes", default=None,
+                    help=f"comma-separated subset of {available_passes()}")
+    ap.add_argument("--configs", default=None,
+                    help="comma-separated BASELINE config subset for the "
+                         "consistency pass")
+    ap.add_argument("--strategy", default=None,
+                    help="strategy JSON file to validate (with --config)")
+    ap.add_argument("--config", default=None,
+                    help="BASELINE config name the --strategy file targets")
+    ap.add_argument("--rules", default=None,
+                    help="rule corpus path (default: shipped corpus)")
+    ap.add_argument("--no-baseline-reach", action="store_true",
+                    help="skip building BASELINE graphs for rule "
+                         "reachability (faster; classification only)")
+    ap.add_argument("--write-coverage", action="store_true",
+                    help="merge rulesat classification into "
+                         "docs/rule_coverage.json")
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args(argv)
+
+    passes = args.passes.split(",") if args.passes else available_passes()
+    unknown = set(passes) - set(available_passes())
+    if unknown:
+        ap.error(f"unknown passes {sorted(unknown)}; "
+                 f"available: {available_passes()}")
+    names = args.configs.split(",") if args.configs else None
+    if args.strategy and not args.config:
+        ap.error("--strategy needs --config NAME")
+    if args.config:
+        names = args.config.split(",")
+
+    report = Report()
+    baseline_graphs = None
+    if "consistency" in passes:
+        baseline_graphs = _consistency(report, names,
+                                       strategy_file=args.strategy)
+    classification = {}
+    if "rulesat" in passes:
+        from flexflow_tpu.search.xfer_engine import DEFAULT_RULES_PATH
+
+        if baseline_graphs is None and not args.no_baseline_reach:
+            from flexflow_tpu.analysis.baselines import (
+                build_baseline_subjects,
+            )
+
+            baseline_graphs = [(n, g) for n, g, _, _ in
+                               build_baseline_subjects(names)]
+        classification = _rulesat(
+            report, args.rules or DEFAULT_RULES_PATH,
+            None if args.no_baseline_reach else baseline_graphs)
+        from flexflow_tpu.analysis.rulesat import classification_counts
+
+        report.stats.setdefault("rulesat", {})["classification_counts"] = \
+            classification_counts(classification)
+    if "hostsync" in passes:
+        from flexflow_tpu.analysis import AnalysisContext, run_passes
+
+        run_passes(["hostsync"], AnalysisContext(subject="src"), report)
+
+    if args.write_coverage and classification:
+        counts = write_coverage_classification(classification)
+        print(f"wrote classification for {len(classification)} rules to "
+              f"{COVERAGE_SNAPSHOT}: {counts}", file=sys.stderr)
+
+    payload = report.to_json()
+    if classification and args.as_json:
+        payload["rule_classification"] = classification
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+    if args.as_json:
+        print(json.dumps(payload, indent=1, sort_keys=True))
+    else:
+        for fnd in report.findings:
+            if fnd.severity == "info":
+                continue
+            print(f"{fnd.severity.upper()} [{fnd.pass_name}/{fnd.code}] "
+                  f"{fnd.where}: {fnd.message}")
+        c = payload["counts"]
+        print(f"fflint: {c['error']} error(s), {c['warning']} warning(s), "
+              f"{c['info']} info")
+    gating = report.gating(strict=args.strict)
+    return 1 if gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

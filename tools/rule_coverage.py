@@ -49,49 +49,11 @@ def is_algebraic(name: str) -> bool:
 
 
 def _configs():
-    """(name, build(ff) -> None, mesh_shape) per BASELINE config plus
-    InceptionV3; small layer counts — coverage depends on structure, not
-    depth."""
-    from flexflow_tpu.models.alexnet import build_alexnet_cifar10
-    from flexflow_tpu.models.bert import BertConfig, build_bert
-    from flexflow_tpu.models.inception import build_inception_v3
-    from flexflow_tpu.models.llama import LlamaConfig, build_llama
-    from flexflow_tpu.models.mixtral import MixtralConfig, build_mixtral
-    from flexflow_tpu.models.resnet import build_resnet50
+    """Config list shared with the static analyzer (single source of
+    truth — flexflow_tpu.analysis.baselines)."""
+    from flexflow_tpu.analysis.baselines import baseline_configs
 
-    def alexnet(ff):
-        build_alexnet_cifar10(ff, batch_size=8)
-
-    def resnet(ff):
-        build_resnet50(ff, batch_size=8, classes=100)
-
-    def bert(ff):
-        build_bert(ff, BertConfig(vocab_size=512, hidden=64, layers=2,
-                                  heads=4, intermediate=128),
-                   batch_size=8, seq_len=64)
-
-    def llama(ff):
-        build_llama(ff, LlamaConfig(vocab_size=512, dim=64, layers=2,
-                                    heads=4, kv_heads=2, hidden=128,
-                                    rope_theta=10000.0),
-                    batch_size=8, seq_len=128)
-
-    def mixtral(ff):
-        build_mixtral(ff, MixtralConfig.tiny(), batch_size=8, seq_len=32)
-
-    def inception(ff):
-        # 75px input keeps the tiny-config search fast; every inception
-        # block's concat-of-parallel-branches structure is preserved
-        build_inception_v3(ff, batch_size=8, classes=32, image_size=75)
-
-    return [
-        ("alexnet_cifar10", alexnet, {"data": 2, "model": 4}),
-        ("resnet50", resnet, {"data": 2, "model": 4}),
-        ("bert_base", bert, {"data": 2, "model": 4}),
-        ("llama_tp_dp", llama, {"data": 2, "seq": 2, "model": 2}),
-        ("mixtral_ep", mixtral, {"data": 2, "expert": 4}),
-        ("inception_v3", inception, {"data": 2, "model": 4}),
-    ]
+    return baseline_configs()
 
 
 def _search(build, mesh_shape, budget, exclude=None):
@@ -185,9 +147,37 @@ def main():
     }
     if args.profit:
         report["profit_by_config"] = profit_by_config
+    # WHY each dead rule is dead comes from the rulesat analysis pass
+    # (fflint) — fireable-but-unreachable vs unsatisfiable, with reasons —
+    # instead of this script re-deriving its own counts
+    from flexflow_tpu.analysis.baselines import build_graph
+    from flexflow_tpu.analysis.rulesat import classify_corpus
+
+    from flexflow_tpu.analysis.rulesat import classification_counts
+
+    with open(DEFAULT_RULES_PATH) as f:
+        rule_dicts = json.load(f)
+    graphs = []
+    for name, build, mesh_shape in _configs():
+        # tolerate a failing build like the search loop above does — one
+        # broken config must not discard the completed search/profit data
+        try:
+            graphs.append((name, build_graph(build, mesh_shape)))
+        except Exception as e:
+            print(f"[{name}] graph build failed for classification: {e}",
+                  file=sys.stderr)
+    classification = classify_corpus(
+        rule_dicts, baseline_graphs=graphs,
+        coverage_snapshot={"fires_by_config": per_config})
+    counts = classification_counts(classification)
+    report["classification"] = {
+        "generated_by": "flexflow_tpu.analysis.rulesat (fflint)",
+        "counts": counts,
+        "rules": classification,
+    }
     print(f"\ncorpus: {len(all_rules)} rules; "
           f"{len(fires_total)} fired on >=1 config; "
-          f"{len(dead)} dead everywhere")
+          f"{len(dead)} dead everywhere; classification {counts}")
     if args.write_active:
         # hand xfers (ring/pipeline/cancel...) are not corpus rules; the
         # active file only gates the DECLARATIVE corpus. Parallelization
