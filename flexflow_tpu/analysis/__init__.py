@@ -5,7 +5,7 @@ Unity-style search is only trustworthy while its invariants hold; round-5
 review enforced them by human advisor (two cost-model/lowering pricing
 divergences shipped, 377/408 corpus rules silently inert with no tool to
 say why). This subsystem turns those recurring review findings into a CI
-gate. Three passes ship (registered like op lowerings, so future PRs add
+gate. Four passes ship (registered like op lowerings, so future PRs add
 passes, not frameworks):
 
   consistency — strategy/sharding algebra per node: degrees divide dims,
@@ -16,10 +16,18 @@ passes, not frameworks):
       reasons), cross-validated against search.soundness instantiation.
   hostsync    — AST lint of runtime/serving/paged/spec for jit-boundary
       hazards (.item() device syncs in decode loops, jnp ops in host-side
-      loops, shape-dependent branches in jitted fns).
+      loops, shape-dependent branches in jitted fns, stale suppression
+      pragmas).
+  hloaudit    — ground-truth audit of the LOWERED programs: AOT-compiles
+      each config's real jitted entry points, parses the optimized HLO
+      (collective schedule, transpose/copy overhead, buffer-assignment
+      peak HBM) and diffs it against the cost model's priced-events
+      manifest. Compiles XLA programs, so the CLI runs it only when
+      selected (--passes hloaudit / all).
 
-CLI: tools/fflint.py (--json, --strict, per-pass selection); tier-1 gates
-on zero strict findings via tests/test_analysis.py. See docs/analysis.md.
+CLI: tools/fflint.py (--json, --strict, per-pass selection, --sarif);
+tier-1 gates on zero strict findings via tests/test_analysis.py. See
+docs/analysis.md.
 """
 
 from __future__ import annotations
@@ -68,6 +76,13 @@ class AnalysisContext:
     rule_classification: Optional[Dict] = None
     # hostsync inputs: files or directories to scan
     src_paths: Optional[List[str]] = None
+    # hloaudit inputs: {entry: {"hlo_text": str, "memory": stats} or
+    # {"error": str}} from analysis.hloaudit.lower_executor_modules, plus
+    # tolerance overrides (an AuditOptions or its kwargs dict)
+    hlo_modules: Optional[Dict] = None
+    hlo_opts: Optional[object] = None
+    # hloaudit per-subject program summaries, filled by the pass
+    hlo_summary: Optional[Dict] = None
 
 
 @dataclasses.dataclass
@@ -139,7 +154,12 @@ def available_passes() -> List[str]:
 
 def _ensure_registered() -> None:
     # imports populate the registry on first use (registry.py idiom)
-    from flexflow_tpu.analysis import consistency, hostsync, rulesat  # noqa: F401
+    from flexflow_tpu.analysis import (  # noqa: F401
+        consistency,
+        hloaudit,
+        hostsync,
+        rulesat,
+    )
 
 
 def run_passes(names: Optional[List[str]], ctx: AnalysisContext,

@@ -104,9 +104,44 @@ def _hand_strategy(name: str) -> Optional[Dict]:
 
 SP_SUBJECT_NAMES = ("llama_sp_ring", "llama_sp_ulysses")
 
+_SP_MESH = {"data": 2, "seq": 2, "model": 2}
+
 
 def known_subject_names() -> List[str]:
     return [name for name, _, _ in baseline_configs()] + list(SP_SUBJECT_NAMES)
+
+
+def _subject_recipe(name: str):
+    """(build(ff), mesh_shape, strategy(graph)) for one subject name —
+    the single home of per-config construction, shared by
+    build_baseline_subjects (graphs for the consistency pass) and
+    build_baseline_executor (compiled executors for hloaudit), so the
+    two passes can never silently audit different subjects."""
+    from flexflow_tpu.models.llama import build_llama, llama_tp_strategy
+    from flexflow_tpu.search.api import space_dp_strategy
+
+    if name not in known_subject_names():
+        raise ValueError(f"unknown BASELINE config name {name!r}; known: "
+                         f"{known_subject_names()}")
+    if name in SP_SUBJECT_NAMES:
+        seq_mode = "ring" if name.endswith("ring") else "ulysses"
+
+        def build(ff):
+            build_llama(ff, _llama_tiny_cfg(), batch_size=8, seq_len=128,
+                        use_ring_attention=True, seq_mode=seq_mode)
+
+        return build, dict(_SP_MESH), lambda graph: llama_tp_strategy(
+            _llama_tiny_cfg(), seq_parallel=True)
+
+    _, build, mesh_shape = next(
+        c for c in baseline_configs() if c[0] == name)
+
+    def strategy_for(graph):
+        hand = _hand_strategy(name)
+        return (hand if hand is not None
+                else space_dp_strategy(graph, mesh_shape))
+
+    return build, dict(mesh_shape), strategy_for
 
 
 def build_baseline_subjects(names: Optional[List[str]] = None):
@@ -115,10 +150,6 @@ def build_baseline_subjects(names: Optional[List[str]] = None):
     where one ships, default DP otherwise), plus `llama_sp_ring` /
     `llama_sp_ulysses` — seq-parallel ring-attention builds whose views
     must agree with the exchange the lowering emits."""
-    from flexflow_tpu import FFConfig, FFModel
-    from flexflow_tpu.models.llama import build_llama, llama_tp_strategy
-    from flexflow_tpu.search.api import space_dp_strategy
-
     if names:
         unknown = sorted(set(names) - set(known_subject_names()))
         if unknown:
@@ -127,26 +158,31 @@ def build_baseline_subjects(names: Optional[List[str]] = None):
                 f"unknown BASELINE config name(s) {unknown}; known: "
                 f"{known_subject_names()}")
     subjects = []
-    for name, build, mesh_shape in baseline_configs():
+    for name in known_subject_names():
         if names and name not in names:
             continue
+        build, mesh_shape, strategy_for = _subject_recipe(name)
         graph = build_graph(build, mesh_shape)
-        strategy = _hand_strategy(name)
-        if strategy is None:
-            strategy = space_dp_strategy(graph, mesh_shape)
-        subjects.append((name, graph, strategy, dict(mesh_shape)))
-
-    sp_mesh = {"data": 2, "seq": 2, "model": 2}
-    for sp_name, seq_mode in (("llama_sp_ring", "ring"),
-                              ("llama_sp_ulysses", "ulysses")):
-        if names and sp_name not in names:
-            continue
-        ff = FFModel(FFConfig(batch_size=8, mesh_shape=dict(sp_mesh)))
-        build_llama(ff, _llama_tiny_cfg(), batch_size=8, seq_len=128,
-                    use_ring_attention=True, seq_mode=seq_mode)
-        ff.graph.infer_shapes()
-        subjects.append((sp_name, ff.graph,
-                         llama_tp_strategy(_llama_tiny_cfg(),
-                                           seq_parallel=True),
-                         dict(sp_mesh)))
+        subjects.append((name, graph, strategy_for(graph), mesh_shape))
     return subjects
+
+
+def build_baseline_executor(name: str):
+    """Compile ONE BASELINE config end-to-end — FFModel.compile under its
+    canonical strategy on the local (8-device CPU) mesh — and return
+    (executor, graph, strategy, axis_sizes). This is the hloaudit entry:
+    the executor's lowered_modules() are the ground-truth artifacts the
+    cost model is audited against; _subject_recipe guarantees it is the
+    SAME config/strategy the consistency pass checks
+    (build_baseline_subjects)."""
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType
+
+    build, mesh_shape, strategy_for = _subject_recipe(name)
+    ff = FFModel(FFConfig(batch_size=8, mesh_shape=dict(mesh_shape)))
+    build(ff)
+    ff.graph.infer_shapes()
+    strategy = strategy_for(ff.graph)
+    ff.compile(optimizer=AdamOptimizer(lr=1e-4),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy=strategy)
+    return ff.executor, ff.graph, strategy, dict(mesh_shape)

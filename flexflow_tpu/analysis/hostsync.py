@@ -20,14 +20,21 @@ non-transitive — it reads each function's own AST, not its callees):
 
 Suppression: any flagged line (or its enclosing loop header) carrying a
 `# fflint: host-ok` / `# fflint: ignore` comment is skipped — intentional
-per-tick syncs are annotated, not silenced globally.
+per-tick syncs are annotated, not silenced globally. A directive that no
+longer suppresses ANY finding is itself flagged:
+
+  stale-pragma        (info)    the annotated hazard was refactored away
+      but the pragma survived — delete it so annotations keep meaning
+      something (suppressions must not rot into blanket noise).
 """
 
 from __future__ import annotations
 
 import ast
+import io
 import os
-from typing import List, Optional, Set
+import tokenize
+from typing import Dict, List, Optional, Set
 
 from flexflow_tpu.analysis import AnalysisContext, Finding, register_pass
 
@@ -83,34 +90,60 @@ def _jitted_names(tree: ast.Module) -> Set[str]:
     return jitted
 
 
-def _suppressed(lines: List[str], *linenos: int) -> bool:
+def _is_directive(txt: str) -> bool:
+    if "fflint:" not in txt:
+        return False
+    # only the exact directives suppress — a comment like
+    # '# fflint: broken, fix this' must NOT count
+    directive = txt.split("fflint:", 1)[1].strip()
+    return directive.startswith("host-ok") or directive.startswith("ignore")
+
+
+def _comment_map(src: str) -> Dict[int, str]:
+    """lineno -> COMMENT token text. Directives must live in actual
+    comments: a docstring that merely *documents* the
+    '# fflint: host-ok' convention is neither a suppression nor a stale
+    pragma."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError):
+        pass  # ast.parse already succeeded; a tokenizer hiccup only
+        # costs pragma visibility, never findings
+    return out
+
+
+def _suppressed(comments: Dict[int, str], *linenos: int) -> Optional[int]:
+    """The line number of the directive that suppresses a finding on any
+    of `linenos` (the flagged line or its enclosing loop headers), or
+    None. Returning the LINE lets the caller track which pragmas earned
+    their keep — unused ones are flagged stale."""
     for ln in linenos:
-        if 1 <= ln <= len(lines):
-            txt = lines[ln - 1]
-            if "fflint:" not in txt:
-                continue
-            # only the exact directives suppress — a comment like
-            # '# fflint: broken, fix this' must NOT count
-            directive = txt.split("fflint:", 1)[1].strip()
-            if directive.startswith("host-ok") or \
-                    directive.startswith("ignore"):
-                return True
-    return False
+        if _is_directive(comments.get(ln, "")):
+            return ln
+    return None
 
 
 class _FnScanner(ast.NodeVisitor):
     """Scan ONE function body (nested defs get their own scanner)."""
 
-    def __init__(self, findings, rel, lines, fn_name, jitted):
+    def __init__(self, findings, rel, comments, fn_name, jitted,
+                 used_pragmas: Optional[Set[int]] = None):
         self.findings = findings
         self.rel = rel
-        self.lines = lines
+        self.comments = comments
         self.fn_name = fn_name
         self.jitted = fn_name in jitted
         self.loop_stack: List[int] = []  # header linenos
+        self.used_pragmas = used_pragmas if used_pragmas is not None \
+            else set()
 
     def _add(self, severity, code, lineno, msg):
-        if _suppressed(self.lines, lineno, *self.loop_stack):
+        used = _suppressed(self.comments, lineno, *self.loop_stack)
+        if used is not None:
+            self.used_pragmas.add(used)
             return
         self.findings.append(Finding(
             "hostsync", severity, code, f"{self.rel}:{lineno}",
@@ -189,14 +222,26 @@ def scan_file(path: str, rel: Optional[str] = None) -> List[Finding]:
     except SyntaxError as e:
         return [Finding("hostsync", "error", "syntax-error",
                         f"{rel}:{e.lineno}", str(e))]
-    lines = src.splitlines()
+    comments = _comment_map(src)
     jitted = _jitted_names(tree)
     findings: List[Finding] = []
+    used_pragmas: Set[int] = set()
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            scanner = _FnScanner(findings, rel, lines, node.name, jitted)
+            scanner = _FnScanner(findings, rel, comments, node.name,
+                                 jitted, used_pragmas)
             for child in node.body:
                 scanner.visit(child)
+    # suppression hygiene: a directive that silenced nothing is stale —
+    # the hazard it annotated was refactored away and the annotation must
+    # not survive to blanket-silence a future real finding
+    for ln, txt in sorted(comments.items()):
+        if _is_directive(txt) and ln not in used_pragmas:
+            findings.append(Finding(
+                "hostsync", "info", "stale-pragma", f"{rel}:{ln}",
+                "'# fflint: host-ok' pragma no longer suppresses any "
+                "finding — delete it (stale annotations rot into blanket "
+                "noise)"))
     findings.sort(key=lambda f: f.where)
     return findings
 

@@ -1,0 +1,91 @@
+"""Finding -> SARIF 2.1.0 serialization for tools/fflint.py --sarif.
+
+One `run` per invocation; each Finding becomes a `result` with
+  ruleId  = "<pass>/<code>"           (e.g. "hloaudit/hlo-hbm-budget")
+  level   = error | warning | note    (info maps to note)
+  location: a physical file/line when `where` looks like "path:123"
+      (the hostsync pass), else a logical location carrying the subject
+      string (config:entry:node for hloaudit, config:node for
+      consistency, rule names for rulesat).
+
+CI uploads the artifact (see .github/workflows/tests.yml) so code-scanning
+UIs and reviewers get the same machine-readable findings the exit code
+gates on.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List
+
+from flexflow_tpu.analysis import Finding, Report
+
+_LEVEL = {"error": "error", "warning": "warning", "info": "note"}
+
+_FILE_LINE_RE = re.compile(r"^([\w./\-]+\.py):(\d+)$")
+
+
+_SEV_RANK = {"info": 0, "warning": 1, "error": 2}
+
+
+def _rules(findings: List[Finding]) -> List[Dict]:
+    # a rule's default level is the MAX severity observed for it, so the
+    # metadata is order-independent for mixed-severity rules (e.g.
+    # hlo-entry-failed is warning for train/eval, info for decode)
+    worst: Dict[str, str] = {}
+    for f in findings:
+        rid = f"{f.pass_name}/{f.code}"
+        if _SEV_RANK[f.severity] >= _SEV_RANK.get(worst.get(rid), -1):
+            worst[rid] = f.severity
+    return [{
+        "id": rid,
+        "name": rid.split("/", 1)[1],
+        "defaultConfiguration": {"level": _LEVEL[sev]},
+    } for rid, sev in sorted(worst.items())]
+
+
+def _location(f: Finding) -> Dict:
+    m = _FILE_LINE_RE.match(f.where)
+    if m:
+        return {
+            "physicalLocation": {
+                "artifactLocation": {"uri": m.group(1)},
+                "region": {"startLine": int(m.group(2))},
+            }
+        }
+    return {
+        "logicalLocations": [
+            {"fullyQualifiedName": f.where, "kind": "member"}
+        ]
+    }
+
+
+def report_to_sarif(report: Report) -> Dict:
+    findings = report.findings
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "fflint",
+                    "informationUri":
+                        "https://github.com/flexflow/FlexFlow",
+                    "rules": _rules(findings),
+                }
+            },
+            "results": [{
+                "ruleId": f"{f.pass_name}/{f.code}",
+                "level": _LEVEL[f.severity],
+                "message": {"text": f"{f.where}: {f.message}"},
+                "locations": [_location(f)],
+            } for f in findings],
+        }],
+    }
+
+
+def write_sarif(report: Report, path: str) -> None:
+    with open(path, "w") as fh:
+        json.dump(report_to_sarif(report), fh, indent=1, sort_keys=True)

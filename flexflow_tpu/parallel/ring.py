@@ -156,7 +156,13 @@ def ring_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,
             scale=scale, vary_axes=vary_axes,
         )
 
-    return _shard_map(fn, mesh, (spec, spec, spec), spec)(q, k, v)
+    # check_vma=False like the pallas ring path: the replication checker
+    # cannot type the BACKWARD of the fori_loop carry (zero cotangents
+    # enter the transposed scan with no varying annotation and training
+    # dies with "mismatched replication types" — caught by hloaudit's
+    # train_step lowering, which no test had ever traced for this path)
+    return _shard_map(fn, mesh, (spec, spec, spec), spec,
+                      check_vma=False)(q, k, v)
 
 
 def ulysses_dot_product_attention(q, k, v, *, mesh, causal: bool, scale: float,

@@ -56,6 +56,14 @@ class Node:
     def __eq__(self, other):
         return isinstance(other, Node) and self.guid == other.guid
 
+    def stable_key(self) -> str:
+        """The node's stable identity string, shared by the executor's
+        param pytrees (runtime.executor.node_key), the cost model's
+        priced-events manifest, and the jax.named_scope the lowering
+        wraps each op in — so HLO metadata op_names can be attributed
+        back to PCG nodes (analysis.hloaudit)."""
+        return f"{self.name}_{self.guid}"
+
     def __repr__(self):
         return f"Node({self.guid}:{self.op_type.value}:{self.name})"
 

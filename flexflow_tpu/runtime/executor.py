@@ -44,7 +44,7 @@ from flexflow_tpu.runtime.optimizer import Optimizer
 
 
 def node_key(node: Node) -> str:
-    return f"{node.name}_{node.guid}"
+    return node.stable_key()
 
 
 class Executor:
@@ -69,6 +69,7 @@ class Executor:
         self.loss_type = loss_type
         self.metrics = list(metrics)
         self.optimizer = optimizer
+        self.label_dtype = label_dtype
         self.seq_length = seq_length
         self.donate = donate
         self.remat = remat
@@ -442,7 +443,8 @@ class Executor:
         remat_groups = self._remat_groups if training else {}
         for n in self.topo:
             if n.op_type == OpType.INPUT:
-                vals = self._apply_view(n, [values[(n.guid, 0)]])
+                with jax.named_scope(node_key(n)):
+                    vals = self._apply_view(n, [values[(n.guid, 0)]])
                 values[(n.guid, 0)] = vals[0]
                 continue
             if remat_groups and n.guid in self._remat_member_of:
@@ -481,21 +483,27 @@ class Executor:
                 values[(n.guid, 0)] = outs[0]
                 continue
             lowering = get_lowering(n.op_type)
-            if (
-                training
-                and self.remat == "attention"
-                and n.op_type
-                in (OpType.MULTIHEAD_ATTENTION, OpType.RING_ATTENTION)
-            ):
-                # recompute S×S attention probs in backward instead of saving
-                # them (reference has no remat; on TPU this trades cheap MXU
-                # FLOPs for the scarce HBM)
-                outs = jax.checkpoint(
-                    lambda ps, xs: lowering(n.attrs, list(xs), ps, ctx)
-                )(params, tuple(ins))
-            else:
-                outs = lowering(n.attrs, ins, params, ctx)
-            outs = self._apply_view(n, outs)
+            # named_scope stamps this node's stable key into the HLO
+            # metadata op_name of every instruction it traces (backward
+            # included: transpose/jvp wrappers keep the scope name), so
+            # analysis.hloaudit can attribute lowered collectives back to
+            # PCG nodes and diff them against the cost model's manifest
+            with jax.named_scope(key):
+                if (
+                    training
+                    and self.remat == "attention"
+                    and n.op_type
+                    in (OpType.MULTIHEAD_ATTENTION, OpType.RING_ATTENTION)
+                ):
+                    # recompute S×S attention probs in backward instead of
+                    # saving them (reference has no remat; on TPU this
+                    # trades cheap MXU FLOPs for the scarce HBM)
+                    outs = jax.checkpoint(
+                        lambda ps, xs: lowering(n.attrs, list(xs), ps, ctx)
+                    )(params, tuple(ins))
+                else:
+                    outs = lowering(n.attrs, ins, params, ctx)
+                outs = self._apply_view(n, outs)
             for i, o in enumerate(outs):
                 values[(n.guid, i)] = o
             if ctx.state_updates:
@@ -537,10 +545,11 @@ class Executor:
                     node_guid=gn.guid,
                     sharding=gn.sharding,
                 )
-                outs = get_lowering(gn.op_type)(
-                    gn.attrs, ins, gp.get(node_key(gn), {}), ctx
-                )
-                outs = self._apply_view(gn, outs)
+                with jax.named_scope(node_key(gn)):
+                    outs = get_lowering(gn.op_type)(
+                        gn.attrs, ins, gp.get(node_key(gn), {}), ctx
+                    )
+                    outs = self._apply_view(gn, outs)
                 for i, o in enumerate(outs):
                     local[(gn.guid, i)] = o
             return local[out_key]
@@ -671,16 +680,14 @@ class Executor:
             )
         return caches
 
-    def init_paged_kv_cache(self, num_pages: int, page_size: int,
-                            dtype=None):
-        """Per-attention-node paged K/V POOLS for the paged decode path
-        (flexflow_tpu.paged): (num_pages, page_size, Hkv, D) buffers
-        shared by every request through per-slot page tables, so HBM
-        scales with TOKENS IN FLIGHT instead of slots x max_len. PIPELINE
-        composites keep their layer-scan threaded dense caches and are
-        not paged (their cache lives inside the scan carry)."""
-        caches = {}
-        for n in self.topo:  # fflint: host-ok (one-time cache init)
+    def paged_kv_cache_specs(self, num_pages: int, page_size: int,
+                             dtype=None) -> Dict[str, Dict[str, Any]]:
+        """Shape/dtype specs (jax.ShapeDtypeStruct) of the paged K/V
+        pools init_paged_kv_cache materializes — also the abstract
+        arguments lowered_modules() feeds the paged entry points, so the
+        audit lowering and the real server always agree on shapes."""
+        specs = {}
+        for n in self.topo:
             if n.op_type == OpType.PIPELINE:
                 raise ValueError(
                     "paged decode does not support PIPELINE composite "
@@ -695,15 +702,29 @@ class Executor:
             if dt is None:
                 dt = ins[0].dtype.jnp_dtype if ins else jnp.bfloat16
             shape = (num_pages, page_size, n.attrs.num_kv, n.attrs.kdim)
-            caches[node_key(n)] = {
-                "k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)
+            specs[node_key(n)] = {
+                "k": jax.ShapeDtypeStruct(shape, dt),
+                "v": jax.ShapeDtypeStruct(shape, dt),
             }
-        if not caches:
+        if not specs:
             raise ValueError(
                 "paged decode needs attention nodes (MULTIHEAD_ATTENTION "
                 "or RING_ATTENTION)"
             )
-        return caches
+        return specs
+
+    def init_paged_kv_cache(self, num_pages: int, page_size: int,
+                            dtype=None):
+        """Per-attention-node paged K/V POOLS for the paged decode path
+        (flexflow_tpu.paged): (num_pages, page_size, Hkv, D) buffers
+        shared by every request through per-slot page tables, so HBM
+        scales with TOKENS IN FLIGHT instead of slots x max_len. PIPELINE
+        composites keep their layer-scan threaded dense caches and are
+        not paged (their cache lives inside the scan carry)."""
+        return jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            self.paged_kv_cache_specs(num_pages, page_size, dtype),
+        )
 
     def paged_decode_fn(self):
         """jitted (params, pools, page_tables, pos, ids) ->
@@ -817,6 +838,137 @@ class Executor:
 
         self._forward = jax.jit(fwd)
         return self._forward
+
+    # ------------------------------------------------------------------
+    # AOT lowering (analysis.hloaudit ground-truth hook)
+
+    def abstract_params(self):
+        """(trainable, nontrainable) pytrees of jax.ShapeDtypeStruct with
+        the real param NamedShardings attached — the arguments init_params
+        would produce, without materializing anything."""
+        tr_sh, ntr_sh = self.param_shardings()
+        tr, ntr = {}, {}
+        for nk, ws in self.weight_specs().items():
+            for wn, decl in ws.items():
+                dtype = decl.shape.dtype.jnp_dtype
+                if dtype == jnp.bfloat16 or dtype == jnp.float16:
+                    dtype = jnp.float32  # master weights (init_params)
+                sh = (tr_sh if decl.trainable else ntr_sh).get(
+                    nk, {}).get(wn)
+                sds = jax.ShapeDtypeStruct(
+                    tuple(d for d in decl.shape.dims), dtype, sharding=sh)
+                (tr if decl.trainable else ntr).setdefault(nk, {})[wn] = sds
+        return tr, ntr
+
+    def _abstract_opt_state(self, trainable):
+        state = jax.eval_shape(self.optimizer.init_state, trainable)
+        if self.mesh is None:
+            return state
+        shardings_like, repl = self.opt_state_shardings(trainable)
+        ptree = jax.tree.structure(trainable)
+
+        def tree_shardings(sub):
+            if jax.tree.structure(sub) == ptree:
+                return shardings_like(sub)
+            return jax.tree.map(lambda _: repl, sub)
+
+        return jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            state, {k: tree_shardings(v) for k, v in state.items()},
+        )
+
+    def _abstract_labels(self):
+        """Label aval matching what fit()/eval() feed compute_loss for
+        this graph's sink shape and loss type."""
+        sink = self.sink.outputs[0]
+        dims = tuple(d.size for d in sink.dims)
+        if self.loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            shape = dims[:-1] if len(dims) > 2 else (dims[0],)
+            return jax.ShapeDtypeStruct(shape, self.label_dtype)
+        return jax.ShapeDtypeStruct(dims, jnp.float32)
+
+    def _abstract_inputs(self):
+        return [jax.ShapeDtypeStruct(
+            tuple(d.size for d in n.outputs[0].dims),
+            n.outputs[0].dtype.jnp_dtype) for n in self.input_nodes]
+
+    def can_paged_decode(self) -> bool:
+        """True when this graph has the shape paged decode serves: token
+        inputs, a token-level (b, s, vocab) sink, attention nodes, and no
+        PIPELINE composite (whose cache is threaded through the layer
+        scan). A pooled-classification graph (BERT's (b, classes) head)
+        has attention but nothing to decode."""
+        has_attn = any(n.op_type in (OpType.MULTIHEAD_ATTENTION,
+                                     OpType.RING_ATTENTION)
+                       for n in self.topo)
+        no_pipe = all(n.op_type != OpType.PIPELINE for n in self.topo)
+        token_in = (len(self.input_nodes) == 1
+                    and self.input_nodes[0].outputs[0].ndim == 2
+                    and jnp.issubdtype(
+                        self.input_nodes[0].outputs[0].dtype.jnp_dtype,
+                        jnp.integer))
+        token_out = self.sink.outputs[0].ndim >= 3
+        return has_attn and no_pipe and token_in and token_out
+
+    def lowered_modules(self, entries: Optional[Sequence[str]] = None, *,
+                        slots: int = 2, page_size: int = 16,
+                        num_pages: Optional[int] = None,
+                        max_nodes: int = 8):
+        """Named AOT lowerings of the real jitted entry points, traced on
+        abstract arguments — nothing is allocated or executed. Returns
+        {entry_name: jax.stages.Lowered}; callers .compile() each one to
+        read optimized HLO and buffer-assignment stats (the ground truth
+        analysis.hloaudit diffs the search cost model against).
+
+        `entries` defaults to train_step + eval_step, plus
+        paged_decode_fn + verify_fn when can_paged_decode(). The paged
+        shapes (slots / page_size / pool size / tree width) only scale
+        the audit's byte counts, not which collectives appear."""
+        known = ("train_step", "eval_step", "paged_decode", "verify")
+        if entries is None:
+            entries = ["train_step", "eval_step"]
+            if self.can_paged_decode():
+                entries += ["paged_decode", "verify"]
+        unknown = sorted(set(entries) - set(known))
+        if unknown:
+            raise ValueError(f"unknown entry point(s) {unknown}; "
+                             f"known: {list(known)}")
+        tr, ntr = self.abstract_params()
+        rng = jax.eval_shape(lambda: jax.random.key(0))
+        labels = self._abstract_labels()
+        inputs = self._abstract_inputs()
+        out: Dict[str, Any] = {}
+        if "train_step" in entries:
+            if self.optimizer is None:
+                raise ValueError("train_step lowering needs an optimizer")
+            opt_state = self._abstract_opt_state(tr)
+            out["train_step"] = self.train_step().lower(
+                tr, ntr, opt_state, rng, labels, *inputs)
+        if "eval_step" in entries:
+            out["eval_step"] = self.eval_step().lower(
+                tr, ntr, labels, *inputs)
+        if {"paged_decode", "verify"} & set(entries):
+            seq = self.input_nodes[0].outputs[0].dims[1].size
+            max_pages = -(-(seq + max_nodes) // page_size)
+            pages = (num_pages if num_pages is not None
+                     else slots * max_pages + 1)
+            caches = self.paged_kv_cache_specs(pages, page_size)
+            tables = jax.ShapeDtypeStruct((slots, max_pages), jnp.int32)
+            pos = jax.ShapeDtypeStruct((slots,), jnp.int32)
+            if "paged_decode" in entries:
+                ids = jax.ShapeDtypeStruct((slots, 1), jnp.int32)
+                out["paged_decode"] = self.paged_decode_fn().lower(
+                    tr, ntr, caches, tables, pos, ids)
+            if "verify" in entries:
+                depths = jax.ShapeDtypeStruct((slots, max_nodes),
+                                              jnp.int32)
+                mask = jax.ShapeDtypeStruct(
+                    (slots, max_nodes, max_nodes), jnp.bool_)
+                ids = jax.ShapeDtypeStruct((slots, max_nodes), jnp.int32)
+                out["verify"] = self.verify_fn().lower(
+                    tr, ntr, caches, tables, pos, depths, mask, ids)
+        return out
 
     # ------------------------------------------------------------------
 

@@ -208,20 +208,28 @@ class CostModel:
         if node.op_type in PARALLEL_OP_TYPES:
             return []
         # expert parallelism: an EXPERTS op whose weight stack is sharded
-        # over the expert axis pays a token all-to-all each way (dispatch +
-        # combine) — the reference prices Group_by/Aggregate data movement
-        # through Legion partitions; on TPU it is an explicit ICI all-to-all
+        # over the expert axis pays a token all-to-all INTO the experts
+        # (dispatch) and a partial-sum all-reduce OUT (combine) — the
+        # lowering's combine gathers every token's k expert rows and
+        # psums the weighted partial outputs over the expert axis
+        # (jax_ops._experts slot gather + sum(axis=0)); pricing a second
+        # all-to-all here was the divergence the hloaudit pass caught
+        # against the lowered HLO (the reference prices Group_by/Aggregate
+        # data movement through Legion partitions)
         if node.op_type == OpType.EXPERTS and view is not None and ins:
             w1 = view.weight_specs.get("w1")
             if w1 and w1[0]:
                 deg = axes_degree(w1[0])
                 if deg > 1:
-                    each = self.machine.all_to_all_time(
+                    dispatch = self.machine.all_to_all_time(
                         ins[0].global_bytes(), deg, axes=tuple(w1[0])
                     )
-                    # dispatch + combine: two distinct all-to-alls that can
-                    # each contend on the expert axis's links
-                    return [(tuple(w1[0]), each), (tuple(w1[0]), each)]
+                    combine = self.machine.all_reduce_time(
+                        node.outputs[0].global_bytes(), deg,
+                        axes=tuple(w1[0])
+                    )
+                    return [(tuple(w1[0]), dispatch),
+                            (tuple(w1[0]), combine)]
         # sequence-parallel attention: the comm that makes ring attention
         # win. A plain MULTIHEAD_ATTENTION under a seq-sharded view is
         # executable (the shard_map flash wrapper keeps S local, so GSPMD
@@ -245,8 +253,16 @@ class CostModel:
             for st in self.attention_comm_spec(graph, node, view):
                 deg = axes_degree(st.axes)
                 if st.kind == "all_reduce":
-                    attn_events.append((st.axes, self.machine.all_reduce_time(
-                        st.nbytes, deg, axes=st.axes)))
+                    ar = self.machine.all_reduce_time(
+                        st.nbytes, deg, axes=st.axes)
+                    attn_events.append((st.axes, ar))
+                    if training:
+                        # bwd mirror: the head-sharded qkv projections are
+                        # column-parallel, so dx at the attention entry is
+                        # a partial sum over the same head axes (same
+                        # (b,s,e) bytes as the wo psum) — the lowered-HLO
+                        # audit caught this leg priced at zero
+                        attn_events.append((st.axes, ar))
                 elif st.kind == "all_gather":
                     gather = self.machine.all_gather_time(
                         st.nbytes, deg, axes=st.axes)
@@ -303,24 +319,37 @@ class CostModel:
                 )
                 return [(("pipe",), (m + p - 1) * per_hop)]
         # contraction-dim sharding => partial-sum all-reduce of the output
+        # (row-TP); output-dim sharding => the BACKWARD dx is a partial
+        # sum over the same axes (column-TP pays its all-reduce in the
+        # backward — a leg the lowered-HLO audit found priced at zero)
         if view is not None and node.outputs:
             contraction_specs = {
-                OpType.LINEAR: ("kernel", 0),
-                OpType.CONV2D: ("kernel", 1),
+                OpType.LINEAR: ("kernel", 0, 1),
+                OpType.CONV2D: ("kernel", 1, 0),
             }
             if node.op_type in contraction_specs:
-                wname, cdim = contraction_specs[node.op_type]
+                wname, cdim, odim = contraction_specs[node.op_type]
                 wspec = view.weight_specs.get(wname)
+                events = []
                 if wspec is not None and cdim < len(wspec) and wspec[cdim]:
-                    deg = 1
-                    for a in wspec[cdim]:
-                        deg *= self.axis_sizes.get(a, 1)
+                    deg = axes_degree(wspec[cdim])
                     if deg > 1:
-                        return [(tuple(wspec[cdim]),
-                                 self.machine.all_reduce_time(
+                        events.append((tuple(wspec[cdim]),
+                                       self.machine.all_reduce_time(
                             node.outputs[0].global_bytes(), deg,
                             axes=tuple(wspec[cdim]),
-                        ))]
+                        )))
+                if (training and wspec is not None and ins
+                        and odim < len(wspec) and wspec[odim]):
+                    deg = axes_degree(wspec[odim])
+                    if deg > 1:
+                        events.append((tuple(wspec[odim]),
+                                       self.machine.all_reduce_time(
+                            ins[0].global_bytes(), deg,
+                            axes=tuple(wspec[odim]),
+                        )))
+                if events:
+                    return events
         return []
 
     def attention_comm_spec(self, graph: Graph, node: Node,
@@ -447,18 +476,200 @@ class CostModel:
                 events.append((tuple(sync_axes), t))
         return events
 
+    def event_seconds(self, kind: str, nbytes: float, deg: int,
+                      axes: Tuple[str, ...] = ()) -> float:
+        """Machine-model seconds for one collective in the
+        parallel.comm_spec kind vocabulary ("psum" accepted as an
+        all_reduce alias for the measured path's sample keys). Shared by
+        the priced-events manifest and MeasuredCostModel's
+        modeled_collective_time so both sides read the same formulas."""
+        axes = tuple(axes)
+        if deg <= 1:
+            return 0.0
+        if kind in ("all_reduce", "psum"):
+            return self.machine.all_reduce_time(nbytes, deg, axes=axes)
+        if kind == "all_gather":
+            return self.machine.all_gather_time(nbytes, deg, axes=axes)
+        if kind == "reduce_scatter":
+            return self.machine.reduce_scatter_time(nbytes, deg, axes=axes)
+        if kind == "all_to_all":
+            return self.machine.all_to_all_time(nbytes, deg, axes=axes)
+        # ppermute: one full hop of the per-chip shard
+        return (nbytes / self.machine._axis_bw(deg, axes)
+                + self.machine.ici_latency)
+
+    def node_priced_events(self, graph: Graph, node: Node,
+                           view: Optional[ShardingView],
+                           training: bool = True):
+        """Kind/byte-level view of every collective this model prices
+        AGAINST this node (node_comm_events' branches plus weight sync),
+        as PricedEvents keyed by the node's stable key — the per-node
+        manifest half the lowered-HLO audit joins against HLO metadata.
+        Bytes are forward-pass bytes in the machine-formula convention;
+        the audit's tolerance bands absorb training-time multipliers."""
+        key = node.stable_key()
+        events = []
+
+        def add(kind, axes, nbytes, source="node_comm"):
+            events.append(PricedEvent(kind, tuple(axes), float(nbytes),
+                                      source, key))
+
+        def axes_degree(axes) -> int:
+            from flexflow_tpu.parallel.comm_spec import (
+                axes_degree as _shared,
+            )
+
+            return _shared(axes, self.axis_sizes)
+
+        ins = _in_shapes(graph, node)
+        # parallel ops: same kind/byte decisions as node_comm_events
+        if node.op_type == OpType.REDUCTION and ins:
+            axes = getattr(node.attrs, "axes", ()) or ("model",)
+            if axes_degree(axes) > 1:
+                add("all_reduce", axes, ins[0].global_bytes())
+        elif node.op_type == OpType.COMBINE and ins:
+            axes = getattr(node.attrs, "axes", ()) or ("model",)
+            add("all_gather", axes, ins[0].global_bytes())
+        elif node.op_type == OpType.ALL_TO_ALL and ins:
+            add("all_to_all", getattr(node.attrs, "axes", ()),
+                ins[0].global_bytes())
+        elif node.op_type == OpType.FUSED_PARALLEL and ins:
+            nbytes = ins[0].global_bytes()
+            for kind, _dim, axes in node.attrs.steps:
+                axes = (tuple(axes or ()) if kind == "all_to_all"
+                        else tuple(axes or ("model",)))
+                # mirror node_comm_events' fused-chain degrees exactly:
+                # combine/replicate/all_to_all force deg>=2 (always
+                # priced), only a deg<=1 reduction drops out
+                if kind == "repartition" or (
+                        kind == "reduction" and axes_degree(axes) <= 1):
+                    continue
+                add({"reduction": "all_reduce", "combine": "all_gather",
+                     "replicate": "all_gather"}.get(kind, "all_to_all"),
+                    axes, nbytes)
+        elif node.op_type in PARALLEL_OP_TYPES:
+            pass
+        elif (node.op_type == OpType.EXPERTS and view is not None
+              and ins and view.weight_specs.get("w1")
+              and view.weight_specs["w1"][0]
+              and axes_degree(view.weight_specs["w1"][0]) > 1):
+            # dispatch all-to-all in, combine psum out (matches the
+            # slot-gather + weighted-sum combine the lowering emits)
+            add("all_to_all", view.weight_specs["w1"][0],
+                ins[0].global_bytes())
+            if node.outputs:
+                add("all_reduce", view.weight_specs["w1"][0],
+                    node.outputs[0].global_bytes())
+        elif (node.op_type in (OpType.MULTIHEAD_ATTENTION,
+                               OpType.RING_ATTENTION)
+              and node.outputs and node.outputs[0].ndim >= 3):
+            for st in self.attention_comm_spec(graph, node, view):
+                add(st.kind, st.axes, st.nbytes)
+                if not training:
+                    continue
+                # backward legs, mirroring node_comm_events' attention
+                # branch: dx psum for the wo all-reduce, reduce-scatter
+                # as the transpose of the q/kv all-gather, a second
+                # exchange for ulysses, and the ring ppermute moving
+                # k/v + accumulating dk/dv (2x bytes)
+                if st.kind == "ppermute":
+                    add(st.kind, st.axes, 2.0 * st.nbytes)
+                elif st.kind == "all_gather":
+                    add("reduce_scatter", st.axes, st.nbytes)
+                else:
+                    add(st.kind, st.axes, st.nbytes)
+        if not events and is_pipe_sharded(node, view) and ins:
+            p = self.axis_sizes.get("pipe", 1)
+            m = max(getattr(node.attrs, "n_microbatches", 1), 1)
+            if p > 1:
+                out_deg = max(
+                    spec_degree(view.output_spec(0), self.axis_sizes), 1)
+                add("ppermute", ("pipe",),
+                    (m + p - 1) * ins[0].global_bytes() / m / out_deg)
+        # contraction-dim sharding -> partial-sum all-reduce (row-TP);
+        # output-dim sharding -> backward dx psum (column-TP)
+        if (not events and view is not None and node.outputs
+                and node.op_type in (OpType.LINEAR, OpType.CONV2D)):
+            wname, cdim, odim = (("kernel", 0, 1)
+                                 if node.op_type == OpType.LINEAR
+                                 else ("kernel", 1, 0))
+            wspec = view.weight_specs.get(wname)
+            if (wspec is not None and cdim < len(wspec) and wspec[cdim]
+                    and axes_degree(wspec[cdim]) > 1):
+                add("all_reduce", wspec[cdim],
+                    node.outputs[0].global_bytes())
+            if (training and wspec is not None and ins
+                    and odim < len(wspec) and wspec[odim]
+                    and axes_degree(wspec[odim]) > 1):
+                add("all_reduce", wspec[odim], ins[0].global_bytes())
+        if training and node.attrs is not None:
+            for name, decl in node.attrs.weights(*ins).items():
+                if not decl.trainable:
+                    continue
+                shard_degree, used = 1, set()
+                wspec = (view.weight_specs.get(name)
+                         if view is not None else None)
+                if wspec:
+                    shard_degree = spec_degree(wspec, self.axis_sizes)
+                    for axes in wspec:
+                        used.update(axes)
+                sync_axes = tuple(a for a, s in self.axis_sizes.items()
+                                  if a not in used and s > 1)
+                if sync_axes:
+                    add("all_reduce", sync_axes,
+                        decl.shape.size_bytes() / shard_degree,
+                        source="weight_sync")
+        return events
+
+    def priced_comm_manifest(self, graph: Graph,
+                             strategy: Optional[Dict] = None,
+                             training: bool = True) -> Dict:
+        """The full per-node priced-events manifest for one (graph,
+        strategy): {"nodes": {stable_key: [PricedEvent]}, "edges":
+        [{src, dst, kind, axes, nbytes}]} — keyed exactly like the HLO
+        metadata op_names the executor stamps (jax.named_scope of each
+        node's stable key), so analysis.hloaudit can attribute every
+        lowered collective to the event that priced it or flag the node
+        that priced nothing."""
+        nodes: Dict[str, list] = {}
+        edges = []
+        for node in graph.topo_order():
+            view = (strategy.get(node.name, node.sharding)
+                    if strategy is not None else node.sharding)
+            evs = self.node_priced_events(graph, node, view, training)
+            if evs:
+                nodes[node.stable_key()] = evs
+            for e in graph.out_edges(node):
+                dst = graph.node(e.dst)
+                dst_view = (strategy.get(dst.name, dst.sharding)
+                            if strategy is not None else dst.sharding)
+                src_spec = view.output_spec(e.src_idx) if view else None
+                dst_in = None
+                if dst_view is not None:
+                    dst_in = dst_view.input_spec(e.dst_idx)
+                    if dst_in is None:
+                        dst_in = dst_view.output_spec(0)
+                step = self.edge_xfer_step(
+                    node.outputs[e.src_idx], src_spec, dst_in)
+                if step is not None:
+                    kind, axes, nbytes, _parts = step
+                    edges.append({"src": node.stable_key(),
+                                  "dst": dst.stable_key(),
+                                  "kind": kind, "axes": tuple(axes),
+                                  "nbytes": float(nbytes)})
+        return {"nodes": nodes, "edges": edges}
+
     def edge_xfer_time(self, shape, src_spec: Optional[Spec],
                        dst_spec: Optional[Spec]) -> float:
         return self.edge_xfer_event(shape, src_spec, dst_spec)[1]
 
-    def edge_xfer_event(self, shape, src_spec: Optional[Spec],
-                        dst_spec: Optional[Spec]):
-        """Resharding cost between the producer's output spec and the
-        consumer's *input* spec, as one (mesh_axes, seconds) event
-        (reference estimate_xfer_cost graph.cc:1438). Specs are compared
-        dim-by-dim on the dims of the edge tensor itself (trailing
-        replicated entries trimmed), so a rank-changing consumer's own
-        output spec is never misread as its input layout."""
+    def edge_xfer_step(self, shape, src_spec: Optional[Spec],
+                       dst_spec: Optional[Spec]):
+        """The collective one resharding edge implies, as (kind, axes,
+        nbytes, participants) — or None for a free reshard (identical
+        specs, or partitioning replicated data). The single home of the
+        kind decision, consumed by edge_xfer_event for pricing and by
+        priced_comm_manifest for the lowered-HLO audit."""
         ndim = len(shape.dims)
 
         def norm(spec):
@@ -473,20 +684,35 @@ class CostModel:
         src = norm(src_spec)
         dst = norm(dst_spec)
         if src == dst:
-            return ((), 0.0)
+            return None
         nbytes = shape.global_bytes()
         src_deg = spec_degree(src or None, self.axis_sizes)
         dst_deg = spec_degree(dst or None, self.axis_sizes)
         if src_deg == dst_deg == 1:
-            return ((), 0.0)
+            return None
         axes = tuple({a for spec in (src, dst) for entry in spec for a in entry})
-        parts = max(src_deg, dst_deg, 2)
         if src_deg > 1 and dst_deg > 1:
-            return (axes, self.machine.all_to_all_time(nbytes, parts, axes=axes))
+            return ("all_to_all", axes, nbytes, max(src_deg, dst_deg, 2))
         if src_deg > 1 and dst_deg == 1:
-            return (axes, self.machine.all_gather_time(nbytes, src_deg, axes=axes))
+            return ("all_gather", axes, nbytes, src_deg)
         # partitioning replicated data is a local slice
-        return ((), 0.0)
+        return None
+
+    def edge_xfer_event(self, shape, src_spec: Optional[Spec],
+                        dst_spec: Optional[Spec]):
+        """Resharding cost between the producer's output spec and the
+        consumer's *input* spec, as one (mesh_axes, seconds) event
+        (reference estimate_xfer_cost graph.cc:1438). Specs are compared
+        dim-by-dim on the dims of the edge tensor itself (trailing
+        replicated entries trimmed), so a rank-changing consumer's own
+        output spec is never misread as its input layout."""
+        step = self.edge_xfer_step(shape, src_spec, dst_spec)
+        if step is None:
+            return ((), 0.0)
+        kind, axes, nbytes, parts = step
+        if kind == "all_to_all":
+            return (axes, self.machine.all_to_all_time(nbytes, parts, axes=axes))
+        return (axes, self.machine.all_gather_time(nbytes, parts, axes=axes))
 
     # ------------------------------------------------------------------
 
@@ -510,6 +736,31 @@ class CostModel:
                 deg = spec_degree(view.output_spec(i), self.axis_sizes)
             total += out.global_bytes() / deg
         return total
+
+
+@dataclasses.dataclass(frozen=True)
+class PricedEvent:
+    """One collective the search PRICED, exported for the lowered-HLO
+    audit (analysis.hloaudit): `kind` uses the parallel.comm_spec
+    vocabulary (all_reduce / all_gather / reduce_scatter / all_to_all /
+    ppermute),
+    `nbytes` is in the convention the machine-model formula consumes,
+    `source` says which pricing path emitted it (node_comm /
+    weight_sync / edge_xfer), and `node` is the stable key the executor
+    stamps into HLO metadata via jax.named_scope — the join key that
+    lets the audit attribute a lowered collective back to the event
+    that priced it (or prove none did)."""
+
+    kind: str
+    axes: Tuple[str, ...]
+    nbytes: float
+    source: str
+    node: str
+
+    def to_json(self) -> Dict:
+        return {"kind": self.kind, "axes": list(self.axes),
+                "nbytes": float(self.nbytes), "source": self.source,
+                "node": self.node}
 
 
 @dataclasses.dataclass

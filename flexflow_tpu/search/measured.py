@@ -342,15 +342,10 @@ class MeasuredCostModel(CostModel):
     def modeled_collective_time(self, kind: str, nbytes: int,
                                 n: int, axes=None) -> float:
         """The analytic model's prediction for one measured sample (used
-        by the calibration-quality test)."""
-        if kind == "psum":
-            return self.machine.all_reduce_time(nbytes, n, axes=axes)
-        if kind == "all_gather":
-            return self.machine.all_gather_time(nbytes, n, axes=axes)
-        if kind == "all_to_all":
-            return self.machine.all_to_all_time(nbytes, n, axes=axes)
-        bw = self.machine._axis_bw(n, axes)
-        return nbytes / bw + self.machine.ici_latency
+        by the calibration-quality test). Delegates to
+        CostModel.event_seconds so the measured path, the priced-events
+        manifest, and the analytic pricing all read the same formulas."""
+        return self.event_seconds(kind, nbytes, n, tuple(axes or ()))
 
     # ------------------------------------------------------------------
 

@@ -1,9 +1,12 @@
 """fflint static-analysis subsystem (flexflow_tpu.analysis): pass
-registry, the three passes (consistency / rulesat / hostsync), the
-seeded-defect regression fixtures from ISSUE 3 (a misdeclared cost-model
-comm-spec reintroducing the ulysses h_deg bug shape, an unsatisfiable
-corpus rule, a host-sync in a decode loop), strategy-file import
-validation, and the CLI strict gate tier-1 rides on."""
+registry, the four passes (consistency / rulesat / hostsync / hloaudit),
+the seeded-defect regression fixtures from ISSUE 3 (a misdeclared
+cost-model comm-spec reintroducing the ulysses h_deg bug shape, an
+unsatisfiable corpus rule, a host-sync in a decode loop) and ISSUE 4 (a
+zeroed priced comm event the lowered-HLO diff must flag with the node
+named, a config whose priced memory exceeds the machine model's HBM
+budget), strategy-file import validation, and the CLI strict gate tier-1
+rides on."""
 
 import json
 import os
@@ -428,6 +431,382 @@ def test_hostsync_repo_hot_paths_clean():
     findings = scan_paths(default_src_paths())
     gating = [f for f in findings if f.severity in ("error", "warning")]
     assert gating == [], [(f.where, f.code) for f in gating]
+
+
+# ---------------------------------------------------------------------------
+# hostsync stale-pragma hygiene (ISSUE 4 satellite)
+
+
+def test_hostsync_flags_stale_pragma(tmp_path):
+    """A '# fflint: host-ok' that suppresses a real finding is used; one
+    annotating code that no longer trips any check is flagged info so
+    annotations cannot rot into blanket noise."""
+    from flexflow_tpu.analysis.hostsync import scan_file
+
+    src = tmp_path / "mixed.py"
+    src.write_text(textwrap.dedent("""\
+        def used_pragma(self):
+            for x in self.batch:
+                t = x.item()  # fflint: host-ok (singleton control read)
+                self.use(t)
+
+        def stale_pragma(self):
+            total = 0  # fflint: host-ok (nothing hazardous left here)
+            return total
+
+        def documented(self):
+            "Annotate syncs with '# fflint: host-ok (reason)' comments."
+            return 1
+    """))
+    findings = scan_file(str(src))
+    stale = [f for f in findings if f.code == "stale-pragma"]
+    # the docstring MENTIONING the directive is neither stale nor a
+    # suppression — only real comment tokens count
+    assert len(stale) == 1, findings
+    assert stale[0].severity == "info"
+    assert stale[0].where.endswith(":7")
+    # the used pragma's suppression still works: no item-sync error
+    assert not any(f.code == "item-sync-in-loop" for f in findings)
+
+
+def test_hostsync_repo_has_no_stale_pragmas():
+    from flexflow_tpu.analysis.hostsync import default_src_paths, scan_paths
+
+    stale = [f for f in scan_paths(default_src_paths())
+             if f.code == "stale-pragma"]
+    assert stale == [], [(f.where, f.message) for f in stale]
+
+
+# ---------------------------------------------------------------------------
+# hloaudit pass (ISSUE 4 tentpole): ground-truth audit of lowered programs
+# vs the search cost model
+
+
+_SAMPLE_HLO = """\
+HloModule jit_step
+
+ENTRY %main {
+  %ar = f32[4,128,64]{2,1,0} all-reduce(f32[4,128,64]{2,1,0} %x), replica_groups={{0,1},{2,3},{4,5},{6,7}}, metadata={op_name="jit(step)/jit(main)/jvp(l0_attn_7)/dot_general" source_file="a.py" source_line=1}
+  %ag = f32[8,128,64]{2,1,0} all-gather(f32[4,128,64]{2,1,0} %y), replica_groups=[4,2]<=[8], dimensions={0}, metadata={op_name="jit(step)/jit(main)/transpose(jvp(l0_ff_9))/convert" source_file="a.py" source_line=2}
+  %cp = u32[32768]{0} collective-permute(u32[32768]{0} %r), replica_groups={{0,1}}, metadata={op_name="jit(step)/jit(main)/jvp(l0_attn_7)/jit(_bernoulli)/jit(_uniform)/slice" source_file="a.py" source_line=3}
+  %t = f32[8,128,64]{2,1,0} transpose(f32[8,64,128]{2,1,0} %z), dimensions={0,2,1}
+  %c = f32[4,128,64]{2,1,0} copy(f32[4,128,64]{2,1,0} %w)
+  ROOT %out = f32[] constant(0)
+}
+"""
+
+
+def test_hloaudit_parser_attributes_and_classifies():
+    """Collectives parse with payload bytes, replica-group sizes (both
+    textual and iota formats), stable-key node attribution from metadata
+    op_names (fwd jvp and bwd transpose paths), and partitioned-RNG
+    plumbing marked so the diff skips it; transpose/copy totals match."""
+    from flexflow_tpu.analysis.hloaudit import parse_hlo_module
+
+    s = parse_hlo_module(_SAMPLE_HLO, ["l0_attn_7", "l0_ff_9"])
+    by_kind = {c.kind: c for c in s.collectives}
+    assert set(by_kind) == {"all-reduce", "all-gather",
+                            "collective-permute"}
+    ar = by_kind["all-reduce"]
+    assert (ar.node, ar.group_size, ar.rng) == ("l0_attn_7", 2, False)
+    assert ar.payload == 4 * 128 * 64 * 4
+    ag = by_kind["all-gather"]
+    assert (ag.node, ag.group_size) == ("l0_ff_9", 2)  # iota groups
+    cp = by_kind["collective-permute"]
+    assert cp.rng and cp.node == "l0_attn_7"
+    assert s.transpose_bytes == 8 * 128 * 64 * 4
+    assert s.copy_bytes == 4 * 128 * 64 * 4
+
+
+_ASYNC_HLO = """\
+HloModule jit_step
+
+ENTRY %main {
+  %ars = (f32[1024,256]{1,0}, f32[1024,256]{1,0}) all-reduce-start(f32[1024,256]{1,0} %x), replica_groups={{0,1}}, metadata={op_name="jit(step)/jvp(l0_ff_9)/add"}
+  %ard = f32[1024,256]{1,0} all-reduce-done((f32[1024,256]{1,0}, f32[1024,256]{1,0}) %ars)
+  %cps = (f32[1048576]{0}, u32[], u32[]) collective-permute-start(f32[1048576]{0} %y), replica_groups={{0,1}}, metadata={op_name="jit(step)/jvp(l0_attn_7)/slice"}
+  %car = ((f32[256,64]{1,0}, f32[128]{0}), (f32[256,64]{1,0}, f32[128]{0})) all-reduce-start(f32[256,64]{1,0} %a, f32[128]{0} %b), replica_groups={{0,1}}, metadata={op_name="jit(step)/transpose(jvp(l0_moe_11))/add"}
+  %var = (f32[512]{0}, f32[512]{0}, f32[256]{0}) all-reduce(f32[512]{0} %c, f32[512]{0} %d, f32[256]{0} %e), replica_groups={{0,1}}, metadata={op_name="jit(step)/jvp(l0_out_13)/add"}
+  ROOT %out = f32[] constant(0)
+}
+"""
+
+
+def test_hloaudit_parser_async_collectives():
+    """TPU-style forms parse: async `-start` operand/result pair tuples
+    halve (flat AND the nested combined-variadic form), array+scratch
+    tuples sum, sync variadic (combined) tuples sum every member, and
+    `-done` lines never double count."""
+    from flexflow_tpu.analysis.hloaudit import parse_hlo_module
+
+    s = parse_hlo_module(_ASYNC_HLO,
+                         ["l0_ff_9", "l0_attn_7", "l0_moe_11", "l0_out_13"])
+    assert len(s.collectives) == 4, s.collectives
+    by = {c.node: c for c in s.collectives}
+    # flat operand/result pair: halved
+    assert by["l0_ff_9"].payload == 1024 * 256 * 4
+    # array + u32[] scratch: summed (scratch is 8 noise bytes)
+    assert by["l0_attn_7"].payload == 1048576 * 4 + 8
+    # nested combined-variadic pair: halved to the two moved tensors
+    assert by["l0_moe_11"].payload == (256 * 64 + 128) * 4
+    # sync combined variadic: every member moves
+    assert by["l0_out_13"].payload == (512 + 512 + 256) * 4
+
+
+def test_transpose_audit_cli_is_a_wrapper():
+    """One HLO parser in the tree: the tools CLI re-exports the pass's
+    helpers instead of carrying its own drifted regexes."""
+    import tools.hlo_transpose_audit as cli
+    from flexflow_tpu.analysis import hloaudit
+
+    assert cli.audit_hlo_text is hloaudit.audit_hlo_text
+    assert cli.shape_bytes is hloaudit.shape_bytes
+    offenders = cli.audit_hlo_text(_SAMPLE_HLO, min_bytes=1)
+    assert [o["kind"] for o in offenders] == ["transpose", "copy"]
+
+
+def test_priced_comm_manifest_structure():
+    """The manifest exports kind/axes/bytes per stable node key: ring
+    attention prices its ppermute, weight syncs appear as reduce events,
+    and resharding edges carry src/dst keys."""
+    graph, strategy, axis_sizes = _llama_sp_subject("ring")
+    cm = _cost_model(axis_sizes)
+    manifest = cm.priced_comm_manifest(graph, strategy, training=True)
+    attn_key = next(n.stable_key() for n in graph.nodes
+                    if n.name == "l0_attn")
+    kinds = {e.kind for e in manifest["nodes"][attn_key]}
+    assert "ppermute" in kinds
+    assert "all_reduce" in kinds  # wo psum (+ bwd dx) + weight sync
+    sources = {e.source for evs in manifest["nodes"].values()
+               for e in evs}
+    assert "weight_sync" in sources
+    for e in manifest["edges"]:
+        assert set(e) >= {"src", "dst", "kind", "nbytes"}
+    # eval manifest carries no weight-sync traffic
+    ev = cm.priced_comm_manifest(graph, strategy, training=False)
+    assert not any(e.source == "weight_sync"
+                   for evs in ev["nodes"].values() for e in evs)
+
+
+def test_priced_manifest_mirrors_comm_event_pricing():
+    """node_priced_events is the kind/byte decomposition of what
+    node_comm_events actually prices: running each manifest event back
+    through event_seconds must reproduce node_comm_events' per-node
+    seconds on every BASELINE subject (attention and pipe-sharded nodes
+    get structural checks instead — their seconds fold in compute
+    overlap and hop latency the bytes manifest deliberately omits). A
+    one-sided edit to either copy fails here instead of silently making
+    the hloaudit manifest diverge from the search's pricing."""
+    import math
+
+    from flexflow_tpu.analysis.baselines import build_baseline_subjects
+    from flexflow_tpu.parallel.comm_spec import axes_degree
+    from flexflow_tpu.search.cost_model import CostModel, is_pipe_sharded
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+
+    from flexflow_tpu.ffconst import OpType
+
+    attention = (OpType.MULTIHEAD_ATTENTION, OpType.RING_ATTENTION)
+    for name, graph, strategy, axis_sizes in build_baseline_subjects():
+        ndev = 1
+        for s in axis_sizes.values():
+            ndev *= s
+        cm = CostModel(TPUMachineModel.make("v5e", ndev), axis_sizes)
+        for node in graph.topo_order():
+            view = strategy.get(node.name, node.sharding)
+            priced = [e for e in cm.node_priced_events(
+                graph, node, view, training=True)
+                if e.source == "node_comm"]
+            comm = cm.node_comm_events(graph, node, view, training=True)
+            where = f"{name}:{node.name}"
+            if node.op_type in attention and any(
+                    cm.attention_comm_spec(graph, node, view)):
+                # attention seconds are compute-coupled (ring legs price
+                # max(latency, transfer - overlapped compute) and may
+                # drop entirely when hidden), so the mirror check is
+                # structural: every axes comm prices must be in the
+                # manifest, which may additionally carry hidden legs
+                p_axes = [e.axes for e in priced]
+                for axes, _t in comm:
+                    assert tuple(axes) in p_axes, (where, axes, priced)
+                assert len(priced) >= len(comm), (where, priced, comm)
+                continue
+            assert len(priced) == len(comm), (
+                where, [(e.kind, e.axes) for e in priced],
+                [a for a, _t in comm])
+            if is_pipe_sharded(node, view):
+                continue  # hop-latency folding differs by design
+            t_priced = sum(cm.event_seconds(
+                e.kind, e.nbytes, axes_degree(e.axes, cm.axis_sizes),
+                e.axes) for e in priced)
+            t_comm = sum(t for _a, t in comm)
+            assert math.isclose(t_priced, t_comm, rel_tol=1e-9), (
+                where, t_priced, t_comm)
+
+
+@pytest.fixture(scope="module")
+def audited_llama():
+    """llama_tp_dp compiled end-to-end, eval_step AOT-lowered + XLA-
+    compiled once, shared by the hloaudit tests (the expensive part;
+    eval keeps the row-TP wo psum the fixtures need while lowering in a
+    fraction of train_step's time — the full four-entry train audit runs
+    in the slow-marked CLI acceptance test)."""
+    from flexflow_tpu.analysis.baselines import build_baseline_executor
+    from flexflow_tpu.analysis.hloaudit import (
+        lower_executor_modules,
+        parse_hlo_module,
+    )
+
+    executor, graph, strategy, axis_sizes = \
+        build_baseline_executor("llama_tp_dp")
+    cm = _cost_model(axis_sizes)
+    mods = lower_executor_modules(executor, entries=["eval_step"],
+                                  subject="llama_tp_dp")
+    assert "hlo_text" in mods["eval_step"], mods["eval_step"]
+    summary = parse_hlo_module(
+        mods["eval_step"]["hlo_text"],
+        [n.stable_key() for n in graph.nodes],
+        memory=mods["eval_step"]["memory"])
+    return executor, graph, strategy, axis_sizes, cm, mods, summary
+
+
+def test_hloaudit_clean_on_llama_eval_step(audited_llama):
+    """The real eval step audits clean against the (fixed) cost model —
+    and the pass fills the per-entry program summary stats."""
+    from flexflow_tpu.analysis import run_passes
+
+    executor, graph, strategy, axis_sizes, cm, mods, _ = audited_llama
+    ctx = AnalysisContext(graph=graph, strategy=strategy,
+                          axis_sizes=axis_sizes, cost_model=cm,
+                          subject="llama_tp_dp", hlo_modules=mods)
+    report = run_passes(["hloaudit"], ctx)
+    gating = [f for f in report.findings
+              if f.severity in ("error", "warning")]
+    assert gating == [], [(f.code, f.where, f.message) for f in gating]
+    prog = ctx.hlo_summary["llama_tp_dp"]["eval_step"]
+    assert prog["priced"] is True
+    assert prog["collective_schedule"]["all-reduce"]["count"] > 0
+    assert prog["attributed"] > 0
+    assert prog["peak_bytes"] and prog["peak_bytes"] > 0
+
+
+def test_hloaudit_flags_zeroed_priced_event(audited_llama):
+    """Seeded divergence 1 (ISSUE 4): zero the priced all-reduce events
+    of one attention node — the lowered module still runs that psum, so
+    the diff must fail strict with the node and collective kind named."""
+    from flexflow_tpu.analysis.hloaudit import diff_entry
+
+    _, graph, strategy, _, cm, _, summary = audited_llama
+    manifest = cm.priced_comm_manifest(graph, strategy, training=False)
+    attn_key = next(n.stable_key() for n in graph.nodes
+                    if n.name == "l0_attn")
+    clean = diff_entry("llama_tp_dp", "eval_step", manifest, summary)
+    assert [f for f in clean if f.severity == "error"] == []
+    manifest["nodes"][attn_key] = [
+        e for e in manifest["nodes"][attn_key] if e.kind != "all_reduce"
+    ]
+    flagged = [f for f in diff_entry("llama_tp_dp", "eval_step",
+                                     manifest, summary)
+               if f.code == "hlo-unpriced-collective"]
+    assert flagged, "zeroed priced event not caught"
+    assert flagged[0].severity == "error"
+    assert attn_key in flagged[0].where
+    assert "all-reduce" in flagged[0].message
+
+
+def test_hloaudit_flags_hbm_over_budget(audited_llama):
+    """Seeded divergence 2 (ISSUE 4): on a machine whose HBM the config
+    exceeds, both the priced memory_per_chip and XLA's buffer-assignment
+    peak must fail strict with the budget error."""
+    from flexflow_tpu.analysis.hloaudit import check_memory
+    from flexflow_tpu.search.cost_model import graph_cost
+    from flexflow_tpu.search.machine_model import (
+        TPUChipSpec,
+        TPUMachineModel,
+    )
+
+    _, graph, strategy, _, cm, _, summary = audited_llama
+    gc = graph_cost(graph, strategy, cm, training=True)
+    tiny = TPUMachineModel(
+        TPUChipSpec("tiny", 1e12, 1e6, 1e11, 5e10, 4, 2), 8)
+    assert gc.memory_per_chip > tiny.memory_per_chip()
+    flagged = check_memory("llama_tp_dp", "train_step",
+                           gc.memory_per_chip, summary, tiny)
+    budget = [f for f in flagged if f.code == "hlo-hbm-budget"]
+    assert len(budget) == 2  # priced side AND lowered peak
+    assert all(f.severity == "error" for f in budget)
+    assert "llama_tp_dp:train_step" in budget[0].where
+    # the real v5e budget is clean
+    ok = check_memory("llama_tp_dp", "train_step", gc.memory_per_chip,
+                      summary, cm.machine)
+    assert [f for f in ok if f.code == "hlo-hbm-budget"] == []
+
+
+def test_lowered_modules_entry_points(audited_llama):
+    """lowered_modules exposes the four audited entry points for a
+    decode-capable graph and rejects unknown names."""
+    executor = audited_llama[0]
+    assert executor.can_paged_decode()
+    lows = executor.lowered_modules(["eval_step"])
+    assert set(lows) == {"eval_step"}
+    assert hasattr(lows["eval_step"], "compile")  # a jax Lowered
+    with pytest.raises(ValueError) as ei:
+        executor.lowered_modules(["decode_fn"])
+    assert "paged_decode" in str(ei.value)
+
+
+def test_sarif_serialization():
+    """Finding -> SARIF: levels map (info -> note), hostsync file:line
+    findings become physical locations, logical subjects survive."""
+    from flexflow_tpu.analysis import Finding, Report
+    from flexflow_tpu.analysis.sarif import report_to_sarif
+
+    report = Report(findings=[
+        Finding("hostsync", "error", "item-sync-in-loop",
+                "serving.py:42", "sync in loop"),
+        Finding("hloaudit", "info", "hlo-vanished-collective",
+                "llama_tp_dp:train_step:l0_attn_7", "folded"),
+    ])
+    sarif = report_to_sarif(report)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == {
+        "hostsync/item-sync-in-loop",
+        "hloaudit/hlo-vanished-collective"}
+    res = {r["ruleId"]: r for r in run["results"]}
+    assert res["hostsync/item-sync-in-loop"]["level"] == "error"
+    phys = res["hostsync/item-sync-in-loop"]["locations"][0][
+        "physicalLocation"]
+    assert phys["artifactLocation"]["uri"] == "serving.py"
+    assert phys["region"]["startLine"] == 42
+    note = res["hloaudit/hlo-vanished-collective"]
+    assert note["level"] == "note"
+    assert note["locations"][0]["logicalLocations"][0][
+        "fullyQualifiedName"].startswith("llama_tp_dp:")
+
+
+@pytest.mark.slow
+def test_fflint_cli_hloaudit_strict_clean_on_all_baselines():
+    """Acceptance: `fflint --passes hloaudit --strict` audits every
+    BASELINE config's lowered entry points clean (the full run compiles
+    ~30 XLA programs, so it is its own CI step, not part of tier-1)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fflint.py"),
+         "--passes", "hloaudit", "--strict", "--json"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    payload = json.loads(proc.stdout)
+    assert payload["counts"]["error"] == 0
+    assert payload["counts"]["warning"] == 0
+    programs = payload["stats"]["hloaudit"]["programs"]
+    from flexflow_tpu.analysis.baselines import known_subject_names
+
+    assert set(programs) == set(known_subject_names())
+    for name in ("llama_tp_dp", "llama_sp_ring", "llama_sp_ulysses"):
+        assert set(programs[name]) >= {"train_step", "eval_step",
+                                       "paged_decode", "verify"}
 
 
 # ---------------------------------------------------------------------------

@@ -196,10 +196,15 @@ def test_mcmc_polished_near_llama_tp():
     # 100k proposals: the view space includes full-mesh DP and seq/2-axis
     # combinations, and the wo-psum pricing (r3) steepened the resharding
     # barriers into coherent TP chains — the annealer needs the longer
-    # schedule to cross them (native engine, still a few seconds)
+    # schedule to cross them (native engine, still a few seconds).
+    # Bar: the hloaudit-validated training pricing (r4: column-parallel
+    # weights pay their backward dx psum) moved hand/dp from ~0.68 to
+    # ~0.72, so the old 0.75*dp "clearly beats DP" bar had quietly become
+    # a within-5%-of-hand bar; 0.8*dp restores the intended claim (the
+    # 1.25*hand bound below still pins "near the hand strategy")
     s = mcmc_optimize(g, cost, budget=100000, seed=3)
     found = graph_cost(g, s, cost).time
-    assert found < 0.75 * dp, (found, dp)
+    assert found < 0.8 * dp, (found, dp)
     assert found <= 1.25 * hand, (found, hand)
 
 

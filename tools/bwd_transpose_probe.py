@@ -10,6 +10,12 @@ can be applied to the lowerings with evidence.
 Each candidate computes the SAME function; only operand layout/contraction
 order differs — XLA may or may not insert explicit transposes per variant.
 
+To FIND the offending transposes in the first place, use the whole-program
+scan in tools/hlo_transpose_audit.py (a thin CLI over
+flexflow_tpu.analysis.hloaudit, which also runs the same scan on every
+BASELINE config as part of `fflint --passes hloaudit`); this probe is the
+second step — timing candidate layouts for a site the audit named.
+
 Usage: python tools/bwd_transpose_probe.py [--platform tpu|cpu]
        [--dim 2048] [--hidden 5632] [--heads 16] [--tokens 8192]
 Prints one JSON line per (site, variant).

@@ -8,14 +8,22 @@ Default run (what tier-1 gates on through tests/test_analysis.py):
     BASELINE graphs + the committed coverage snapshot;
   - hostsync over runtime/, serving.py, paged/, spec/.
 
+The hloaudit pass — AOT-compile every BASELINE config's real entry
+points (train/eval/paged-decode/verify) and diff the optimized HLO's
+collective schedule + buffer-assignment peak against the cost model's
+priced-events manifest — runs only when selected (--passes hloaudit, or
+--passes all): it XLA-compiles each config and takes minutes, so it is
+its own CI step rather than part of every default invocation.
+
 Exit code: 1 when any error finding exists; --strict also gates on
 warnings. Info findings never gate.
 
 Usage:
-  python tools/fflint.py [--strict] [--json] [--passes P1,P2]
+  python tools/fflint.py [--strict] [--json] [--passes P1,P2|all]
                          [--configs C1,C2] [--strategy FILE --config NAME]
                          [--rules FILE] [--no-baseline-reach]
-                         [--write-coverage] [--out FILE]
+                         [--write-coverage] [--out FILE] [--sarif FILE]
+                         [--hlo-dump DIR]
 
   --strategy FILE --config NAME   validate an exported/imported strategy
                                   file against the named BASELINE config's
@@ -23,6 +31,10 @@ Usage:
   --write-coverage                merge the rulesat classification into
                                   docs/rule_coverage.json (keeps the
                                   search-measured fires/profit sections)
+  --sarif FILE                    also write the findings as SARIF 2.1.0
+                                  (CI uploads this artifact)
+  --hlo-dump DIR                  (hloaudit) write each entry point's
+                                  optimized HLO to DIR for offline diffs
 """
 
 import argparse
@@ -32,13 +44,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from flexflow_tpu.parallel.compat import ensure_cpu_devices  # noqa: E402
+
+# 8 virtual CPU devices BEFORE backend init, on any jax version: the
+# hloaudit pass compiles real multi-chip programs (consistency/rulesat
+# only need graphs, but the mesh must exist when executors are built)
+ensure_cpu_devices(8)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-try:
-    jax.config.update("jax_num_cpu_devices", 8)
-except Exception:
-    pass
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 COVERAGE_SNAPSHOT = os.path.join(REPO, "docs", "rule_coverage.json")
@@ -70,6 +85,37 @@ def _consistency(report, names, strategy_file=None):
         run_passes(["consistency"], ctx, report)
         graphs.append((name, graph))
     return graphs
+
+
+def _hloaudit(report, names, hlo_dump=None):
+    """Lower + XLA-compile each BASELINE config's entry points on the
+    local CPU mesh and diff them against the priced-events manifest."""
+    from flexflow_tpu.analysis import AnalysisContext, run_passes
+    from flexflow_tpu.analysis.baselines import (
+        build_baseline_executor,
+        known_subject_names,
+    )
+    from flexflow_tpu.analysis.hloaudit import lower_executor_modules
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+
+    programs = {}
+    for name in (names or known_subject_names()):
+        executor, graph, strategy, axis_sizes = \
+            build_baseline_executor(name)
+        ndev = 1
+        for s in axis_sizes.values():
+            ndev *= s
+        cm = CostModel(TPUMachineModel.make("v5e", ndev), axis_sizes)
+        mods = lower_executor_modules(executor, hlo_dump=hlo_dump,
+                                      subject=name)
+        ctx = AnalysisContext(graph=graph, strategy=strategy,
+                              axis_sizes=axis_sizes, cost_model=cm,
+                              subject=name, hlo_modules=mods)
+        run_passes(["hloaudit"], ctx, report)
+        if ctx.hlo_summary:
+            programs.update(ctx.hlo_summary)
+    report.stats.setdefault("hloaudit", {})["programs"] = programs
 
 
 def _rulesat(report, rules_path, baseline_graphs):
@@ -108,6 +154,11 @@ def write_coverage_classification(classification):
     return counts
 
 
+# hloaudit XLA-compiles every config (minutes) — selected explicitly,
+# never part of the default invocation tier-1 rides on
+DEFAULT_PASSES = ("consistency", "rulesat", "hostsync")
+
+
 def main(argv=None):
     from flexflow_tpu.analysis import Report, available_passes
 
@@ -117,7 +168,10 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="print the full JSON report")
     ap.add_argument("--passes", default=None,
-                    help=f"comma-separated subset of {available_passes()}")
+                    help=f"comma-separated subset of {available_passes()}"
+                         f" or 'all' (default: {','.join(DEFAULT_PASSES)};"
+                         " hloaudit compiles XLA programs and must be"
+                         " selected explicitly)")
     ap.add_argument("--configs", default=None,
                     help="comma-separated BASELINE config subset for the "
                          "consistency pass")
@@ -134,13 +188,23 @@ def main(argv=None):
                     help="merge rulesat classification into "
                          "docs/rule_coverage.json")
     ap.add_argument("--out", default=None, help="also write JSON here")
+    ap.add_argument("--sarif", default=None,
+                    help="also write SARIF 2.1.0 findings here")
+    ap.add_argument("--hlo-dump", default=None, dest="hlo_dump",
+                    help="(hloaudit) dump each optimized HLO module to "
+                         "this directory")
     args = ap.parse_args(argv)
 
-    passes = args.passes.split(",") if args.passes else available_passes()
+    if args.passes == "all":
+        passes = available_passes()
+    elif args.passes:
+        passes = args.passes.split(",")
+    else:
+        passes = list(DEFAULT_PASSES)
     unknown = set(passes) - set(available_passes())
     if unknown:
         ap.error(f"unknown passes {sorted(unknown)}; "
-                 f"available: {available_passes()}")
+                 f"available: {available_passes()} (or 'all')")
     names = args.configs.split(",") if args.configs else None
     if args.strategy and not args.config:
         ap.error("--strategy needs --config NAME")
@@ -174,6 +238,8 @@ def main(argv=None):
         from flexflow_tpu.analysis import AnalysisContext, run_passes
 
         run_passes(["hostsync"], AnalysisContext(subject="src"), report)
+    if "hloaudit" in passes:
+        _hloaudit(report, names, hlo_dump=args.hlo_dump)
 
     if args.write_coverage and classification:
         counts = write_coverage_classification(classification)
@@ -186,6 +252,10 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as f:
             json.dump(payload, f, indent=1, sort_keys=True)
+    if args.sarif:
+        from flexflow_tpu.analysis.sarif import write_sarif
+
+        write_sarif(report, args.sarif)
     if args.as_json:
         print(json.dumps(payload, indent=1, sort_keys=True))
     else:
