@@ -1215,21 +1215,27 @@ class FFModel:
     def serve_generation(self, slots: int = 4, max_len: int = 512,
                          eos_id=None, seed: int = 0, paged: bool = False,
                          page_size: int = 64, num_pages=None,
-                         preemption: bool = True, speculate=None):
+                         preemption: bool = True, prefix_cache: bool = True,
+                         prefill_chunk: int = 64, speculate=None):
         """Continuous-batching autoregressive generation endpoint (KV-cache
         decode with per-slot positions — flexflow_tpu.serving). With
         `paged=True` the KV cache is a block-paged pool shared by all
         requests (flexflow_tpu.paged): HBM scales with tokens in flight,
         admission is by free-page budget, and page pressure preempts and
-        requeues the youngest request. `speculate=SpecConfig(...)` (with
-        paged=True) adds speculative tree decoding (flexflow_tpu.spec):
-        drafted token trees verified in one step, greedy output
-        token-identical, up to depth+1 tokens emitted per step."""
+        requeues the youngest request; `prefix_cache` shares
+        content-addressed prompt-prefix pages across requests and
+        `prefill_chunk` bounds the prompt tokens prefilled per decode
+        tick (chunked prefill — long prompts never stall in-flight
+        decodes). `speculate=SpecConfig(...)` (with paged=True) adds
+        speculative tree decoding (flexflow_tpu.spec): drafted token
+        trees verified in one step, greedy output token-identical, up to
+        depth+1 tokens emitted per step."""
         from flexflow_tpu.serving import serve_generation as _sg
 
         return _sg(self, slots=slots, max_len=max_len, eos_id=eos_id,
                    seed=seed, paged=paged, page_size=page_size,
                    num_pages=num_pages, preemption=preemption,
+                   prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
                    speculate=speculate)
 
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],

@@ -430,8 +430,12 @@ def _element_binary(attrs, inputs, params, ctx):
             # (sibling branches share a row of the table)
             b = b[pos[:, None] + ctx.spec_depths]
         else:
-            # continuous batching: per-row positions, single-token steps
-            b = b[pos][:, None]
+            # continuous batching: per-row positions. S=1 is a decode
+            # step; S>1 is a paged prefill CHUNK whose rows sit at
+            # pos..pos+S (Executor.chunked_prefill_fn — the gather clamps
+            # padded tail rows, which later writes overwrite anyway)
+            rows = pos[:, None] + jnp.arange(a.shape[1])[None, :]
+            b = b[rows]
     return [_BINARY[attrs.kind](a, b)]
 
 

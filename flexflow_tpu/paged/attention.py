@@ -13,9 +13,10 @@ picks its kernel (backend probe + env kill switch + shape gate):
     dot-product attention) that runs anywhere and is the reference the
     kernel is validated against.
 
-The decode step is S=1 by construction (prefill runs through the dense
-cached path and its rows are scattered into pages afterwards —
-scheduler.py), so q is (B, 1, H, D) here.
+A decode step is S=1; a chunked-prefill CHUNK is the same entry point
+with S>1 (rows land at pos+i through the table, causal kpos <= qpos
+mask), writing K/V straight into pool pages — there is no dense staging
+prefill (scheduler.py).
 
 A third path extends both for SPECULATIVE tree verify
 (flexflow_tpu.spec): the step scores a whole token tree per slot in one
@@ -215,37 +216,58 @@ def paged_flash_decode(q, kc_pages, vc_pages, page_tables, pos, *,
 
 def paged_cached_attention(q, k, v, cache_k, cache_v, page_tables, pos, *,
                            scale: float, rope_theta: Optional[float] = None):
-    """One paged decode step, the drop-in analog of
-    ops.jax_ops.cached_attention: rope at each slot's absolute position,
-    scatter the new K/V row into its slot's current page, attend over the
-    table-mapped pages. Idle slots (page table all-null, pos 0) write
-    into the null page and read garbage that their mask discards.
+    """One paged decode step OR one chunked-prefill chunk, the drop-in
+    analog of ops.jax_ops.cached_attention: rope at absolute positions
+    pos + i, scatter the new K/V rows into their table-mapped pages,
+    attend over everything written so far (kpos <= qpos). S=1 is the
+    per-tick decode step; S>1 is a prefill CHUNK writing straight into
+    pool pages (Executor.chunked_prefill_fn) — chunk lengths mix freely
+    across ticks, each compiles once per bucket. Idle slots (page table
+    all-null, pos 0) write into the null page and read garbage that
+    their mask discards; padded chunk rows past the table's last row are
+    redirected to the null page (their positions are garbage anyway and
+    later writes overwrite the in-range ones).
 
     Returns (attention output, new k pool, new v pool)."""
     from flexflow_tpu.ops.jax_ops import apply_rope
 
-    if q.shape[1] != 1:
-        raise ValueError(
-            f"paged decode is single-token (S=1), got S={q.shape[1]}; "
-            "prefill runs through the dense cached path and its rows are "
-            "scattered into pages (paged/scheduler.py)")
+    B, S = q.shape[0], q.shape[1]
     P = cache_k.shape[1]
     pos_v = jnp.asarray(pos)
     if rope_theta is not None:
-        q = apply_rope(q, rope_theta, pos_offset=pos_v)
-        k = apply_rope(k, rope_theta, pos_offset=pos_v)
-    B = q.shape[0]
-    rows = jnp.arange(B)
-    page = page_tables[rows, pos_v // P]                  # (B,)
-    off = pos_v % P
-    kc = cache_k.at[page, off].set(k[:, 0].astype(cache_k.dtype))
-    vc = cache_v.at[page, off].set(v[:, 0].astype(cache_v.dtype))
+        offs = pos_v if S == 1 else pos_v[:, None] + jnp.arange(S)[None, :]
+        q = apply_rope(q, rope_theta, pos_offset=offs)
+        k = apply_rope(k, rope_theta, pos_offset=offs)
+    L = page_tables.shape[1] * P
+    rows = pos_v[:, None] + jnp.arange(S)[None, :]        # (B, S)
+    safe = jnp.minimum(rows, L - 1)
+    bidx = jnp.arange(B)[:, None]
+    page = page_tables[bidx, safe // P]                   # (B, S)
+    # rows past the table (padded chunk tails) must not clobber the last
+    # real row — dump them in the null page with the other garbage
+    page = jnp.where(rows < L, page, 0)
+    off = safe % P
+    kc = cache_k.at[page, off].set(k.astype(cache_k.dtype))
+    vc = cache_v.at[page, off].set(v.astype(cache_v.dtype))
 
     force_interp = os.environ.get("FF_TPU_FLASH_INTERPRET") == "1"
-    if paged_attention_available(q.shape[-1], P, interpret=force_interp,
-                                 dtype=kc.dtype):
-        out = paged_flash_decode(q, kc, vc, page_tables, pos_v,
-                                 scale=scale, interpret=force_interp)
+    avail = paged_attention_available(q.shape[-1], P, interpret=force_interp,
+                                      dtype=kc.dtype)
+    if S == 1:
+        if avail:
+            out = paged_flash_decode(q, kc, vc, page_tables, pos_v,
+                                     scale=scale, interpret=force_interp)
+        else:
+            out = paged_gather_attention(q, kc, vc, page_tables, pos_v,
+                                         scale=scale)
+    elif avail:
+        # a chunk is a degenerate token tree (one chain): reuse the tree
+        # kernel's scalar-prefetched page walk with the causal chunk mask
+        kpos = jnp.arange(L)
+        qpos = pos_v[:, None] + jnp.arange(S)[None, :]
+        mask = kpos[None, None, :] <= qpos[:, :, None]    # (B, S, L)
+        out = paged_tree_verify(q, kc, vc, page_tables, pos_v, mask,
+                                scale=scale, interpret=force_interp)
     else:
         out = paged_gather_attention(q, kc, vc, page_tables, pos_v,
                                      scale=scale)

@@ -7,13 +7,30 @@ hold which pages. Page 0 is reserved as the NULL page: unallocated page
 table entries point at it, and idle decode slots write their garbage
 K/V row into it (those rows sit past every live request's position and
 are masked by the absolute-position attention mask).
+
+Pages are REFCOUNTED and CONTENT-ADDRESSED (vLLM-style prefix caching):
+a sha1 hash chain over page-aligned token blocks names each full page by
+the entire token prefix it closes, so two requests whose prompts share a
+page-aligned prefix map the SAME physical pages (refcount counts the
+mappers). A page whose refcount drops to zero is not erased: if it is
+hash-registered it parks on an LRU dead list — still addressable as a
+cache hit, reclaimed lazily when a fresh allocation needs it. Partially
+filled tail pages are registered under (parent chain hash, tail tokens)
+and are served copy-on-write: a hit clones the rows into a private page
+before the new owner writes past them (paged/scheduler.py owns the
+device copy; the pool only does the bookkeeping).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+# chain hash of the empty prefix (parent of the first block)
+EMPTY_HASH = hashlib.sha1().hexdigest()
 
 
 class PagePool:
@@ -34,7 +51,23 @@ class PagePool:
         # LIFO free list: freshly freed pages are reused first (their HBM
         # is warm) — order is a host-side detail, invisible to the device
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
-        self._owner: Dict[int, int] = {}  # page id -> owner token
+        self._refs: Dict[int, int] = {}          # page id -> refcount > 0
+        # dead-but-cached pages, oldest first (refcount 0, still indexed);
+        # an OrderedDict so revival and LRU eviction are both O(1)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # content addressing: chain hash -> page for FULL blocks; parent
+        # chain hash -> (page, tail tokens) for the partial tail block.
+        # _keys_of tracks every index entry naming a page, for O(1)
+        # unregister on eviction and id rewrite on defrag.
+        self._full: Dict[str, int] = {}
+        self._partial: Dict[str, Tuple[int, Tuple[int, ...]]] = {}
+        self._keys_of: Dict[int, List[Tuple[str, str]]] = {}
+        # prefix-cache counters (served by scheduler/server metrics)
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+        self.hits = 0          # lookups that mapped at least one row
+        self.misses = 0
+        self.evictions = 0     # cached pages reclaimed for fresh allocs
 
     # -- accounting -----------------------------------------------------
 
@@ -44,63 +77,246 @@ class PagePool:
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        """Allocatable pages: truly free + dead-but-cached (the LRU list
+        is reclaimed lazily, so admission math treats it as free)."""
+        return len(self._free) + len(self._lru)
 
     @property
     def pages_in_use(self) -> int:
-        return self.capacity - len(self._free)
+        """Live (refcount > 0) pages — shared pages count ONCE; that is
+        the whole point of prefix sharing."""
+        return self.capacity - self.free_pages
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._lru)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+    def fragmentation(self) -> float:
+        """Hole fraction of the occupied span: 1 - occupied/span where
+        span reaches the highest non-free page. 0.0 when compact (or
+        empty); defrag drives it back to 0."""
+        # metrics threads (server.metrics(), the HTTP endpoint) call this
+        # while the scheduler thread allocates/frees; dict iteration can
+        # race a resize, so retry the cheap snapshot instead of locking
+        # the hot path
+        for _ in range(8):
+            try:
+                used = set(self._refs) | set(self._lru)
+                break
+            except RuntimeError:  # dict resized mid-iteration
+                continue
+        else:
+            return 0.0
+        if not used:
+            return 0.0
+        return 1.0 - len(used) / max(used)
 
     def pages_for(self, n_tokens: int) -> int:
         """Pages needed to hold `n_tokens` cache rows."""
         return -(-int(n_tokens) // self.page_size)
 
+    # -- content addressing ---------------------------------------------
+
+    def chain_hashes(self, tokens) -> List[str]:
+        """Chain hash of every FULL page-aligned block of `tokens`:
+        entry i names blocks 0..i — the whole prefix, not just block i —
+        so equal hashes mean equal prefixes (position is implicit)."""
+        toks = np.asarray(tokens, np.int32)
+        h = hashlib.sha1()
+        out = []
+        P = self.page_size
+        for i in range(len(toks) // P):
+            h.update(toks[i * P:(i + 1) * P].tobytes())
+            out.append(h.hexdigest())
+        return out
+
+    def _is_free(self, page: int) -> bool:
+        """Neither refcounted nor dead-cached — O(1), unlike a `_free`
+        list scan (publication runs per page boundary on the hot loop)."""
+        return page not in self._refs and page not in self._lru
+
+    def register_full(self, page: int, chain_hash: str) -> None:
+        """Publish a fully written page under its prefix chain hash.
+        First writer wins — an existing entry keeps its page (the rows
+        are identical by construction; re-pointing would orphan refs)."""
+        if self._is_free(page) or chain_hash in self._full:
+            return
+        self._full[chain_hash] = page
+        self._keys_of.setdefault(page, []).append(("full", chain_hash))
+
+    def register_partial(self, page: int, parent_hash: str,
+                         tokens) -> None:
+        """Publish a partially filled tail page: rows [0, len(tokens))
+        hold the K/V of `tokens` continuing the `parent_hash` prefix.
+        Latest wins (the entry is a hint, hits are COW-copied anyway)."""
+        toks = tuple(int(t) for t in tokens)
+        if self._is_free(page) or not toks or len(toks) >= self.page_size:
+            return
+        prev = self._partial.get(parent_hash)
+        if prev is not None and prev[0] != page:
+            keys = self._keys_of.get(prev[0])
+            if keys and ("partial", parent_hash) in keys:
+                keys.remove(("partial", parent_hash))
+            if not keys and prev[0] in self._lru:
+                # the displaced donor lost its last index entry: it can
+                # never hit again, so free it rather than let it squat
+                # in the LRU ahead of genuinely hittable pages
+                del self._lru[prev[0]]
+                self._keys_of.pop(prev[0], None)
+                self._free.append(prev[0])
+        self._partial[parent_hash] = (page, toks)
+        keys = self._keys_of.setdefault(page, [])
+        if ("partial", parent_hash) not in keys:
+            keys.append(("partial", parent_hash))
+
+    def lookup(self, tokens) -> Tuple[List[int], int, Optional[int]]:
+        """Map the longest cached prefix of `tokens`. Returns
+        (full_pages, cached_tokens, cow_page):
+
+          full_pages — one page per matched FULL block, refcount bumped
+          (revived from the LRU dead list when necessary);
+          cached_tokens — rows covered: len(full_pages) * page_size plus
+          any tail rows matched in cow_page;
+          cow_page — a partial tail page whose leading rows continue the
+          matched prefix, refcount bumped. The CALLER must clone its rows
+          into a private page before anyone writes past them and then
+          free() this reference (copy-on-write).
+
+        Every returned page is pinned (refcounted) until freed."""
+        toks = np.asarray(tokens, np.int32)
+        n = len(toks)
+        self.lookup_tokens += n
+        chain = self.chain_hashes(toks)
+        pages: List[int] = []
+        parent = EMPTY_HASH
+        for h in chain:
+            p = self._full.get(h)
+            if p is None:
+                break
+            pages.append(p)
+            parent = h
+        cached = len(pages) * self.page_size
+        cow_page = None
+        # wherever the full-chain match stopped, a registered partial
+        # tail continuing the matched prefix can still serve its leading
+        # rows (identical prompts, prompt extensions, resume)
+        if cached < n:
+            ent = self._partial.get(parent)
+            if ent is not None:
+                pg, ptoks = ent
+                rest = toks[cached:]
+                m = 0
+                for a, b in zip(rest, ptoks):
+                    if int(a) != int(b):
+                        break
+                    m += 1
+                if m > 0:
+                    cow_page = pg
+                    cached += m
+        for p in pages:
+            self._retain(p)
+        if cow_page is not None:
+            self._retain(cow_page)
+        self.hit_tokens += cached
+        if cached > 0:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return pages, cached, cow_page
+
+    def _retain(self, page: int) -> None:
+        self._refs[page] = self._refs.get(page, 0) + 1
+        self._lru.pop(page, None)  # revive a dead-cached page
+
+    def _unregister(self, page: int) -> None:
+        for kind, h in self._keys_of.pop(page, []):
+            if kind == "full" and self._full.get(h) == page:
+                del self._full[h]
+            elif kind == "partial" and \
+                    self._partial.get(h, (None,))[0] == page:
+                del self._partial[h]
+
     # -- alloc / free ---------------------------------------------------
 
-    def alloc(self, n: int, owner: int = -1) -> Optional[List[int]]:
-        """Allocate `n` pages for `owner`, or None when the pool cannot
-        satisfy the request (callers queue or preempt — never partial)."""
-        if n > len(self._free):
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate `n` PRIVATE pages (refcount 1), or None when the pool
+        cannot satisfy the request (callers queue or preempt — never
+        partial). Truly free pages first; then the oldest dead-but-cached
+        pages are evicted (their hash entries drop — a future lookup of
+        that prefix misses and recomputes)."""
+        if n > self.free_pages:
             return None
-        pages = [self._free.pop() for _ in range(n)]
-        for p in pages:
-            self._owner[p] = owner
+        pages = []
+        for _ in range(n):
+            if self._free:
+                p = self._free.pop()
+            else:
+                p, _ = self._lru.popitem(last=False)  # oldest first
+                self._unregister(p)
+                self.evictions += 1
+            self._refs[p] = 1
+            pages.append(p)
         return pages
 
     def free(self, pages: List[int]) -> None:
+        """Drop one reference per page. At refcount 0 a hash-registered
+        page parks on the LRU dead list (reusable as a cache hit); an
+        unregistered one returns to the free list."""
         for p in pages:
-            if p in self._owner:
-                del self._owner[p]
+            r = self._refs.get(p)
+            if r is None:
+                continue
+            if r > 1:
+                self._refs[p] = r - 1
+                continue
+            del self._refs[p]
+            if self._keys_of.get(p):
+                self._lru[p] = None  # newest at the end
+            else:
+                self._keys_of.pop(p, None)
                 self._free.append(p)
 
     # -- defrag ---------------------------------------------------------
 
     def defrag(self) -> tuple:
-        """Compact allocated pages to the low end of the pool. Returns
-        (perm, old_to_new):
+        """Compact occupied pages (live AND dead-cached) to the low end
+        of the pool. Returns (perm, old_to_new):
 
           perm[new_id] = old_id  — gather indices for moving the DEVICE
           pool buffers (`new_pool = old_pool[perm]`);
           old_to_new[old_id]     — rewrite for every live page table
           (`table = old_to_new[table]`; null stays null).
 
-        Pure bookkeeping here; the caller owns applying both sides
+        Every owner's table AND the hash index are rewritten: the caller
+        applies old_to_new to each slot's table row and every request's
+        page list; the pool rewrites refcounts, the LRU list (order
+        preserved) and the content-address indexes here. Pure bookkeeping
+        on this side; the caller owns applying the device gather
         atomically (the scheduler does this between decode ticks, when no
         jitted program is in flight)."""
-        allocated = sorted(self._owner)
+        allocated = sorted(set(self._refs) | set(self._lru))
         perm = np.arange(self.num_pages, dtype=np.int32)
         old_to_new = np.arange(self.num_pages, dtype=np.int32)
-        new_owner: Dict[int, int] = {}
         for new_id, old_id in enumerate(allocated, start=1):
             perm[new_id] = old_id
             old_to_new[old_id] = new_id
-            new_owner[new_id] = self._owner[old_id]
         # remaining slots of perm point at the (now free) old pages, keeping
         # perm a true permutation; their content is garbage either way
+        occupied = set(allocated)
         free_old = [p for p in range(1, self.num_pages)
-                    if p not in self._owner]
+                    if p not in occupied]
         for i, old_id in zip(range(len(allocated) + 1, self.num_pages),
                              free_old):
             perm[i] = old_id
-        self._owner = new_owner
+        remap = lambda p: int(old_to_new[p])  # noqa: E731
+        self._refs = {remap(p): r for p, r in self._refs.items()}
+        self._lru = OrderedDict((remap(p), None) for p in self._lru)
+        self._keys_of = {remap(p): ks for p, ks in self._keys_of.items()}
+        self._full = {h: remap(p) for h, p in self._full.items()}
+        self._partial = {h: (remap(p), t)
+                         for h, (p, t) in self._partial.items()}
         self._free = list(range(self.num_pages - 1, len(allocated), -1))
         return perm, old_to_new

@@ -5,19 +5,38 @@ by FREE-PAGE BUDGET: a request is admitted when a decode slot is free
 AND the pool can hold its prompt's pages; it grows one page at a time as
 it decodes; page pressure preempts the youngest other request (its pages
 are freed and it requeues at the FRONT of the queue with prompt +
-generated prefix, so re-prefill resumes exactly where it stopped).
-EOS/max-new free pages and slot immediately. All bookkeeping is host
-numpy; the jitted decode step sees only int32 page tables and positions,
-so it compiles ONCE for the (slots, max_pages) shape.
+generated prefix). EOS/max-new free pages and slot immediately. All
+bookkeeping is host numpy; the jitted decode step sees only int32 page
+tables and positions, so it compiles ONCE for the (slots, max_pages)
+shape.
+
+PREFIX CACHING (prefix_cache=True): admission first maps the longest
+content-addressed prefix of the prompt from the pool's hash index —
+full pages are SHARED by refcount, a partially filled tail page is
+cloned copy-on-write — and only the uncached suffix is computed.
+Completed/preempted requests leave their pages behind as dead-but-
+cached LRU entries, so a preempted request's resume re-attaches its own
+K/V instead of recomputing it.
+
+CHUNKED PREFILL: the uncached suffix is computed `prefill_chunk` tokens
+per tick straight into pool pages (Executor.chunked_prefill_fn — no
+dense staging cache), INSIDE the decode loop: each tick advances
+mid-prefill slots by one budgeted chunk and then runs the normal decode
+tick for everyone else, so a long prompt never stalls in-flight decodes
+for more than the one tick its chunk shares.
 
 Decode flow per tick:
   1. admit queued requests into free slots while pages last (FIFO;
-     preempted requests re-enter ahead of the queue)
-  2. grow: slots whose next write position crosses a page boundary
-     allocate a page, preempting under pressure
-  3. one jitted paged decode step for the whole slot pool (idle slots
-     write their garbage row into the null page)
-  4. sample, append, finish/free
+     preempted requests re-enter ahead of the queue); admission maps
+     prefix-cache hits and allocates the remaining pages — no model run
+  2. grow: decoding slots whose next write position crosses a page
+     boundary allocate a page, preempting under pressure
+  3. one budgeted prefill chunk per mid-prefill slot (last chunk samples
+     the first token)
+  4. one jitted paged decode step for the decoding slots (idle and
+     mid-prefill slots write their garbage row into the null page)
+  5. sample, append, publish freshly filled pages to the prefix cache,
+     finish/free
 """
 
 from __future__ import annotations
@@ -29,7 +48,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from flexflow_tpu.paged.pool import PagePool
+from flexflow_tpu.paged.pool import EMPTY_HASH, PagePool
 from flexflow_tpu.serving import _GenerationServerBase, _GenRequest
 
 
@@ -38,12 +57,14 @@ class PagedGenerationServer(_GenerationServerBase):
     (serve_generation(..., paged=True)). Same public surface and sampling
     as the dense GenerationServer; HBM scales with the page pool instead
     of slots x max_len, so short sequences leave room to admit more
-    concurrent work than the dense layout could hold."""
+    concurrent work than the dense layout could hold, and shared prompt
+    prefixes (system prompts, few-shot headers) are stored ONCE."""
 
     def __init__(self, ff, slots: int = 4, max_len: int = 512,
                  eos_id: Optional[int] = None, seed: int = 0,
                  page_size: int = 64, num_pages: Optional[int] = None,
-                 preemption: bool = True, table_slack_tokens: int = 0):
+                 preemption: bool = True, table_slack_tokens: int = 0,
+                 prefix_cache: bool = True, prefill_chunk: int = 64):
         import jax
 
         super().__init__(ff, slots, max_len, eos_id, seed)
@@ -55,9 +76,6 @@ class PagedGenerationServer(_GenerationServerBase):
         self.table_slack = int(table_slack_tokens)
         self.max_pages_per_seq = -(
             -(self.max_len + self.table_slack) // self.page_size)
-        # prefill runs through the DENSE one-slot cache, page-aligned so
-        # its rows reshape straight into (max_pages, page_size) pages
-        self._prefill_len = self.max_pages_per_seq * self.page_size
         if num_pages is None:
             # default pool matches the dense layout's capacity (+ null
             # page); size it DOWN to oversubscribe slots against HBM
@@ -65,11 +83,14 @@ class PagedGenerationServer(_GenerationServerBase):
         self.pool = PagePool(num_pages, self.page_size,
                              self.max_pages_per_seq)
         self.preemption = bool(preemption)
+        self.prefix_cache = bool(prefix_cache)
+        self.prefill_chunk = max(1, int(prefill_chunk))
         ex = ff.executor
         self._step = ex.paged_decode_fn()
-        self._prefill_step = ex.decode_fn()
+        # chunked prefill writes K/V straight into pool pages — there is
+        # no dense staging cache and no post-prefill page scatter
+        self._chunk_step = ex.chunked_prefill_fn()
         self._caches = ex.init_paged_kv_cache(num_pages, self.page_size)
-        self._prefill_caches = ex.init_kv_cache(1, self._prefill_len)
         self._tables = np.zeros((self.slots, self.max_pages_per_seq),
                                 np.int32)
         self._admit_order: List[int] = []  # live slots, oldest first
@@ -78,20 +99,16 @@ class PagedGenerationServer(_GenerationServerBase):
         self.preemptions = 0
         self.defrags = 0
         self.peak_active = 0
-
-        mpps, P = self.max_pages_per_seq, self.page_size
+        self.prefill_ticks = 0
+        self._prefill_rr = 0  # rotating start slot for the chunk budget
 
         @jax.jit
-        def scatter_pages(pool_buf, rows, page_ids):
-            # rows: (1, prefill_len, Hkv, D) dense prefill cache; the
-            # first len(page_ids) page-sized row blocks land on the
-            # request's pages (page_ids length is static per prompt-page
-            # count, so this compiles once per count, like the dense
-            # server's bucketed prefill)
-            full = rows[0].reshape(mpps, P, *rows.shape[2:])
-            return pool_buf.at[page_ids].set(full[: page_ids.shape[0]])
+        def copy_page(caches, src, dst):
+            # copy-on-write: clone one pool page (every cache buffer) so
+            # a new owner can write past a shared partial prefix
+            return jax.tree.map(lambda b: b.at[dst].set(b[src]), caches)
 
-        self._scatter_pages = scatter_pages
+        self._copy_page = copy_page
         self._start()
 
     # -- capacity ---------------------------------------------------------
@@ -114,15 +131,31 @@ class PagedGenerationServer(_GenerationServerBase):
 
     def metrics(self) -> dict:
         """Aggregate serving metrics + the per-request records of the
-        last MAX_REQUEST_RECORDS completed requests (queue time,
-        prefill/decode tokens, pages — see _GenerationServerBase)."""
+        last MAX_REQUEST_RECORDS completed requests (queue time, TTFT,
+        prefill/decode tokens, pages — see _GenerationServerBase), plus
+        pool occupancy/fragmentation and the prefix-cache counters (what
+        the /v2/models/<name>/metrics endpoint scrapes)."""
         m = super().metrics()
+        pool = self.pool
         m.update({
             "preemptions": self.preemptions,
             "defrags": self.defrags,
             "peak_active": self.peak_active,
-            "pages_in_use": self.pool.pages_in_use,
-            "free_pages": self.pool.free_pages,
+            "pages_in_use": pool.pages_in_use,
+            "free_pages": pool.free_pages,
+            "cached_pages": pool.cached_pages,
+            "pool_occupancy": pool.pages_in_use / pool.capacity,
+            "fragmentation": pool.fragmentation(),
+            "prefill_ticks": self.prefill_ticks,
+            "prefix_cache": {
+                "enabled": self.prefix_cache,
+                "hit_tokens": pool.hit_tokens,
+                "miss_tokens": pool.lookup_tokens - pool.hit_tokens,
+                "lookup_tokens": pool.lookup_tokens,
+                "hits": pool.hits,
+                "misses": pool.misses,
+                "evictions": pool.evictions,
+            },
         })
         return m
 
@@ -131,11 +164,59 @@ class PagedGenerationServer(_GenerationServerBase):
         bookkeeping + one device gather per cache buffer)."""
         self._defrag_req.set()
 
+    # -- prefix-cache publication -----------------------------------------
+
+    def _publish_prefix(self, req: _GenRequest, valid_rows: int):
+        """Register every freshly FILLED page (all page_size rows hold
+        committed K/V) under its token-prefix chain hash, so concurrent
+        and future requests sharing the prefix map it instead of
+        recomputing. Cheap no-op until a page boundary is crossed."""
+        if not self.prefix_cache:
+            return
+        P = self.page_size
+        target = min(valid_rows // P, len(req.pages))
+        if req.hashed_blocks >= target:
+            return
+        seq = req.seq_tokens()
+        chain = self.pool.chain_hashes(seq[:target * P])
+        for b in range(req.hashed_blocks, target):
+            self.pool.register_full(req.pages[b], chain[b])
+        req.hashed_blocks = target
+
+    def _publish_tail(self, req: _GenRequest):
+        """On release/preemption: publish the remaining full pages and
+        the partially filled tail page, so a resume (or an identical
+        prompt) re-attaches these rows instead of recomputing them."""
+        if not self.prefix_cache or not req.pages:
+            return
+        P = self.page_size
+        valid = max(req.pos, req.prefill_pos)
+        self._publish_prefix(req, valid)
+        full = req.hashed_blocks
+        tail = valid - full * P
+        if tail > 0 and full < len(req.pages):
+            seq = req.seq_tokens()
+            chain = self.pool.chain_hashes(seq[:full * P])
+            parent = chain[-1] if chain else EMPTY_HASH
+            self.pool.register_partial(req.pages[full], parent,
+                                       seq[full * P:valid])
+
     # -- slot lifecycle ---------------------------------------------------
+
+    def _reset_prefill_state(self, req: _GenRequest):
+        req.pos = 0
+        req.prefill_pos = 0
+        req.prefill_target = 0
+        req.prefill_seq = None
+        req.hashed_blocks = 0
 
     def _release_slot(self, slot: int, req: _GenRequest,
                       completed: bool = False):
-        self.pool.free(req.pages)
+        self._publish_tail(req)
+        # free LEAF-first: a chain lookup stops at its first missing
+        # block, so under pressure the LRU must reclaim tail pages before
+        # the roots that every shared prefix runs through
+        self.pool.free(list(reversed(req.pages)))
         req.pages = []
         self._tables[slot] = 0
         if slot in self._admit_order:
@@ -144,13 +225,16 @@ class PagedGenerationServer(_GenerationServerBase):
 
     def _evict(self, slot: int):
         """Preempt: free the victim's pages and requeue it (front); its
-        future stays pending and its re-prefill recomputes the freed K/V
-        from prompt + generated prefix (req.seq_tokens() — the prompt
-        itself is never mutated, so repeated preemptions of the same
-        request cannot double-fold the prefix)."""
+        future stays pending. With the prefix cache on, the freed pages
+        stay content-addressed on the LRU dead list, so the resume
+        re-attaches them and recomputes only whatever was evicted in
+        between (req.seq_tokens() — the prompt itself is never mutated,
+        so repeated preemptions cannot double-fold the prefix)."""
         req = self._active[slot]
-        self.pool.free(req.pages)
+        self._publish_tail(req)
+        self.pool.free(list(reversed(req.pages)))  # leaf-first (see above)
         req.pages = []
+        self._reset_prefill_state(req)
         self._tables[slot] = 0
         self._active[slot] = None
         if slot in self._admit_order:
@@ -159,31 +243,80 @@ class PagedGenerationServer(_GenerationServerBase):
         self.preemptions += 1
         self._requeue.insert(0, req)
 
-    def _admit(self, req: _GenRequest, slot: int):
-        """Allocate the prompt's pages, then the shared bucketed prefill
-        (_admit_common) with a page-scatter instead of a slot-scatter."""
-        import jax
+    def _admit(self, req: _GenRequest, slot: int) -> bool:
+        """Map the longest cached prefix (shared full pages by refcount,
+        copy-on-write clone of a matched partial tail), allocate private
+        pages for the rest, and queue the uncached suffix for CHUNKED
+        prefill. No model step runs here — prefill happens inside the
+        decode loop, one budgeted chunk per tick."""
         import jax.numpy as jnp
 
-        n = len(req.seq_tokens())
-        pages = self.pool.alloc(self.pool.pages_for(n), owner=slot)
-        ids = jnp.asarray(np.asarray(pages, np.int32))
-
-        def scatter(upd):
-            for key, rows in upd.items():
-                self._caches[key] = jax.tree.map(
-                    lambda buf, r: self._scatter_pages(buf, r, ids),
-                    self._caches[key], rows)
-
+        seq = req.seq_tokens()
+        n = len(seq)
+        P = self.page_size
+        shared: List[int] = []
+        cached = 0
+        cow = None
+        if self.prefix_cache:
+            shared, cached, cow = self.pool.lookup(seq)
+        # always recompute at least the LAST prompt token: its forward
+        # pass produces the first sampled token's distribution (the
+        # cache stores K/V, not logits)
+        start = min(cached, n - 1)
+        b0 = start // P            # first block this request writes into
+        keep = shared[:b0]
+        # a shared page at/after the write boundary must be cloned before
+        # we write into it: the partial-tail donor, or — page-aligned
+        # full-prompt hit — the last matched full page
+        cow_src = cow if cow is not None else (
+            shared[b0] if b0 < len(shared) else None)
+        # start >= len(shared)*P - 1, so b0 >= len(shared) - 1: lookup
+        # can never return full pages past the write boundary
+        assert not shared[b0 + 1:], (shared, b0, cached, n)
+        total = self.pool.pages_for(n)
+        fresh = self.pool.alloc(total - b0)
+        if fresh is None:
+            # transient shortfall (LRU revival vs the conservative gate):
+            # drop every cache hit and retry as a full recompute, and
+            # roll the pool's hit counters back — these tokens end up
+            # recomputed, not served from cache
+            self.pool.free(keep + ([cow_src] if cow_src is not None
+                                   else []))
+            if cached > 0:
+                self.pool.hit_tokens -= cached
+                self.pool.hits -= 1
+                self.pool.misses += 1
+            shared, keep, cached, cow_src = [], [], 0, None
+            start, b0 = 0, 0
+            fresh = self.pool.alloc(total)
+            if fresh is None:
+                self._push_back(req)
+                return False
+        if cached > start:
+            # full-prompt hit: the clamped last prompt token is
+            # recomputed for its logits, not served — keep the pool's
+            # hit_tokens in step with the per-request counters
+            self.pool.hit_tokens -= cached - start
+        pages = keep + fresh
         req.pages = pages
         req.peak_pages = max(req.peak_pages, len(pages))
-        self._admit_common(req, slot,
-                           min(self._bucket(n), self._prefill_len),
-                           scatter)
         self._tables[slot] = 0
         self._tables[slot, :len(pages)] = pages
+        if cow_src is not None:
+            self._caches = self._copy_page(
+                self._caches, jnp.asarray(cow_src, jnp.int32),
+                jnp.asarray(pages[b0], jnp.int32))
+            self.pool.free([cow_src])
+        req.prefill_seq = seq
+        req.prefill_pos = start
+        req.prefill_target = n
+        req.pos = 0
+        req.hashed_blocks = min(b0, n // P)
+        req.cached_prefill_tokens += start
+        req.admit_t = time.monotonic()
+        self._active[slot] = req
         self._admit_order.append(slot)
-        self._finish_if_done(slot)
+        return True
 
     def _pop_next(self) -> Optional[_GenRequest]:
         if self._requeue:
@@ -201,7 +334,8 @@ class PagedGenerationServer(_GenerationServerBase):
     def _pages_target(self, req: _GenRequest) -> int:
         """Pages a live slot must hold BEFORE the next tick (subclass
         hook: speculative verify needs its whole tree's rows covered, not
-        just the next write position)."""
+        just the next write position). Mid-prefill slots already hold
+        their prompt's pages (pos is 0 until prefill completes)."""
         return min(self.pool.pages_for(req.pos + 1), self.max_pages_per_seq)
 
     def _ensure_pages(self):
@@ -216,7 +350,7 @@ class PagedGenerationServer(_GenerationServerBase):
                 continue
             target = self._pages_target(req)
             while req is self._active[slot] and len(req.pages) < target:
-                got = self.pool.alloc(1, owner=slot)
+                got = self.pool.alloc(1)
                 if got is not None:
                     req.pages.append(got[0])
                     req.peak_pages = max(req.peak_pages, len(req.pages))
@@ -237,6 +371,11 @@ class PagedGenerationServer(_GenerationServerBase):
             key: jax.tree.map(lambda b: b[perm], bufs)
             for key, bufs in self._caches.items()
         }
+        # EVERY owner's table: the (slots, max_pages) matrix rewrite
+        # covers every live slot (decoding and mid-prefill alike); shared
+        # pages get the same new id in every owner's row because
+        # old_to_new is one global map. The pool rewrote the hash index
+        # and LRU inside defrag().
         self._tables = old_to_new[self._tables]
         for s in self._admit_order:
             req = self._active[s]
@@ -250,8 +389,10 @@ class PagedGenerationServer(_GenerationServerBase):
         """Free pages required before admitting `req`: the prompt's rows
         PLUS the first decode tick's write row (an exact-page-multiple
         prompt would otherwise admit and immediately preempt for its
-        first tick's page). Subclass hook: speculative verify instead
-        requires the whole first verify tree to fit."""
+        first tick's page). Conservative: prefix-cache hits can only
+        reduce what admission actually allocates. Subclass hook:
+        speculative verify instead requires the whole first verify tree
+        to fit."""
         return self.pool.pages_for(len(req.seq_tokens()) + 1)
 
     def _outstanding_growth(self) -> int:
@@ -282,18 +423,36 @@ class PagedGenerationServer(_GenerationServerBase):
                     > self.pool.free_pages):
                 self._push_back(req)
                 break
-            self._admit(req, slot)
+            if not self._admit(req, slot):
+                break
             admitted = True
         return admitted
 
     def _live(self) -> List[int]:
         return [s for s in range(self.slots) if self._active[s] is not None]
 
+    def _mid_prefill(self, slot: int) -> bool:
+        req = self._active[slot]
+        return req is not None and req.prefill_pos < req.prefill_target
+
+    def _decode_table(self) -> np.ndarray:
+        """Device table for a decode/verify tick: mid-prefill slots' rows
+        are NULLED so the fixed-shape batched step's write row for them
+        lands in the null page instead of their real, partially filled
+        pages (the step writes a K/V row for every slot, live or not)."""
+        pre = [s for s in self._admit_order if self._mid_prefill(s)]
+        if not pre:
+            return self._tables
+        t = self._tables.copy()
+        t[pre] = 0
+        return t
+
     def _tick_prep(self) -> Optional[List[int]]:
         """Shared tick prologue (base and speculative loops): defrag if
-        requested, admit, grow pages. Returns the live slots to decode,
-        or None when this tick should be skipped (nothing live; sleeps
-        briefly when nothing was admitted either)."""
+        requested, admit, grow pages. Returns the live slots (decoding
+        AND mid-prefill), or None when this tick should be skipped
+        (nothing live; sleeps briefly when nothing was admitted
+        either)."""
         if self._defrag_req.is_set():
             self._defrag_req.clear()
             self._apply_defrag()
@@ -307,17 +466,74 @@ class PagedGenerationServer(_GenerationServerBase):
         self._ensure_pages()  # may preempt: recompute live after
         return self._live() or None
 
+    def _split_live(self, live):
+        """(mid-prefill slots, decoding slots) for this tick."""
+        pre = [s for s in live if self._mid_prefill(s)]
+        dec = [s for s in live if not self._mid_prefill(s)]
+        return pre, dec
+
+    def _prefill_tick(self, slots, tr, ntr):
+        """Advance mid-prefill slots by chunks, at most `prefill_chunk`
+        tokens ACROSS the tick (a shared Sarathi-style token budget —
+        it bounds the tick's prefill FLOPs, protecting decode latency),
+        writing K/V straight into their pool pages. The start slot
+        rotates tick to tick so a long prompt cannot starve a later
+        slot's prefill out of the budget indefinitely. The chunk
+        finishing a prompt samples the request's first token from its
+        own last-row logits — the same rng/_pick discipline as the
+        dense server's admission prefill."""
+        import jax.numpy as jnp
+
+        budget = self.prefill_chunk
+        self.prefill_ticks += 1
+        rot = self._prefill_rr % len(slots)
+        self._prefill_rr += 1
+        slots = slots[rot:] + slots[:rot]
+        for s in slots:  # fflint: host-ok (one chunk per prefilling slot per tick, not per token)
+            if budget <= 0:
+                break
+            req = self._active[s]
+            n = req.prefill_target
+            take = min(budget, n - req.prefill_pos)
+            bucket = self._bucket(take)
+            chunk = np.zeros((1, bucket), np.int32)
+            chunk[0, :take] = req.prefill_seq[
+                req.prefill_pos:req.prefill_pos + take]
+            probs, upd = self._chunk_step(
+                tr, ntr, self._caches,
+                jnp.asarray(self._tables[s:s + 1]),
+                jnp.asarray(np.array([req.prefill_pos], np.int32)),
+                jnp.asarray(chunk))
+            self._caches = upd
+            req.prefill_pos += take
+            req.prefill_tokens += take
+            budget -= take
+            self._publish_prefix(req, req.prefill_pos)
+            if req.prefill_pos >= n:
+                # publish the PROMPT's partial tail now, before decode
+                # appends rows to the same page: the entry only names
+                # rows [0, tail) and those are immutable, so an
+                # identical or extending prompt can COW-clone this page
+                # while this request keeps decoding into it (the first
+                # token is appended below, so seq_tokens() still equals
+                # prefill_seq here)
+                self._publish_tail(req)
+                self._sample_first_token(s, req, probs[:, take - 1, :])
+                self._finish_if_done(s)
+
     def _decode_tick(self, live, tr, ntr):
-        """One plain single-token decode tick for the whole slot pool
+        """One plain single-token decode tick for the decoding slots
         (also dispatched by the speculative server when no live slot can
-        use a tree — all-sampled ticks skip the tree-verify FLOPs)."""
+        use a tree — all-sampled ticks skip the tree-verify FLOPs).
+        Mid-prefill slots ride along with nulled table rows (fixed-shape
+        program) and count the tick as decode/prefill overlap."""
         import jax
         import jax.numpy as jnp
 
         pos = np.array([self._active[s].pos if self._active[s] else 0
                         for s in range(self.slots)], np.int32)
         probs, upd = self._step(
-            tr, ntr, self._caches, jnp.asarray(self._tables),
+            tr, ntr, self._caches, jnp.asarray(self._decode_table()),
             jnp.asarray(pos), jnp.asarray(self._tokens)[:, None])
         self._caches = upd
         temps = np.array(
@@ -327,11 +543,15 @@ class PagedGenerationServer(_GenerationServerBase):
         toks = np.asarray(self._pick(probs[:, -1, :],
                                      jnp.asarray(temps), sub))
         self._steps += 1
+        for s in self._admit_order:
+            if self._mid_prefill(s):
+                self._active[s].decode_overlap_ticks += 1
         for s in live:
             req = self._active[s]
             req.pos += 1
             req.tokens.append(int(toks[s]))
             self._tokens[s] = toks[s]
+            self._publish_prefix(req, req.pos)
             self._finish_if_done(s)
 
     def _loop_body(self, tr, ntr):
@@ -339,7 +559,11 @@ class PagedGenerationServer(_GenerationServerBase):
             live = self._tick_prep()
             if live is None:
                 continue
-            self._decode_tick(live, tr, ntr)
+            pre, dec = self._split_live(live)
+            if pre:
+                self._prefill_tick(pre, tr, ntr)
+            if dec:
+                self._decode_tick(dec, tr, ntr)
 
     def _drain(self):
         super()._drain()

@@ -281,8 +281,16 @@ class Executor:
         return tr, ntr
 
     def init_params(self, rng, overrides: Optional[Dict] = None):
-        """Initialize (trainable, nontrainable) param pytrees, jitted with
-        output shardings so big weights materialize directly sharded.
+        """Initialize (trainable, nontrainable) param pytrees, resharding
+        each weight to its strategy NamedSharding as it is drawn. The
+        draws run UNPARTITIONED on purpose: under GSPMD a sharded
+        out_sharding partitions the threefry stream, and with the
+        non-partitionable RNG (jax < 0.5 default) a partitioned draw
+        produces DIFFERENT values than the replicated one — a sharded
+        model would train/decode from different weights than the
+        unsharded reference (seed failure: test_decode_sp_pp token
+        identity). Values first, layout second — leaf by leaf, so the
+        whole model never resides unsharded on one device.
         `overrides` maps node_key -> weight name -> Initializer (the layer
         methods' kernel_initializer arguments)."""
         specs = self.weight_specs()
@@ -295,25 +303,26 @@ class Executor:
                 keys[(nk, wn)] = i
                 i += 1
 
-        def build(rng):
-            tr, ntr = {}, {}
-            for nk, ws in specs.items():
-                for wn, spec in ws.items():
-                    ini = overrides.get(nk, {}).get(wn) or init_mod.resolve(
-                        spec.initializer
-                    )
-                    sub = jax.random.fold_in(rng, keys[(nk, wn)])
-                    # master weights in fp32 (bf16 cast happens at use site)
-                    dtype = spec.shape.dtype.jnp_dtype
-                    if dtype == jnp.bfloat16 or dtype == jnp.float16:
-                        dtype = jnp.float32
-                    arr = ini(sub, spec.shape.dims, dtype)
-                    d = tr if spec.trainable else ntr
-                    d.setdefault(nk, {})[wn] = arr
-            return tr, ntr
-
+        # one weight at a time: the unsharded draw lives only until its
+        # device_put reshards it, so peak memory is the sharded tree plus
+        # ONE full leaf — never the whole model on one device
         tr_sh, ntr_sh = self.param_shardings()
-        return jax.jit(build, out_shardings=(tr_sh, ntr_sh))(rng)
+        tr, ntr = {}, {}
+        for nk, ws in specs.items():
+            for wn, spec in ws.items():
+                ini = overrides.get(nk, {}).get(wn) or init_mod.resolve(
+                    spec.initializer
+                )
+                sub = jax.random.fold_in(rng, keys[(nk, wn)])
+                # master weights in fp32 (bf16 cast happens at use site)
+                dtype = spec.shape.dtype.jnp_dtype
+                if dtype == jnp.bfloat16 or dtype == jnp.float16:
+                    dtype = jnp.float32
+                arr = ini(sub, spec.shape.dims, dtype)
+                sh = (tr_sh if spec.trainable else ntr_sh)[nk][wn]
+                d = tr if spec.trainable else ntr
+                d.setdefault(nk, {})[wn] = jax.device_put(arr, sh)
+        return tr, ntr
 
     # ------------------------------------------------------------------
     # optimizer state (ZeRO-1 sharding)
@@ -748,6 +757,23 @@ class Executor:
 
         self._paged_decode_fn = jax.jit(step)
         return self._paged_decode_fn
+
+    def chunked_prefill_fn(self):
+        """jitted (params, pools, page_table_row, pos, ids) ->
+        (probs, new_pools): one PREFILL CHUNK written straight into pool
+        pages (flexflow_tpu.paged chunked prefill — no dense staging
+        cache, no scatter afterwards). `ids` is (1, C) — C prompt tokens
+        of a single request starting at absolute position `pos` (a (1,)
+        vector) — and `page_table_row` the request's (1, max_pages)
+        table. Rows land at pos + i through the table; attention masks
+        kpos <= qpos, so each chunk sees the pages earlier chunks (or
+        prefix-cache hits) already populated. Compiled once per chunk
+        bucket; the table shape is fixed, so admission order never
+        recompiles it. Chunks with C=1 are exactly one decode step —
+        it IS the paged decode callable (one traced program per input
+        shape; the paged lowering handles S=1 and S>1 alike), named
+        separately only for the call-site contract above."""
+        return self.paged_decode_fn()
 
     def verify_fn(self):
         """jitted (params, pools, page_tables, pos, depths, tree_mask,

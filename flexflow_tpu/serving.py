@@ -366,7 +366,9 @@ class _GenRequest:
     __slots__ = ("prompt", "max_new", "temperature", "future", "tokens",
                  "pos", "pages", "submit_t", "admit_t", "prefill_tokens",
                  "peak_pages", "preemptions", "spec_steps", "spec_drafted",
-                 "spec_accepted", "spec_emitted")
+                 "spec_accepted", "spec_emitted", "first_token_t",
+                 "cached_prefill_tokens", "prefill_pos", "prefill_target",
+                 "prefill_seq", "hashed_blocks", "decode_overlap_ticks")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -379,9 +381,22 @@ class _GenRequest:
         self.pages: List[int] = []      # pool pages held (paged only)
         self.submit_t = time.monotonic()
         self.admit_t: Optional[float] = None
-        self.prefill_tokens = 0
+        self.first_token_t: Optional[float] = None  # TTFT stamp
+        self.prefill_tokens = 0         # prompt rows actually COMPUTED
+        self.cached_prefill_tokens = 0  # prompt rows served by the cache
         self.peak_pages = 0
         self.preemptions = 0
+        # chunked-prefill progress (paged scheduler): rows [0, prefill_pos)
+        # of prefill_seq hold valid K/V; the slot decodes only once
+        # prefill_pos reaches prefill_target. hashed_blocks counts the
+        # full pages already published to the prefix cache (the hash
+        # chain is re-derived from seq_tokens(), so no hasher state
+        # survives preemption).
+        self.prefill_pos = 0
+        self.prefill_target = 0
+        self.prefill_seq: Optional[np.ndarray] = None
+        self.hashed_blocks = 0
+        self.decode_overlap_ticks = 0   # decode ticks run mid-prefill
         # speculative decoding (flexflow_tpu.spec): verify steps run for
         # this request, draft tokens proposed/accepted, tokens emitted
         self.spec_steps = 0
@@ -406,10 +421,14 @@ class _GenRequest:
         m = {
             "queue_time_s": (self.admit_t - self.submit_t
                              if self.admit_t is not None else None),
+            "ttft_s": (self.first_token_t - self.submit_t
+                       if self.first_token_t is not None else None),
             "prefill_tokens": self.prefill_tokens,
+            "cached_prefill_tokens": self.cached_prefill_tokens,
             "decode_tokens": len(self.tokens),
             "pages_held_peak": self.peak_pages,
             "preemptions": self.preemptions,
+            "decode_overlap_ticks": self.decode_overlap_ticks,
         }
         if self.spec_steps:
             m.update({
@@ -554,6 +573,25 @@ class _GenerationServerBase:
             b *= 2
         return b
 
+    def _sample_first_token(self, slot: int, req: _GenRequest, row_probs):
+        """Pick a request's FIRST token from its last real prompt row's
+        probs, append it, and stamp TTFT — ONE implementation shared by
+        the dense admission prefill and the paged chunked prefill, so
+        the rng/_pick discipline (and with it greedy dense-vs-paged
+        token identity) can never drift."""
+        import jax
+        import jax.numpy as jnp
+
+        self._rng, sub = jax.random.split(self._rng)
+        tok = int(np.asarray(self._pick(
+            row_probs, jnp.full((1,), req.temperature, jnp.float32),
+            sub))[0])
+        req.pos = len(req.seq_tokens())  # before the append below
+        req.tokens.append(tok)
+        self._tokens[slot] = tok
+        if req.first_token_t is None:
+            req.first_token_t = time.monotonic()
+
     def _admit_common(self, req: _GenRequest, slot: int, padded_len: int,
                       scatter_rows):
         """Bucketed prefill + first-token sample, shared by the dense and
@@ -563,7 +601,6 @@ class _GenerationServerBase:
         the prefill K/V rows to `scatter_rows` (dense slot-scatter or
         paged page-scatter), pick the first token from the last REAL
         prompt position, and stamp the request's admission bookkeeping."""
-        import jax
         import jax.numpy as jnp
 
         tr, ntr = self._params
@@ -574,15 +611,9 @@ class _GenerationServerBase:
         probs, upd = self._prefill_step(tr, ntr, self._prefill_caches, 0,
                                         jnp.asarray(padded))
         scatter_rows(upd)
-        self._rng, sub = jax.random.split(self._rng)
-        tok = int(np.asarray(self._pick(
-            probs[:, n - 1, :],
-            jnp.full((1,), req.temperature, jnp.float32), sub))[0])
         req.admit_t = time.monotonic()
-        req.prefill_tokens = n
-        req.pos = n
-        req.tokens.append(tok)
-        self._tokens[slot] = tok
+        req.prefill_tokens += n
+        self._sample_first_token(slot, req, probs[:, n - 1, :])
         self._active[slot] = req
 
     def _release_slot(self, slot: int, req: _GenRequest,
@@ -743,6 +774,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                      paged: bool = False, page_size: int = 64,
                      num_pages: Optional[int] = None,
                      preemption: bool = True,
+                     prefix_cache: bool = True,
+                     prefill_chunk: int = 64,
                      speculate=None) -> "_GenerationServerBase":
     """Continuous-batching generation endpoint over a compiled causal-LM
     FFModel (KV-cache decode path required — see FFModel.generate).
@@ -754,6 +787,16 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
     preempts+requeues the youngest request (`preemption=False` queues
     instead). Dense and paged paths share sampling, the position-table
     guard, and the submit/stop contract.
+
+    `prefix_cache=True` (paged only) content-addresses pool pages by a
+    hash chain over page-aligned token blocks: requests sharing a prompt
+    prefix map the SAME physical pages (refcounted; copy-on-write on a
+    shared partial tail), completed/preempted requests leave their pages
+    behind as LRU-cached hits, and only the uncached suffix is computed.
+    Prefill runs CHUNKED inside the decode loop — at most
+    `prefill_chunk` prompt tokens per tick — so long prompts admit
+    without stalling in-flight decodes. Greedy output is token-identical
+    with the cache on or off.
 
     `speculate=SpecConfig(...)` (requires paged=True) turns each decode
     tick into a speculative TREE-VERIFY step (flexflow_tpu.spec): a
@@ -771,12 +814,14 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
         return SpeculativePagedServer(
             ff, speculate, slots=slots, max_len=max_len, eos_id=eos_id,
             seed=seed, page_size=page_size, num_pages=num_pages,
-            preemption=preemption)
+            preemption=preemption, prefix_cache=prefix_cache,
+            prefill_chunk=prefill_chunk)
     if paged:
         from flexflow_tpu.paged.scheduler import PagedGenerationServer
 
         return PagedGenerationServer(
             ff, slots=slots, max_len=max_len, eos_id=eos_id, seed=seed,
-            page_size=page_size, num_pages=num_pages, preemption=preemption)
+            page_size=page_size, num_pages=num_pages, preemption=preemption,
+            prefix_cache=prefix_cache, prefill_chunk=prefill_chunk)
     return GenerationServer(ff, slots=slots, max_len=max_len, eos_id=eos_id,
                             seed=seed)

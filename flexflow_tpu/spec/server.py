@@ -46,7 +46,8 @@ class SpeculativePagedServer(PagedGenerationServer):
     def __init__(self, ff, spec: SpecConfig, slots: int = 4,
                  max_len: int = 512, eos_id: Optional[int] = None,
                  seed: int = 0, page_size: int = 64,
-                 num_pages: Optional[int] = None, preemption: bool = True):
+                 num_pages: Optional[int] = None, preemption: bool = True,
+                 prefix_cache: bool = True, prefill_chunk: int = 64):
         if not isinstance(spec, SpecConfig):
             raise TypeError(
                 f"speculate must be a SpecConfig, got {type(spec).__name__}")
@@ -64,7 +65,9 @@ class SpeculativePagedServer(PagedGenerationServer):
         super().__init__(ff, slots=slots, max_len=max_len, eos_id=eos_id,
                          seed=seed, page_size=page_size,
                          num_pages=num_pages, preemption=preemption,
-                         table_slack_tokens=spec.max_nodes)
+                         table_slack_tokens=spec.max_nodes,
+                         prefix_cache=prefix_cache,
+                         prefill_chunk=prefill_chunk)
 
     # -- page accounting: the tree's scratch rows count --------------------
 
@@ -122,6 +125,15 @@ class SpeculativePagedServer(PagedGenerationServer):
             live = self._tick_prep()
             if live is None:
                 continue
+            # chunked prefill rides the same tick structure as the base
+            # loop: mid-prefill slots advance one budgeted chunk, then
+            # the decoding slots verify — a long prompt never stalls
+            # in-flight speculation for more than the shared tick
+            pre, live = self._split_live(live)
+            if pre:
+                self._prefill_tick(pre, tr, ntr)
+            if not live:
+                continue
             if all(self._active[s].temperature > 0.0 for s in live):
                 # nothing to speculate on: sampled requests take one
                 # token per step either way, so dispatch the plain
@@ -159,11 +171,17 @@ class SpeculativePagedServer(PagedGenerationServer):
             pos = np.array([self._active[s].pos if self._active[s] else 0
                             for s in range(self.slots)], np.int32)
 
+            # _decode_table nulls mid-prefill slots' rows: the verify
+            # writes T scratch rows for EVERY slot, and a mid-prefill
+            # slot's must land in the null page, not its real pages
             probs, upd = self._verify(
-                tr, ntr, self._caches, jnp.asarray(self._tables),  # fflint: host-ok (per-tick batch transfer)
+                tr, ntr, self._caches, jnp.asarray(self._decode_table()),  # fflint: host-ok (per-tick batch transfer)
                 jnp.asarray(pos), jnp.asarray(depths), jnp.asarray(anc),  # fflint: host-ok (per-tick batch transfer)
                 jnp.asarray(tokens))  # fflint: host-ok (per-tick batch transfer)
             self._caches = upd
+            for s in self._admit_order:
+                if self._mid_prefill(s):
+                    self._active[s].decode_overlap_ticks += 1
 
             # accept: greedy argmax walk. Both reductions run ON DEVICE —
             # per-node argmaxes for the walk and the root row's _pick for
@@ -219,4 +237,9 @@ class SpeculativePagedServer(PagedGenerationServer):
                                         jnp.asarray(src),  # fflint: host-ok (per-tick batch transfer)
                                         jnp.asarray(dst))  # fflint: host-ok (per-tick batch transfer)
             for s in live:
+                # publish AFTER the commit: only rows below the advanced
+                # write head are committed K/V — tree scratch rows past
+                # it must never reach the prefix cache (the tree-slack
+                # pages stay private until pos actually crosses them)
+                self._publish_prefix(self._active[s], self._active[s].pos)
                 self._finish_if_done(s)

@@ -6,6 +6,8 @@ and logits-identical at the decode-step level — the page indirection is
 a memory layout, never a numerics change.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -32,8 +34,8 @@ def _causal_lm(kv_heads=2, seed=7):
 def test_page_pool_alloc_free_accounting():
     pool = PagePool(num_pages=8, page_size=4, max_pages_per_seq=4)
     assert pool.capacity == 7 and pool.free_pages == 7
-    a = pool.alloc(3, owner=0)
-    b = pool.alloc(2, owner=1)
+    a = pool.alloc(3)
+    b = pool.alloc(2)
     assert len(a) == 3 and len(b) == 2 and 0 not in a + b  # null reserved
     assert pool.free_pages == 2 and pool.pages_in_use == 5
     assert pool.alloc(3) is None  # never partial
@@ -44,10 +46,94 @@ def test_page_pool_alloc_free_accounting():
     assert pool.pages_for(5) == 2
 
 
+def test_page_pool_refcount_and_lru_cache():
+    """Refcounted content-addressed pages: lookup maps shared pages and
+    bumps refs; free at ref 0 parks hashed pages on the LRU dead list
+    (still hittable); fresh allocation reclaims the oldest dead page and
+    drops its hash entry (a later lookup of that prefix misses)."""
+    pool = PagePool(num_pages=6, page_size=4, max_pages_per_seq=4)
+    toks = np.arange(8, dtype=np.int32)
+    chain = pool.chain_hashes(toks)
+    assert len(chain) == 2 and chain[0] != chain[1]
+    # deterministic: same tokens -> same chain (content addressing)
+    assert pool.chain_hashes(toks) == chain
+
+    a = pool.alloc(2)
+    pool.register_full(a[0], chain[0])
+    pool.register_full(a[1], chain[1])
+    # a second request sharing the prefix maps the SAME pages
+    pages, cached, cow = pool.lookup(toks)
+    assert pages == a and cached == 8 and cow is None
+    assert pool.refcount(a[0]) == 2
+    assert pool.pages_in_use == 2  # shared pages count once
+    pool.free(a)                   # first owner releases
+    assert pool.refcount(a[0]) == 1 and pool.pages_in_use == 2
+    pool.free(pages)               # second owner releases -> dead-cached
+    assert pool.pages_in_use == 0 and pool.cached_pages == 2
+    # still a cache hit while dead
+    pages2, cached2, _ = pool.lookup(toks)
+    assert pages2 == a and cached2 == 8 and pool.cached_pages == 0
+    pool.free(pages2)
+    # pressure reclaims the OLDEST dead page and unregisters it
+    grab = pool.alloc(5)
+    assert grab is not None and pool.evictions >= 1
+    p3, c3, _ = pool.lookup(toks)
+    assert c3 < 8  # the evicted block no longer hits
+    pool.free(p3)
+
+
+def test_page_pool_partial_tail_cow_lookup():
+    """A partially filled tail page registered under (parent hash, tail
+    tokens) is served as a copy-on-write donor: lookup pins it and
+    reports the matched tail rows; a diverging tail misses."""
+    pool = PagePool(num_pages=6, page_size=4, max_pages_per_seq=4)
+    toks = np.array([5, 6, 7, 8, 9, 10], np.int32)  # 1 full block + 2 tail
+    chain = pool.chain_hashes(toks)
+    pages = pool.alloc(2)
+    pool.register_full(pages[0], chain[0])
+    pool.register_partial(pages[1], chain[0], toks[4:])
+    pool.free(pages)
+    # identical prompt: full block + both tail rows, donor pinned
+    got, cached, cow = pool.lookup(toks)
+    assert got == [pages[0]] and cached == 6 and cow == pages[1]
+    assert pool.refcount(cow) == 1
+    pool.free(got + [cow])
+    # diverging tail: only the common prefix of the tail matches
+    div = np.array([5, 6, 7, 8, 9, 99], np.int32)
+    got, cached, cow = pool.lookup(div)
+    assert cached == 5 and cow == pages[1]
+    pool.free(got + [cow])
+    # diverging INSIDE the full block: nothing matches
+    miss = np.array([5, 6, 0, 8, 9, 10], np.int32)
+    got, cached, cow = pool.lookup(miss)
+    assert got == [] and cached == 0 and cow is None
+
+
+def test_page_pool_defrag_rewrites_hash_index():
+    """Defrag compacts live AND dead-cached pages and rewrites the
+    content-address index, so prefix hits survive the page moves."""
+    pool = PagePool(num_pages=10, page_size=4, max_pages_per_seq=4)
+    toks = np.arange(8, dtype=np.int32)
+    chain = pool.chain_hashes(toks)
+    scratch = pool.alloc(3)   # occupy low ids
+    pages = pool.alloc(2)
+    pool.register_full(pages[0], chain[0])
+    pool.register_full(pages[1], chain[1])
+    pool.free(scratch)                 # unregistered -> truly free
+    pool.free(pages)                   # dead-but-cached
+    perm, old_to_new = pool.defrag()
+    assert sorted(perm.tolist()) == list(range(10))
+    moved = [int(old_to_new[p]) for p in pages]
+    assert moved == [1, 2]             # compacted to the low end
+    got, cached, _ = pool.lookup(toks)
+    assert got == moved and cached == 8
+    pool.free(got)
+
+
 def test_page_pool_defrag_compacts_and_remaps():
     pool = PagePool(num_pages=10, page_size=4, max_pages_per_seq=4)
-    a = pool.alloc(2, owner=0)
-    b = pool.alloc(3, owner=1)
+    a = pool.alloc(2)
+    b = pool.alloc(3)
     pool.free(a)  # fragment: b's pages no longer contiguous from 1
     perm, old_to_new = pool.defrag()
     # b's pages land on 1..3, every old page appears exactly once in perm
@@ -59,7 +145,7 @@ def test_page_pool_defrag_compacts_and_remaps():
         assert perm[old_to_new[p]] == p
     assert pool.pages_in_use == 3 and pool.free_pages == 6
     # post-defrag allocations come from the compacted free set
-    c = pool.alloc(6, owner=2)
+    c = pool.alloc(6)
     assert c is not None and len(set(c) & {1, 2, 3}) == 0
 
 
@@ -341,6 +427,219 @@ def test_concurrent_submit_under_page_pressure():
     assert m["requests_served"] == len(prompts)
     assert m["pages_in_use"] == 0
     assert len(m["requests"]) == len(prompts)
+
+
+# ---------------------------------------------------------------------------
+# prefix caching + chunked prefill (ISSUE 5 tentpole)
+
+
+def test_shared_prefix_token_identity_and_hit_rate():
+    """≥3 concurrent requests sharing a system-prompt prefix emit the
+    dense-identical greedy tokens with the prefix cache ON and OFF, and
+    with it on, the second and later requests serve ≥50% of their
+    prompt rows from shared pages (the acceptance criterion)."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(11)
+    sys_prompt = rs.randint(0, lcfg.vocab_size, (8,)).astype(np.int32)
+    prompts = [np.concatenate([sys_prompt,
+                               rs.randint(0, lcfg.vocab_size, (3,))
+                               .astype(np.int32)])
+               for _ in range(4)]
+    want = [ff.generate(p[None, :], max_new_tokens=6)[0] for p in prompts]
+    for cache in (True, False):
+        server = ff.serve_generation(slots=4, max_len=32, paged=True,
+                                     page_size=4, prefix_cache=cache)
+        try:
+            # first request warms the shared blocks (registration happens
+            # as its chunks complete, so same-tick admissions can't hit)
+            first = server.submit(prompts[0], max_new_tokens=6)
+            first.result(timeout=120)
+            futs = [server.submit(p, max_new_tokens=6)
+                    for p in prompts[1:]]
+            got = [np.asarray(first.result())] + \
+                  [f.result(timeout=120) for f in futs]
+            m = server.metrics()
+        finally:
+            server.stop()
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+        pc = m["prefix_cache"]
+        if cache:
+            # the 8-token system prompt is 2 full pages: every later
+            # request serves >= 8 of its 11 prompt rows from the cache
+            later = [r for r in m["requests"]
+                     if r["cached_prefill_tokens"] > 0]
+            assert len(later) >= 3, m["requests"]
+            for r in later:
+                frac = r["cached_prefill_tokens"] / (
+                    r["cached_prefill_tokens"] + r["prefill_tokens"])
+                assert frac >= 0.5, r
+            assert pc["hit_tokens"] >= 3 * 8
+        else:
+            assert not pc["enabled"] and pc["hit_tokens"] == 0
+            assert all(r["cached_prefill_tokens"] == 0
+                       for r in m["requests"])
+
+
+def test_prefix_cache_cow_divergence_after_shared_prefix():
+    """Copy-on-write on the partially filled tail page: a request whose
+    prompt extends a cached prompt past a mid-page boundary clones the
+    donor page before writing its own rows — both the extended request
+    and a fresh re-run of the ORIGINAL prompt stay dense-identical, and
+    the tail rows count as cache hits."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(12)
+    base = rs.randint(0, lcfg.vocab_size, (6,)).astype(np.int32)  # 1.5 pages
+    ext = np.concatenate([base, rs.randint(0, lcfg.vocab_size, (3,))
+                          .astype(np.int32)])
+    want_base = ff.generate(base[None, :], max_new_tokens=5)[0]
+    want_ext = ff.generate(ext[None, :], max_new_tokens=5)[0]
+    server = ff.serve_generation(slots=2, max_len=32, paged=True,
+                                 page_size=4)
+    try:
+        got0 = server.generate(base, max_new_tokens=5)   # donor
+        got1 = server.generate(ext, max_new_tokens=5)    # COW + diverge
+        got2 = server.generate(base, max_new_tokens=5)   # donor rows intact
+        m = server.metrics()
+    finally:
+        server.stop()
+    np.testing.assert_array_equal(want_base, got0)
+    np.testing.assert_array_equal(want_ext, got1)
+    np.testing.assert_array_equal(want_base, got2)
+    reqs = m["requests"]
+    # the extension hit the full page AND the 2-row tail (6 of 9 rows);
+    # the re-run hit everything but the recomputed last row
+    assert reqs[1]["cached_prefill_tokens"] >= 6, reqs[1]
+    assert reqs[2]["cached_prefill_tokens"] >= 5, reqs[2]
+
+
+def test_preempted_resume_reattaches_cached_pages():
+    """Preemption + prefix cache: the victim's pages stay content-
+    addressed on the LRU dead list, so its resume re-attaches them and
+    recomputes only the non-cached suffix (asserted via the per-request
+    cached/computed prefill counters), with dense-identical output."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(13)
+    prompts = [rs.randint(0, lcfg.vocab_size, (5,)).astype(np.int32)
+               for _ in range(2)]
+    want = [ff.generate(p[None, :], max_new_tokens=8)[0] for p in prompts]
+    # capacity 5 pages; both requests peak at 3 pages (12 written rows)
+    # -> one preemption is forced, the victim resumes after the winner
+    # finishes and finds its own blocks still content-addressed
+    server = ff.serve_generation(slots=2, max_len=16, paged=True,
+                                 page_size=4, num_pages=6)
+    try:
+        futs = [server.submit(p, max_new_tokens=8) for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+        m = server.metrics()
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert m["preemptions"] > 0
+    preempted = [r for r in m["requests"] if r["preemptions"] > 0]
+    assert preempted, m["requests"]
+    for r in preempted:
+        # at least one page of its own prior work re-attached on resume
+        assert r["cached_prefill_tokens"] >= 4, r
+        # computed rows stay below the full per-admission recompute the
+        # monolithic prefill would have paid (5 prompt rows + the
+        # re-prefilled generated prefix on every resume)
+        assert r["prefill_tokens"] < (r["preemptions"] + 1) * 5 + \
+            r["decode_tokens"], r
+
+
+def test_refcount_eviction_stress_under_page_pressure():
+    """Shared-prefix requests churning through a tight pool (preemption,
+    LRU eviction, COW, repeated resume): outputs stay dense-identical,
+    every page returns to the pool, and the refcount invariants hold."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(14)
+    sys_prompt = rs.randint(0, lcfg.vocab_size, (4,)).astype(np.int32)
+    prompts = [np.concatenate([sys_prompt,
+                               rs.randint(0, lcfg.vocab_size, (n,))
+                               .astype(np.int32)])
+               for n in (2, 3, 4, 2, 3, 4)]
+    want = [ff.generate(p[None, :], max_new_tokens=6)[0] for p in prompts]
+    server = ff.serve_generation(slots=3, max_len=16, paged=True,
+                                 page_size=4, num_pages=8)
+    try:
+        futs = [server.submit(p, max_new_tokens=6) for p in prompts]
+        got = [f.result(timeout=180) for f in futs]
+        m = server.metrics()
+    finally:
+        server.stop()
+    for i, (w, g) in enumerate(zip(want, got)):
+        np.testing.assert_array_equal(w, g, err_msg=f"request {i}")
+    assert m["requests_served"] == len(prompts)
+    assert m["pages_in_use"] == 0  # every reference released
+    pool = server.pool
+    assert pool._refs == {}, pool._refs
+    assert len(pool._free) + len(pool._lru) == pool.capacity
+
+
+def test_defrag_with_shared_pages_mid_stream():
+    """Defrag while two live requests SHARE prefix pages: the page moves
+    rewrite both owners' tables and the hash index, and output stays
+    dense-identical."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(15)
+    sys_prompt = rs.randint(0, lcfg.vocab_size, (8,)).astype(np.int32)
+    prompts = [np.concatenate([sys_prompt,
+                               rs.randint(0, lcfg.vocab_size, (2,))
+                               .astype(np.int32)])
+               for _ in range(3)]
+    want = [ff.generate(p[None, :], max_new_tokens=8)[0] for p in prompts]
+    server = ff.serve_generation(slots=3, max_len=32, paged=True,
+                                 page_size=4)
+    try:
+        first = server.submit(prompts[0], max_new_tokens=8)
+        first.result(timeout=120)       # warm the shared blocks
+        futs = [server.submit(p, max_new_tokens=8) for p in prompts[1:]]
+        server.request_defrag()         # compact under live sharing
+        got = [np.asarray(first.result())] + \
+              [f.result(timeout=120) for f in futs]
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert server.defrags >= 1
+    m = server.metrics()
+    assert m["prefix_cache"]["hit_tokens"] >= 2 * 8
+
+
+def test_chunked_prefill_does_not_stall_decodes():
+    """A prompt longer than the chunk budget admits and prefills chunk by
+    chunk INSIDE the decode loop: the already-running request keeps
+    decoding between the chunks (>= 2 overlapped decode ticks recorded),
+    and both outputs are dense-identical (scheduler acceptance
+    criterion)."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(16)
+    short = rs.randint(0, lcfg.vocab_size, (4,)).astype(np.int32)
+    long = rs.randint(0, lcfg.vocab_size, (24,)).astype(np.int32)
+    want_short = ff.generate(short[None, :], max_new_tokens=20)[0]
+    want_long = ff.generate(long[None, :], max_new_tokens=4)[0]
+    server = ff.serve_generation(slots=2, max_len=48, paged=True,
+                                 page_size=4, prefill_chunk=4)
+    try:
+        f_short = server.submit(short, max_new_tokens=20)
+        # wait until the short request is live and decoding
+        deadline = time.monotonic() + 60
+        while not server._admit_order and time.monotonic() < deadline:
+            time.sleep(0.001)
+        f_long = server.submit(long, max_new_tokens=4)
+        got_short = f_short.result(timeout=120)
+        got_long = f_long.result(timeout=120)
+        m = server.metrics()
+    finally:
+        server.stop()
+    np.testing.assert_array_equal(want_short, got_short)
+    np.testing.assert_array_equal(want_long, got_long)
+    assert m["prefill_ticks"] >= 6  # 24 tokens / 4-token budget
+    long_rec = [r for r in m["requests"] if r["decode_tokens"] == 4][0]
+    assert long_rec["prefill_tokens"] >= 24
+    assert long_rec["decode_overlap_ticks"] >= 2, long_rec
 
 
 def test_paged_submit_contract():

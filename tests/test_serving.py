@@ -203,6 +203,46 @@ def test_generation_server_sampling_and_stats():
     assert server.requests_served == 1
 
 
+def test_http_metrics_endpoint_exposes_pool_and_prefix_cache():
+    """GET /v2/models/<name>/metrics on a PAGED generation server exposes
+    pool occupancy, fragmentation, the prefix-cache hit/miss/eviction
+    counters, and per-request TTFT (ISSUE 5 satellite) — all
+    JSON-serializable."""
+    import json
+    import urllib.request
+
+    from flexflow_tpu.serving import http_serve, serve
+
+    ff, lcfg = _causal_lm()
+    fwd = serve(ff, batch_sizes=(1,), warmup=False)
+    gen = ff.serve_generation(slots=2, max_len=32, paged=True, page_size=4)
+    httpd = http_serve(fwd, port=0, model_name="lm", generation_server=gen)
+    try:
+        rs = np.random.RandomState(4)
+        prompt = rs.randint(0, lcfg.vocab_size, (9,)).astype(np.int32)
+        gen.generate(prompt, max_new_tokens=4)
+        gen.generate(prompt, max_new_tokens=4)  # second run hits the cache
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with urllib.request.urlopen(f"{base}/v2/models/lm/metrics") as r:
+            m = json.loads(r.read())
+        g = m["generation"]
+        assert g["requests_served"] == 2
+        assert 0.0 <= g["pool_occupancy"] <= 1.0
+        assert 0.0 <= g["fragmentation"] <= 1.0
+        pc = g["prefix_cache"]
+        assert pc["enabled"] and pc["hit_tokens"] >= 8
+        assert pc["hits"] >= 1 and pc["evictions"] >= 0
+        assert pc["hit_tokens"] + pc["miss_tokens"] == pc["lookup_tokens"]
+        for r_ in g["requests"]:
+            assert r_["ttft_s"] is not None and r_["ttft_s"] >= 0.0
+        assert g["requests"][1]["cached_prefill_tokens"] >= 8
+        json.dumps(m)  # no numpy leakage anywhere in the payload
+    finally:
+        httpd.shutdown()
+        fwd.stop()
+        gen.stop()
+
+
 def test_generation_server_stop_contract():
     """submit after stop raises; bad max_new_tokens rejected; stop cancels
     (never silently truncates) in-flight work."""
