@@ -384,7 +384,10 @@ def bench_decode() -> dict:
     prompts = [rs.randint(0, lcfg.vocab_size, (rs.randint(4, 17),))
                .astype(np.int32) for _ in range(n_req)]
     _log("decode bench: plain paged serving")
-    tps, toks, _ = run_server(prompts)
+    tps, toks, plain_m = run_server(prompts)
+    # tick-latency percentiles ride the always-on serving histograms
+    # (fftrace/obs.metrics) — no tracing needed for these
+    tick_h = plain_m["histograms"]["tick_latency_s"]
 
     # shared-system-prompt fixture: every request opens with the same
     # system prefix, so the prefix cache serves the bulk of prefill for
@@ -445,12 +448,57 @@ def bench_decode() -> dict:
     spec_tps, _spec_toks, m = run_server(
         prompts, speculate=SpecConfig(width=2, depth=4))
     sm = m["speculative"]
+
+    # traced pass (fftrace): a short re-run with the span recorder + tick
+    # ledger on produces the Chrome-trace artifact and a predicted-vs-
+    # measured calibration summary. The timed runs above stay untraced so
+    # the reported throughput is the no-tracing number.
+    from flexflow_tpu import obs
+    from flexflow_tpu.obs.calibrate import (
+        calibration_report,
+        stamp_ledger_meta,
+    )
+
+    _log("decode bench: traced pass (fftrace)")
+    calibration = None
+    rec = obs.enable()
+    try:
+        # short plain + speculative passes so decode, prefill AND verify
+        # tick shapes all land in the calibration ledger
+        run_server(prompts[:2])
+        run_server(prompts[:max(2, n_req // 4)],
+                   speculate=SpecConfig(width=2, depth=4))
+    finally:
+        obs.disable()
+    try:
+        stamp_ledger_meta(rec.ledger, ff, fixture="bench_decode")
+        report = calibration_report(rec.ledger)
+        calibration = {
+            "pricing_mode": report["base"].get("pricing_mode"),
+            "phases": {k: round(v, 4) for k, v in report["phases"].items()},
+            "shapes": len(report["shapes"]),
+        }
+    except Exception as e:
+        _log(f"calibration report unavailable: {type(e).__name__}: {e}")
+    if not smoke:
+        # same green-artifact discipline as bench_decode_last_green.json:
+        # smoke runs never overwrite the persisted trace
+        try:
+            os.makedirs(os.path.dirname(_DECODE_TRACE_PATH), exist_ok=True)
+            rec.export_chrome_trace(_DECODE_TRACE_PATH)
+            _log(f"trace artifact: {_DECODE_TRACE_PATH}")
+        except OSError as e:
+            _log(f"could not persist trace artifact: {e}")
+
     return {
         "metric": "paged_decode_tokens_per_sec",
         "value": round(tps, 2),
         "unit": "tokens/s",
         "requests": n_req,
         "decode_tokens": toks,
+        "tick_latency_p50_s": round(float(tick_h["p50"]), 6),
+        "tick_latency_p95_s": round(float(tick_h["p95"]), 6),
+        "calibration": calibration,
         "prefix_cache": prefix_metrics,
         "speculative": {
             "tokens_per_sec": round(spec_tps, 2),
@@ -511,6 +559,11 @@ _GREEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 _DECODE_GREEN_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "docs", "bench_decode_last_green.json")
+# Chrome-trace artifact from the decode bench's traced pass (Perfetto-
+# loadable); written only on non-smoke runs, alongside the green JSON
+_DECODE_TRACE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "docs", "bench_decode_trace.json.gz")
 
 
 def _persist_green(res: dict, path: "str | None" = None) -> None:

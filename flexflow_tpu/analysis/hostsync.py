@@ -38,7 +38,7 @@ from typing import Dict, List, Optional, Set
 
 from flexflow_tpu.analysis import AnalysisContext, Finding, register_pass
 
-DEFAULT_ROOTS = ("runtime", "serving.py", "paged", "spec")
+DEFAULT_ROOTS = ("runtime", "serving.py", "paged", "spec", "obs")
 
 _SYNC_CALLS = {("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
                ("numpy", "array"), ("jax", "device_get")}

@@ -1216,7 +1216,8 @@ class FFModel:
                          eos_id=None, seed: int = 0, paged: bool = False,
                          page_size: int = 64, num_pages=None,
                          preemption: bool = True, prefix_cache: bool = True,
-                         prefill_chunk: int = 64, speculate=None):
+                         prefill_chunk: int = 64, speculate=None,
+                         request_record_limit=None):
         """Continuous-batching autoregressive generation endpoint (KV-cache
         decode with per-slot positions — flexflow_tpu.serving). With
         `paged=True` the KV cache is a block-paged pool shared by all
@@ -1236,7 +1237,8 @@ class FFModel:
                    seed=seed, paged=paged, page_size=page_size,
                    num_pages=num_pages, preemption=preemption,
                    prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
-                   speculate=speculate)
+                   speculate=speculate,
+                   request_record_limit=request_record_limit)
 
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],
                 batch_size: Optional[int] = None) -> np.ndarray:

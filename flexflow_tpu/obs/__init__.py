@@ -1,0 +1,106 @@
+"""fftrace — structured tracing + metrics for the serving tick loop.
+
+Two layers with different overhead budgets:
+
+  * `MetricsRegistry` (obs.metrics): counters/gauges/fixed-bucket
+    histograms. Always on — every generation server owns one and feeds
+    both the JSON metrics endpoint and the Prometheus text endpoint.
+    An observe() is a bisect + two adds.
+  * Span recorder + TickLedger (obs.trace / obs.ledger): opt-in via
+    `obs.enable()`. When disabled, `obs.span(name)` returns a shared
+    falsy singleton — zero allocations on the tick path (the
+    disabled-overhead guard in tests/test_obs.py holds this to account).
+
+Usage on a hot path:
+
+    from flexflow_tpu import obs
+    ...
+    with obs.span("decode_tick") as sp:
+        if sp:  # only build the attrs dict when someone is recording
+            sp.set(live=len(live), width=T)
+        ...
+
+Calibration (see obs.calibrate and tools/fftrace.py):
+
+    obs.enable()
+    ... serve traffic ...
+    obs.recorder().export_chrome_trace("trace.json")   # Perfetto
+    led = obs.ledger(); stamp_ledger_meta(led, ff); led.save("ledger.json")
+    # fftrace calibrate ledger.json -> per-tick-shape scale factors
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from flexflow_tpu.obs.ledger import TickLedger, shape_key
+from flexflow_tpu.obs.metrics import (
+    COUNT_BUCKETS,
+    RATIO_BUCKETS,
+    TIME_BUCKETS_S,
+    Histogram,
+    MetricsRegistry,
+    flatten_scalars,
+)
+from flexflow_tpu.obs.trace import NULL_SPAN, Span, TraceRecorder
+
+_recorder: Optional[TraceRecorder] = None
+
+
+def enable(max_events: int = 200_000,
+           annotate_device: bool = True) -> TraceRecorder:
+    """Install a fresh TraceRecorder (replacing any previous one) and
+    return it. Spans and ledger recording start immediately."""
+    global _recorder
+    _recorder = TraceRecorder(max_events=max_events,
+                              annotate_device=annotate_device)
+    return _recorder
+
+
+def disable() -> Optional[TraceRecorder]:
+    """Stop recording; returns the recorder so its events/ledger can
+    still be exported after the fact."""
+    global _recorder
+    rec, _recorder = _recorder, None
+    return rec
+
+
+def enabled() -> bool:
+    return _recorder is not None
+
+
+def recorder() -> Optional[TraceRecorder]:
+    return _recorder
+
+
+def ledger() -> Optional[TickLedger]:
+    return _recorder.ledger if _recorder is not None else None
+
+
+def span(name: str):
+    """A live Span when enabled, else the falsy no-op singleton."""
+    rec = _recorder
+    if rec is None:
+        return NULL_SPAN
+    return Span(rec, name)
+
+
+__all__ = [
+    "COUNT_BUCKETS",
+    "Histogram",
+    "MetricsRegistry",
+    "NULL_SPAN",
+    "RATIO_BUCKETS",
+    "Span",
+    "TIME_BUCKETS_S",
+    "TickLedger",
+    "TraceRecorder",
+    "disable",
+    "enable",
+    "enabled",
+    "flatten_scalars",
+    "ledger",
+    "recorder",
+    "shape_key",
+    "span",
+]

@@ -48,6 +48,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from flexflow_tpu import obs
 from flexflow_tpu.paged.pool import EMPTY_HASH, PagePool
 from flexflow_tpu.serving import _GenerationServerBase, _GenRequest
 
@@ -64,10 +65,12 @@ class PagedGenerationServer(_GenerationServerBase):
                  eos_id: Optional[int] = None, seed: int = 0,
                  page_size: int = 64, num_pages: Optional[int] = None,
                  preemption: bool = True, table_slack_tokens: int = 0,
-                 prefix_cache: bool = True, prefill_chunk: int = 64):
+                 prefix_cache: bool = True, prefill_chunk: int = 64,
+                 request_record_limit: Optional[int] = None):
         import jax
 
-        super().__init__(ff, slots, max_len, eos_id, seed)
+        super().__init__(ff, slots, max_len, eos_id, seed,
+                         request_record_limit=request_record_limit)
         self.page_size = int(page_size)
         # table_slack_tokens widens every page table beyond max_len —
         # speculative verify (flexflow_tpu.spec) writes its draft tree's
@@ -101,6 +104,10 @@ class PagedGenerationServer(_GenerationServerBase):
         self.peak_active = 0
         self.prefill_ticks = 0
         self._prefill_rr = 0  # rotating start slot for the chunk budget
+        # idle-loop accounting (fftrace): ticks the loop slept because
+        # nothing was live or admitted, and total seconds spent asleep
+        self._c_idle = self.registry.counter("idle_ticks_total")
+        self._c_idle_s = self.registry.counter("idle_wait_seconds_total")
 
         @jax.jit
         def copy_page(caches, src, dst):
@@ -453,18 +460,33 @@ class PagedGenerationServer(_GenerationServerBase):
         AND mid-prefill), or None when this tick should be skipped
         (nothing live; sleeps briefly when nothing was admitted
         either)."""
-        if self._defrag_req.is_set():
-            self._defrag_req.clear()
-            self._apply_defrag()
-        admitted = self._admit_pending()
-        live = self._live()
-        self.peak_active = max(self.peak_active, len(live))
-        if not live:
-            if not admitted:
-                time.sleep(0.001)
-            return None
-        self._ensure_pages()  # may preempt: recompute live after
-        return self._live() or None
+        with obs.span("tick_prep") as sp:
+            if self._defrag_req.is_set():
+                self._defrag_req.clear()
+                with obs.span("defrag"):
+                    self._apply_defrag()
+            with obs.span("admit_pending"):
+                admitted = self._admit_pending()
+            live = self._live()
+            self.peak_active = max(self.peak_active, len(live))
+            if sp:
+                sp.set(live=len(live),
+                       mid_prefill=sum(1 for s in live
+                                       if self._mid_prefill(s)),
+                       pages_in_use=self.pool.pages_in_use,
+                       admitted=admitted)
+            if not live:
+                if not admitted:
+                    # idle/busy-wait time is charged to its own span so a
+                    # trace separates "waiting for work" from real prep
+                    t0 = time.monotonic()
+                    with obs.span("idle_wait"):
+                        time.sleep(0.001)
+                    self._c_idle.inc()
+                    self._c_idle_s.inc(time.monotonic() - t0)
+                return None
+            self._ensure_pages()  # may preempt: recompute live after
+            return self._live() or None
 
     def _split_live(self, live):
         """(mid-prefill slots, decoding slots) for this tick."""
@@ -489,6 +511,8 @@ class PagedGenerationServer(_GenerationServerBase):
         rot = self._prefill_rr % len(slots)
         self._prefill_rr += 1
         slots = slots[rot:] + slots[:rot]
+        t0 = time.monotonic()
+        sp = obs.span("prefill_tick").__enter__()
         for s in slots:  # fflint: host-ok (one chunk per prefilling slot per tick, not per token)
             if budget <= 0:
                 break
@@ -520,6 +544,15 @@ class PagedGenerationServer(_GenerationServerBase):
                 self._publish_tail(req)
                 self._sample_first_token(s, req, probs[:, take - 1, :])
                 self._finish_if_done(s)
+        chunked = self.prefill_chunk - budget
+        if sp:
+            sp.set(slots=len(slots), chunk_tokens=chunked)
+        sp.__exit__(None, None, None)
+        dt = time.monotonic() - t0
+        self._h_prefill.observe(dt)
+        led = obs.ledger()
+        if led is not None:
+            led.record("prefill", dt, batch=len(slots), chunk=chunked)
 
     def _decode_tick(self, live, tr, ntr):
         """One plain single-token decode tick for the decoding slots
@@ -530,6 +563,10 @@ class PagedGenerationServer(_GenerationServerBase):
         import jax
         import jax.numpy as jnp
 
+        t0 = time.monotonic()
+        sp = obs.span("decode_tick").__enter__()
+        if sp:
+            sp.set(live=len(live), pages_in_use=self.pool.pages_in_use)
         pos = np.array([self._active[s].pos if self._active[s] else 0
                         for s in range(self.slots)], np.int32)
         probs, upd = self._step(
@@ -553,6 +590,13 @@ class PagedGenerationServer(_GenerationServerBase):
             self._tokens[s] = toks[s]
             self._publish_prefix(req, req.pos)
             self._finish_if_done(s)
+        sp.__exit__(None, None, None)
+        dt = time.monotonic() - t0
+        self._h_tick.observe(dt)
+        self._h_tokens.observe(len(live))
+        led = obs.ledger()
+        if led is not None:
+            led.record("decode", dt, batch=len(live))
 
     def _loop_body(self, tr, ntr):
         while not self._stop.is_set():

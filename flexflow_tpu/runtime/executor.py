@@ -47,6 +47,35 @@ def node_key(node: Node) -> str:
     return node.stable_key()
 
 
+class _TracedStep:
+    """Jitted step function wrapped in an fftrace span (obs.span) so
+    train/eval steps land on the host trace next to the serving ticks.
+    Everything else delegates to the underlying jitted callable —
+    `.lower()` in particular, which lowered_modules()/hloaudit call on
+    the object train_step() returns. Disabled-mode cost is one module
+    attribute load + an `is None` test per step."""
+
+    __slots__ = ("_fn", "_name")
+
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        self._name = name
+
+    def __call__(self, *args, **kw):
+        from flexflow_tpu import obs
+
+        if obs.recorder() is None:
+            return self._fn(*args, **kw)
+        with obs.span(self._name):
+            return self._fn(*args, **kw)
+
+    def lower(self, *args, **kw):
+        return self._fn.lower(*args, **kw)
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
 class Executor:
     """Owns the lowered step functions for one compiled PCG."""
 
@@ -630,7 +659,8 @@ class Executor:
             return new_tr, new_ntr, new_opt, step_metrics
 
         donate = (0, 1, 2) if self.donate else ()
-        self._train_step = jax.jit(step, donate_argnums=donate)
+        self._train_step = _TracedStep(
+            jax.jit(step, donate_argnums=donate), "train_step")
         return self._train_step
 
     def eval_step(self):
@@ -653,7 +683,7 @@ class Executor:
             m["loss"] = loss
             return m
 
-        self._eval_step = jax.jit(step)
+        self._eval_step = _TracedStep(jax.jit(step), "eval_step")
         return self._eval_step
 
     def init_kv_cache(self, batch: int, max_len: int, dtype=None):

@@ -66,6 +66,9 @@ class MeasuredCostModel(CostModel):
     warmup: int = 2
     repeats: int = 5
     _measured: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # serving-tick calibration (fftrace): per-tick-shape scale factors
+    # (measured / predicted) from obs.calibrate.calibration_report
+    _tick_scale: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     # ------------------------------------------------------------------
 
@@ -346,6 +349,59 @@ class MeasuredCostModel(CostModel):
         CostModel.event_seconds so the measured path, the priced-events
         manifest, and the analytic pricing all read the same formulas."""
         return self.event_seconds(kind, nbytes, n, tuple(axes or ()))
+
+    # ------------------------------------------------------------------
+    # serving-tick calibration (fftrace): obs.calibrate measures real
+    # decode/verify/prefill ticks against the analytic step price; the
+    # per-shape ratios land here so a search pricing a serving
+    # configuration can correct its tick-time estimate with reality
+    # (ROADMAP: auto-tuned decode strategies under SLO)
+
+    def set_tick_calibration(self, report: Dict) -> int:
+        """Ingest an `fftrace calibrate` report (obs.calibrate
+        .calibration_report): per-tick-shape scale factors plus the
+        per-phase medians as `phase|*` fallbacks for shapes the ledger
+        never saw. Returns the number of exact shapes loaded."""
+        if not isinstance(report, dict):
+            raise TypeError(f"expected a report dict, got {type(report)}")
+        scales = report.get("tick_scales", report)
+        if not isinstance(scales, dict):
+            raise TypeError(f"expected a report dict, got {type(report)}")
+        for key, ratio in scales.items():
+            self._tick_scale[key] = float(ratio)
+        for phase, ratio in report.get("phases", {}).items():
+            self._tick_scale[f"{phase}|*"] = float(ratio)
+        return len(scales)
+
+    def tick_scale(self, phase: str, batch: int, chunk: int = 0,
+                   width: int = 1) -> float:
+        """Measured/predicted ratio for this tick shape: exact shape
+        first, then the phase's median, else 1.0 (uncalibrated)."""
+        from flexflow_tpu.obs.ledger import shape_key
+
+        exact = self._tick_scale.get(shape_key(phase, batch, chunk, width))
+        if exact is not None:
+            return exact
+        return self._tick_scale.get(f"{phase}|*", 1.0)
+
+    def decode_tick_time(self, graph: Graph,
+                         strategy: Dict[str, ShardingView],
+                         phase: str = "decode", batch: int = 1,
+                         chunk: int = 0, width: int = 1) -> float:
+        """Calibrated wall-time estimate for one serving tick of the
+        given shape: the analytic step price scaled to the tick's token
+        count (obs.calibrate's linear model), times the measured
+        correction for that shape."""
+        from flexflow_tpu.obs.calibrate import (
+            graph_tokens,
+            predict_tick_seconds,
+        )
+        from flexflow_tpu.search.cost_model import graph_cost
+
+        base = graph_cost(graph, strategy, self, training=False).time
+        pred = predict_tick_seconds(base, graph_tokens(graph), phase,
+                                    batch, chunk, width)
+        return pred * self.tick_scale(phase, batch, chunk, width)
 
     # ------------------------------------------------------------------
 

@@ -24,10 +24,13 @@ import json
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from flexflow_tpu import obs
 
 
 class ModelInstance:
@@ -252,14 +255,19 @@ def http_serve(server: Server, port: int = 8000, model_name: str = "model",
       GET  /v2/health/ready                 -> 200
       GET  /v2/models/<name>               -> metadata
       GET  /v2/models/<name>/metrics       -> serving metrics JSON
+      GET  /metrics                        -> Prometheus text exposition
       POST /v2/models/<name>/infer         -> {"inputs": [{"name","shape",
                                                "datatype","data"}...]}
 
-    The metrics endpoint serves the batcher's counters and — when a
+    The JSON metrics endpoint serves the batcher's counters and — when a
     `generation_server` (serve_generation) is attached — its aggregate +
     per-request generation metrics (queue times, pages, preemptions,
     speculative acceptance rates), so operators scrape what was
-    previously reachable only from Python.
+    previously reachable only from Python. `GET /metrics` serves the
+    SAME numbers (same MetricsRegistry + the flattened scalar counters,
+    `ff_` prefix) in Prometheus text-exposition format, so a standard
+    scrape config needs no JSON translation layer (docs/observability.md
+    has the scrape stanza).
 
     Returns the ThreadingHTTPServer (serve_forever on a thread; call
     .shutdown() to stop). Stdlib-only — no server framework in the image.
@@ -277,10 +285,13 @@ def http_serve(server: Server, port: int = 8000, model_name: str = "model",
             pass
 
         def _send(self, code: int, payload: dict):
-            body = json.dumps(payload).encode()
+            self._send_raw(code, json.dumps(payload).encode(),
+                           "application/json")
+
+        def _send_raw(self, code: int, body: bytes, ctype: str):
             try:
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -306,6 +317,25 @@ def http_serve(server: Server, port: int = 8000, model_name: str = "model",
                 if generation_server is not None:
                     payload["generation"] = generation_server.metrics()
                 self._send(200, payload)
+            elif self.path == "/metrics":
+                # Prometheus text exposition off the SAME registry the
+                # JSON endpoint reads; the flattened scalar metrics
+                # (counters the servers track outside the registry) ride
+                # along so the two surfaces always agree
+                scalars = {"server_requests_served":
+                           float(server.requests_served)}
+                if generation_server is not None:
+                    gm = generation_server.metrics()
+                    gm.pop("requests", None)    # per-request detail:
+                    gm.pop("histograms", None)  # JSON-only; registry
+                    scalars.update(obs.flatten_scalars(gm, "generation"))
+                    reg = generation_server.registry
+                else:
+                    reg = obs.MetricsRegistry()
+                self._send_raw(
+                    200,
+                    reg.prometheus_text(extra_scalars=scalars).encode(),
+                    "text/plain; version=0.0.4")
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -450,13 +480,15 @@ class _GenerationServerBase:
     validation, and the learned-position-table guard — so the two decode
     paths can never drift apart on the serving surface."""
 
-    # per-request metric records kept for metrics(); bounded so a
-    # long-running server (and the HTTP metrics scrape) cannot grow
-    # without limit — oldest records drop first
+    # default cap on per-request metric records kept for metrics();
+    # bounded so a long-running server (and the HTTP metrics scrape)
+    # cannot grow without limit — oldest records drop first. Override
+    # per server with request_record_limit.
     MAX_REQUEST_RECORDS = 1024
 
     def __init__(self, ff, slots: int, max_len: int,
-                 eos_id: Optional[int], seed: int):
+                 eos_id: Optional[int], seed: int,
+                 request_record_limit: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -496,7 +528,26 @@ class _GenerationServerBase:
         self._running = True
         self._served = 0
         self._steps = 0
-        self._request_metrics: List[dict] = []
+        # per-request records ride a ring buffer (cumulative counters and
+        # histograms are unaffected by the cap — only the per-request
+        # detail list is bounded)
+        limit = (int(request_record_limit) if request_record_limit
+                 is not None else self.MAX_REQUEST_RECORDS)
+        if limit < 1:
+            raise ValueError(
+                f"request_record_limit must be >= 1, got {limit}")
+        self.request_record_limit = limit
+        self._request_metrics: "deque[dict]" = deque(maxlen=limit)
+        # always-on histograms (obs.metrics): tick latency, TTFT, queue
+        # time, tokens emitted per tick. Backs BOTH the JSON metrics
+        # payload and the Prometheus text endpoint.
+        self.registry = obs.MetricsRegistry()
+        self._h_tick = self.registry.histogram("tick_latency_s")
+        self._h_prefill = self.registry.histogram("prefill_tick_s")
+        self._h_ttft = self.registry.histogram("ttft_s")
+        self._h_queue = self.registry.histogram("queue_time_s")
+        self._h_tokens = self.registry.histogram("tokens_per_tick",
+                                                 obs.COUNT_BUCKETS)
         self._thread: Optional[threading.Thread] = None
 
     def _start(self):
@@ -554,14 +605,17 @@ class _GenerationServerBase:
 
     def metrics(self) -> dict:
         """Aggregate serving metrics + per-request records of the last
-        MAX_REQUEST_RECORDS COMPLETED requests (subclasses extend: paged
-        adds pool/preemption counters, speculative adds acceptance
-        rates). This dict is what http_serve's /v2/models/<name>/metrics
-        endpoint serves."""
+        `request_record_limit` COMPLETED requests (subclasses extend:
+        paged adds pool/preemption counters, speculative adds acceptance
+        rates) + the registry's histograms (tick latency, TTFT — with
+        p50/p95/p99 estimates). This dict is what http_serve's
+        /v2/models/<name>/metrics endpoint serves; the same registry
+        backs the Prometheus `GET /metrics` endpoint."""
         return {
             "requests_served": self._served,
             "decode_steps": self._steps,
             "requests": list(self._request_metrics),
+            "histograms": self.registry.to_json(),
         }
 
     # -- shared scheduler pieces -----------------------------------------
@@ -624,10 +678,19 @@ class _GenerationServerBase:
         _finish_if_done. Completed requests record their per-request
         metrics (cancellations are not records)."""
         if completed:
-            self._request_metrics.append(req.metrics())
-            if len(self._request_metrics) > self.MAX_REQUEST_RECORDS:
-                del self._request_metrics[
-                    :len(self._request_metrics) - self.MAX_REQUEST_RECORDS]
+            m = req.metrics()
+            self._request_metrics.append(m)  # deque(maxlen=...) ring
+            if m["ttft_s"] is not None:
+                self._h_ttft.observe(m["ttft_s"])
+            if m["queue_time_s"] is not None:
+                self._h_queue.observe(m["queue_time_s"])
+            rec = obs.recorder()
+            if rec is not None:
+                # lifecycle track (queued→prefill→decode) from the same
+                # monotonic clock the spans use
+                rec.record_request(req.submit_t, req.admit_t,
+                                   req.first_token_t, time.monotonic(),
+                                   label=f"req {self._served + 1}", attrs=m)
         self._active[slot] = None
 
     def _finish_if_done(self, slot: int):
@@ -691,10 +754,12 @@ class GenerationServer(_GenerationServerBase):
     """
 
     def __init__(self, ff, slots: int = 4, max_len: int = 512,
-                 eos_id: Optional[int] = None, seed: int = 0):
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 request_record_limit: Optional[int] = None):
         import jax
 
-        super().__init__(ff, slots, max_len, eos_id, seed)
+        super().__init__(ff, slots, max_len, eos_id, seed,
+                         request_record_limit=request_record_limit)
         ex = ff.executor
         self._step = ex.decode_fn()
         self._prefill_step = self._step  # one fn, two input shapes
@@ -734,15 +799,20 @@ class GenerationServer(_GenerationServerBase):
         while not self._stop.is_set():
             # admission: fill every free slot from the queue
             admitted = False
-            for slot in range(self.slots):
-                if self._active[slot] is not None:
-                    continue
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                self._admit(req, slot)
-                admitted = True
+            with obs.span("admit") as sp:
+                n_admitted = 0
+                for slot in range(self.slots):
+                    if self._active[slot] is not None:
+                        continue
+                    try:
+                        req = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    self._admit(req, slot)
+                    admitted = True
+                    n_admitted += 1
+                if sp and n_admitted:
+                    sp.set(admitted=n_admitted)
             live = [s for s in range(self.slots) if self._active[s] is not None]
             if not live:
                 if not admitted:
@@ -750,23 +820,33 @@ class GenerationServer(_GenerationServerBase):
                 continue
             # one decode tick for the whole pool (idle slots compute too —
             # fixed shapes keep the step compiled once)
-            pos = np.array([self._active[s].pos if self._active[s] else 0
-                            for s in range(self.slots)], np.int32)
-            probs, upd = self._step(tr, ntr, self._caches, jnp.asarray(pos),  # fflint: host-ok (per-tick batch transfer)
-                                    jnp.asarray(self._tokens)[:, None])  # fflint: host-ok (per-tick batch transfer)
-            self._caches = upd
-            temps = np.array([self._active[s].temperature if self._active[s]
-                              else 0.0 for s in range(self.slots)], np.float32)
-            self._rng, sub = jax.random.split(self._rng)
-            toks = np.asarray(self._pick(probs[:, -1, :],
-                                         jnp.asarray(temps), sub))  # fflint: host-ok (per-tick batch transfer)
-            self._steps += 1
-            for s in live:
-                req = self._active[s]
-                req.pos += 1
-                req.tokens.append(int(toks[s]))
-                self._tokens[s] = toks[s]
-                self._finish_if_done(s)
+            t0 = time.monotonic()
+            with obs.span("decode_tick") as sp:
+                if sp:
+                    sp.set(live=len(live))
+                pos = np.array([self._active[s].pos if self._active[s] else 0
+                                for s in range(self.slots)], np.int32)
+                probs, upd = self._step(tr, ntr, self._caches, jnp.asarray(pos),  # fflint: host-ok (per-tick batch transfer)
+                                        jnp.asarray(self._tokens)[:, None])  # fflint: host-ok (per-tick batch transfer)
+                self._caches = upd
+                temps = np.array([self._active[s].temperature if self._active[s]
+                                  else 0.0 for s in range(self.slots)], np.float32)
+                self._rng, sub = jax.random.split(self._rng)
+                toks = np.asarray(self._pick(probs[:, -1, :],
+                                             jnp.asarray(temps), sub))  # fflint: host-ok (per-tick batch transfer)
+                self._steps += 1
+                for s in live:
+                    req = self._active[s]
+                    req.pos += 1
+                    req.tokens.append(int(toks[s]))
+                    self._tokens[s] = toks[s]
+                    self._finish_if_done(s)
+            dt = time.monotonic() - t0
+            self._h_tick.observe(dt)
+            self._h_tokens.observe(len(live))
+            led = obs.ledger()
+            if led is not None:
+                led.record("decode", dt, batch=len(live))
 
 
 def serve_generation(ff, slots: int = 4, max_len: int = 512,
@@ -776,7 +856,9 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                      preemption: bool = True,
                      prefix_cache: bool = True,
                      prefill_chunk: int = 64,
-                     speculate=None) -> "_GenerationServerBase":
+                     speculate=None,
+                     request_record_limit: Optional[int] = None
+                     ) -> "_GenerationServerBase":
     """Continuous-batching generation endpoint over a compiled causal-LM
     FFModel (KV-cache decode path required — see FFModel.generate).
 
@@ -803,7 +885,12 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
     drafter proposes a token tree, one forward pass scores every node,
     and the longest verified path commits — greedy output stays
     token-identical to the non-speculative paged path while emitting up
-    to depth+1 tokens per step."""
+    to depth+1 tokens per step.
+
+    `request_record_limit` bounds how many completed requests keep their
+    per-request metric record (default _GenerationServerBase
+    .MAX_REQUEST_RECORDS); cumulative counters and histograms are
+    unaffected."""
     if speculate is not None:
         if not paged:
             raise ValueError(
@@ -815,13 +902,16 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             ff, speculate, slots=slots, max_len=max_len, eos_id=eos_id,
             seed=seed, page_size=page_size, num_pages=num_pages,
             preemption=preemption, prefix_cache=prefix_cache,
-            prefill_chunk=prefill_chunk)
+            prefill_chunk=prefill_chunk,
+            request_record_limit=request_record_limit)
     if paged:
         from flexflow_tpu.paged.scheduler import PagedGenerationServer
 
         return PagedGenerationServer(
             ff, slots=slots, max_len=max_len, eos_id=eos_id, seed=seed,
             page_size=page_size, num_pages=num_pages, preemption=preemption,
-            prefix_cache=prefix_cache, prefill_chunk=prefill_chunk)
+            prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
+            request_record_limit=request_record_limit)
     return GenerationServer(ff, slots=slots, max_len=max_len, eos_id=eos_id,
-                            seed=seed)
+                            seed=seed,
+                            request_record_limit=request_record_limit)

@@ -28,10 +28,12 @@ its own committed prefix.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as np
 
+from flexflow_tpu import obs
 from flexflow_tpu.paged.scheduler import PagedGenerationServer
 from flexflow_tpu.serving import _GenRequest
 from flexflow_tpu.spec.config import SpecConfig
@@ -47,7 +49,8 @@ class SpeculativePagedServer(PagedGenerationServer):
                  max_len: int = 512, eos_id: Optional[int] = None,
                  seed: int = 0, page_size: int = 64,
                  num_pages: Optional[int] = None, preemption: bool = True,
-                 prefix_cache: bool = True, prefill_chunk: int = 64):
+                 prefix_cache: bool = True, prefill_chunk: int = 64,
+                 request_record_limit: Optional[int] = None):
         if not isinstance(spec, SpecConfig):
             raise TypeError(
                 f"speculate must be a SpecConfig, got {type(spec).__name__}")
@@ -67,7 +70,11 @@ class SpeculativePagedServer(PagedGenerationServer):
                          num_pages=num_pages, preemption=preemption,
                          table_slack_tokens=spec.max_nodes,
                          prefix_cache=prefix_cache,
-                         prefill_chunk=prefill_chunk)
+                         prefill_chunk=prefill_chunk,
+                         request_record_limit=request_record_limit)
+        # per-tick draft acceptance rate (accepted / drafted this tick)
+        self._h_accept = self.registry.histogram("spec_acceptance",
+                                                 obs.RATIO_BUCKETS)
 
     # -- page accounting: the tree's scratch rows count --------------------
 
@@ -146,6 +153,9 @@ class SpeculativePagedServer(PagedGenerationServer):
             # slots skip the drafter entirely — their accept path is the
             # root's sample only, so drafts would be paid for and thrown
             # away (and would dilute the acceptance metrics)
+            t0 = time.monotonic()
+            tick_drafted = 0
+            sp = obs.span("draft").__enter__()
             tokens = np.zeros((self.slots, T), np.int32)
             parents = np.full((self.slots, T), -1, np.int32)
             depths = np.zeros((self.slots, T), np.int32)
@@ -167,6 +177,10 @@ class SpeculativePagedServer(PagedGenerationServer):
                 drafted = tree.n_nodes - 1
                 self.spec_drafted += drafted
                 req.spec_drafted += drafted
+                tick_drafted += drafted
+            if sp:
+                sp.set(live=len(live), width=T, drafted=tick_drafted)
+            sp.__exit__(None, None, None)
             anc = ancestor_masks(parents)
             pos = np.array([self._active[s].pos if self._active[s] else 0
                             for s in range(self.slots)], np.int32)
@@ -174,6 +188,10 @@ class SpeculativePagedServer(PagedGenerationServer):
             # _decode_table nulls mid-prefill slots' rows: the verify
             # writes T scratch rows for EVERY slot, and a mid-prefill
             # slot's must land in the null page, not its real pages
+            sp = obs.span("verify").__enter__()
+            if sp:
+                sp.set(live=len(live), width=T,
+                       pages_in_use=self.pool.pages_in_use)
             probs, upd = self._verify(
                 tr, ntr, self._caches, jnp.asarray(self._decode_table()),  # fflint: host-ok (per-tick batch transfer)
                 jnp.asarray(pos), jnp.asarray(depths), jnp.asarray(anc),  # fflint: host-ok (per-tick batch transfer)
@@ -196,6 +214,7 @@ class SpeculativePagedServer(PagedGenerationServer):
             preds = np.asarray(jnp.argmax(probs, axis=-1))  # (slots, T)  # fflint: host-ok (on-device reduction, one sync per tick)
             sampled = np.asarray(self._pick(probs[:, 0, :],
                                             jnp.asarray(temps), sub))  # fflint: host-ok (per-tick batch transfer)
+            sp.__exit__(None, None, None)  # verify: closes at host sync
             plans = {}
             for s in live:
                 req = self._active[s]
@@ -209,6 +228,8 @@ class SpeculativePagedServer(PagedGenerationServer):
 
             # commit: accepted path rows -> contiguous committed rows
             # (unused entries self-copy; built before tables mutate)
+            sp = obs.span("commit").__enter__()
+            a0, e0 = self.spec_accepted, self.spec_emitted
             src = np.repeat(pos[:, None], C, axis=1)
             dst = src.copy()
             for s in live:
@@ -243,3 +264,17 @@ class SpeculativePagedServer(PagedGenerationServer):
                 # pages stay private until pos actually crosses them)
                 self._publish_prefix(self._active[s], self._active[s].pos)
                 self._finish_if_done(s)
+            emitted = self.spec_emitted - e0
+            if sp:
+                sp.set(emitted=emitted,
+                       accepted=self.spec_accepted - a0)
+            sp.__exit__(None, None, None)
+            dt = time.monotonic() - t0
+            self._h_tick.observe(dt)
+            self._h_tokens.observe(emitted)
+            if tick_drafted:
+                self._h_accept.observe((self.spec_accepted - a0)
+                                       / tick_drafted)
+            led = obs.ledger()
+            if led is not None:
+                led.record("verify", dt, batch=len(live), width=T)

@@ -464,6 +464,25 @@ def test_hostsync_gate_covers_prefix_cache_and_chunked_prefill():
         .split("def ", 1)[0]
 
 
+def test_hostsync_gate_covers_obs_instrumentation():
+    """The fftrace instrumentation (ISSUE 8 satellite) is inside the
+    hostsync gate: obs/ is a default scan root, and the span recorder +
+    the instrumented scheduler/spec tick bodies all scan clean — tracing
+    must not introduce unannotated host syncs into the tick loop."""
+    from flexflow_tpu.analysis.hostsync import (
+        DEFAULT_ROOTS,
+        default_src_paths,
+        scan_paths,
+    )
+
+    assert "obs" in DEFAULT_ROOTS
+    obs_root = [p for p in default_src_paths() if p.endswith("obs")]
+    assert obs_root
+    findings = scan_paths(obs_root)
+    gating = [f for f in findings if f.severity in ("error", "warning")]
+    assert gating == [], [(f.where, f.code) for f in gating]
+
+
 # ---------------------------------------------------------------------------
 # hostsync stale-pragma hygiene (ISSUE 4 satellite)
 

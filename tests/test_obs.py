@@ -1,0 +1,385 @@
+"""fftrace observability slice: metrics registry, span recorder,
+Chrome-trace export, tick ledger, and predicted-vs-measured calibration
+(obs/ + tools/fftrace.py)."""
+
+import gzip
+import json
+import threading
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, obs
+from flexflow_tpu.obs.calibrate import (
+    calibration_report,
+    predict_tick_seconds,
+    stamp_ledger_meta,
+    tick_tokens,
+)
+from flexflow_tpu.obs.ledger import TickLedger, parse_shape_key, shape_key
+from flexflow_tpu.obs.metrics import (
+    COUNT_BUCKETS,
+    Histogram,
+    MetricsRegistry,
+    flatten_scalars,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_recorder():
+    """Span recording is process-global: never leak it across tests."""
+    obs.disable()
+    yield
+    obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# metrics: histogram bucket math + Prometheus text
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_bucket_math():
+    h = Histogram([0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    # per-bucket counts: le 0.1 -> 1, le 1.0 -> 2, le 10.0 -> 1, +Inf -> 1
+    assert h.counts == [1, 2, 1, 1]
+    assert h.count == 5
+    assert h.sum == pytest.approx(56.05)
+    d = h.to_json()
+    assert d["count"] == 5
+    assert 0.1 <= d["p50"] <= 1.0          # 3rd of 5 samples sits in (0.1, 1]
+    assert d["p95"] >= 10.0                # tail clamps at/past the last bound
+    # boundary values land in the bucket whose le bound they equal
+    h2 = Histogram([1.0, 2.0])
+    h2.observe(1.0)
+    assert h2.counts == [1, 0, 0]
+
+
+def test_histogram_rejects_bad_bounds():
+    with pytest.raises(ValueError):
+        Histogram([1.0, 0.5])
+    with pytest.raises(ValueError):
+        Histogram([])
+
+
+def test_flatten_scalars_nested():
+    flat = flatten_scalars(
+        {"a": 1, "b": {"c": 2.5, "d": True, "skip": [1, 2], "n": None}},
+        "g")
+    assert flat == {"g_a": 1.0, "g_b_c": 2.5, "g_b_d": 1.0}
+
+
+def test_registry_prometheus_text():
+    reg = MetricsRegistry()
+    reg.counter("requests_total").inc(3)
+    reg.gauge("live_slots").set(2)
+    h = reg.histogram("tick_latency_s")
+    h.observe(0.002)
+    h.observe(0.2)
+    text = reg.prometheus_text(extra_scalars={"decode_steps": 7.0,
+                                              "pool_pages_free": 5.0})
+    assert "# TYPE ff_requests_total counter" in text
+    assert "ff_requests_total 3" in text
+    assert "# TYPE ff_live_slots gauge" in text
+    assert "# TYPE ff_tick_latency_s histogram" in text
+    assert 'ff_tick_latency_s_bucket{le="+Inf"} 2' in text
+    assert "ff_tick_latency_s_count 2" in text
+    assert "ff_tick_latency_s_sum" in text
+    # extra scalars: *_steps renders as a counter, the rest as gauges
+    assert "# TYPE ff_decode_steps counter" in text
+    assert "# TYPE ff_pool_pages_free gauge" in text
+    # buckets are cumulative and non-decreasing
+    vals = [int(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+            if ln.startswith("ff_tick_latency_s_bucket")]
+    assert vals == sorted(vals) and vals[-1] == 2
+
+
+def test_registry_json_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.histogram("h", COUNT_BUCKETS).observe(3)
+    doc = json.loads(json.dumps(reg.to_json()))
+    assert doc["c"] == 1
+    assert doc["h"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# spans: nesting, threading, Chrome-trace export, disabled-mode overhead
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_threads(tmp_path):
+    rec = obs.enable()
+    with obs.span("tick") as sp:
+        assert sp
+        sp.set(live=2)
+        with obs.span("inner"):
+            pass
+
+    def other():
+        with obs.span("worker") as w:
+            w.set(idx=1)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    obs.disable()
+
+    names = [e[0] for e in rec.events]
+    assert names == ["inner", "tick", "worker"]  # inner closes first
+    tids = {e[0]: e[3] for e in rec.events}
+    assert tids["tick"] == tids["inner"] != tids["worker"]
+    # nesting: inner's interval lies within tick's
+    by = {e[0]: e for e in rec.events}
+    assert by["tick"][1] <= by["inner"][1]
+    assert (by["inner"][1] + by["inner"][2]
+            <= by["tick"][1] + by["tick"][2])
+
+    doc = rec.chrome_trace()
+    evs = doc["traceEvents"]
+    assert {e["ph"] for e in evs} <= {"X", "M"}
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert [e["ts"] for e in xs] == sorted(e["ts"] for e in xs)
+    assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(xs[0])
+    assert xs[0]["ts"] >= 0.0
+    # two threads -> two named tid rows in the tick-loop process
+    assert sum(1 for e in evs
+               if e["ph"] == "M" and e["name"] == "thread_name"
+               and e["pid"] == 1) == 2
+
+    # gz export is valid gzipped JSON with the same events
+    p = rec.export_chrome_trace(str(tmp_path / "t.json.gz"))
+    with gzip.open(p, "rt") as f:
+        doc2 = json.load(f)
+    assert len(doc2["traceEvents"]) == len(evs)
+
+
+def test_request_lifecycle_tracks():
+    rec = obs.enable()
+    t = 1000.0
+    rec.record_request(t, t + 0.5, t + 0.7, t + 1.2, label="req 1",
+                       attrs={"generated_tokens": 5})
+    rec.record_request(t, None, None, t + 0.1, label="req 2", attrs={})
+    obs.disable()
+    doc = rec.chrome_trace()
+    reqs = [e for e in doc["traceEvents"]
+            if e["ph"] == "X" and e["pid"] == 2]
+    names = {e["name"] for e in reqs}
+    # admitted request gets queued/prefill/decode phases; the never-
+    # admitted one collapses to a single queued span
+    assert {"queued", "prefill", "decode"} <= names
+    r1 = [e for e in reqs if e["tid"] == 1]
+    assert sum(e["dur"] for e in r1) == pytest.approx(1.2e6, rel=1e-3)
+
+
+def test_disabled_mode_is_free():
+    assert not obs.enabled()
+    # identity: every disabled span() call returns the shared singleton
+    sp = obs.span("decode_tick")
+    assert sp is obs.span("other") is obs.NULL_SPAN
+    assert not sp
+    with sp as inner:
+        assert inner is obs.NULL_SPAN
+
+    # allocation guard: the disabled tick-path pattern must not allocate
+    # per call inside the obs package (the null span is pre-built).
+    # A handful of one-off interpreter-cache allocations are tolerated;
+    # anything O(iterations) fails.
+    obs_dir = obs.__file__.rsplit("/", 1)[0]
+    iters = 2000
+
+    def tick():
+        with obs.span("decode_tick") as s:
+            if s:
+                s.set(live=3)
+
+    for _ in range(16):
+        tick()  # warm any lazy setup
+    tracemalloc.start()
+    s1 = tracemalloc.take_snapshot()
+    for _ in range(iters):
+        tick()
+    s2 = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    new_allocs = sum(
+        d.count_diff for d in s2.compare_to(s1, "filename")
+        if d.traceback[0].filename.startswith(obs_dir) and d.count_diff > 0)
+    assert new_allocs < iters // 100
+
+
+def test_recorder_drops_beyond_max_events():
+    rec = obs.enable(max_events=4)
+    for i in range(10):
+        with obs.span("e"):
+            pass
+    obs.disable()
+    assert len(rec.events) == 4
+    assert rec.dropped == 6
+
+
+# ---------------------------------------------------------------------------
+# tick ledger + calibration
+# ---------------------------------------------------------------------------
+
+
+def test_shape_key_roundtrip():
+    k = shape_key("verify", batch=3, chunk=0, width=7)
+    assert k == "verify|b3|c0|w7"
+    assert parse_shape_key(k) == {"phase": "verify", "batch": 3,
+                                  "chunk": 0, "width": 7}
+
+
+def test_ledger_stats_bounding_and_roundtrip(tmp_path):
+    led = TickLedger(max_samples_per_shape=8)
+    for i in range(20):
+        led.record("decode", 0.01 * (i + 1), batch=2)
+    led.record("prefill", 0.5, batch=1, chunk=32)
+    st = led.stats("decode|b2|c0|w1")
+    assert st["count"] == 20          # true event count survives...
+    assert st["sampled"] == 8         # ...but only the window is kept
+    assert st["min_s"] == pytest.approx(0.13)  # oldest samples evicted
+    assert st["max_s"] == pytest.approx(0.20)
+    led.meta["note"] = "x"
+    led2 = TickLedger.from_json(json.loads(json.dumps(led.to_json())))
+    assert led2.shapes() == led.shapes()
+    assert led2.stats("decode|b2|c0|w1") == st
+    assert led2.meta["note"] == "x"
+    p = led.save(str(tmp_path / "led.json"))
+    assert TickLedger.load(p).stats("prefill|b1|c32|w1")["count"] == 1
+
+
+def test_tick_tokens_and_prediction():
+    assert tick_tokens("decode", 4, 0, 1) == 4
+    assert tick_tokens("verify", 4, 0, 7) == 28
+    assert tick_tokens("prefill", 4, 32, 1) == 32
+    # base step prices 100 tokens in 1s -> a 4-row decode tick is 40ms
+    assert predict_tick_seconds(1.0, 100, "decode", 4) == pytest.approx(0.04)
+
+
+def test_calibration_report_math():
+    led = TickLedger()
+    for _ in range(5):
+        led.record("decode", 0.04, batch=2)     # predicted 0.02 -> ratio 2
+        led.record("verify", 0.07, batch=1, width=7)  # pred 0.07 -> ratio 1
+    predicted = {"predicted_step_s": 1.0, "graph_tokens": 100,
+                 "pricing_mode": "test"}
+    rep = calibration_report(led, predicted=predicted)
+    assert rep["base"]["pricing_mode"] == "test"
+    dk = shape_key("decode", 2)
+    assert rep["shapes"][dk]["predicted_s"] == pytest.approx(0.02)
+    assert rep["shapes"][dk]["ratio"] == pytest.approx(2.0)
+    assert rep["tick_scales"][dk] == pytest.approx(2.0)
+    assert rep["phases"]["decode"] == pytest.approx(2.0)
+    assert rep["phases"]["verify"] == pytest.approx(1.0)
+
+    # an unstamped ledger refuses to calibrate
+    with pytest.raises(ValueError, match="predicted_step_s"):
+        calibration_report(TickLedger())
+
+
+def test_measured_cost_model_consumes_tick_scales():
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+    from flexflow_tpu.search.measured import MeasuredCostModel
+
+    m = MeasuredCostModel(TPUMachineModel.make("v5e", 8), {"data": 8})
+    assert m.tick_scale("decode", 2) == 1.0  # uncalibrated -> identity
+    n = m.set_tick_calibration({
+        "tick_scales": {shape_key("decode", 2): 2.5,
+                        shape_key("verify", 2, width=7): 4.0},
+        "phases": {"decode": 3.0},
+    })
+    assert n == 2  # exact shapes (phase fallbacks stored separately)
+    assert m.tick_scale("decode", 2) == pytest.approx(2.5)       # exact
+    assert m.tick_scale("decode", 16) == pytest.approx(3.0)      # phase med.
+    assert m.tick_scale("prefill", 1, chunk=8) == 1.0            # unknown
+    # a bare {key: ratio} dict (tick_scales alone) is accepted too
+    m2 = MeasuredCostModel(TPUMachineModel.make("v5e", 8), {"data": 8})
+    m2.set_tick_calibration({shape_key("decode", 4): 1.5})
+    assert m2.tick_scale("decode", 4) == pytest.approx(1.5)
+    with pytest.raises(TypeError):
+        m2.set_tick_calibration([1, 2])
+
+
+# ---------------------------------------------------------------------------
+# end to end: traced paged+speculative serving -> trace + calibration
+# ---------------------------------------------------------------------------
+
+
+def _causal_lm():
+    from flexflow_tpu import DataType
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+
+    lcfg = LlamaConfig.tiny()
+    ff = FFModel(FFConfig(batch_size=1, seed=7))
+    build_llama(ff, lcfg, batch_size=1, seq_len=8, dtype=DataType.FLOAT)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff, lcfg
+
+
+def test_traced_serving_end_to_end(tmp_path):
+    """A paged + speculative serving run under obs.enable() yields a
+    Perfetto-loadable trace with nested tick-phase spans and per-request
+    lifecycle tracks, a populated tick ledger, and a calibration report
+    whose scales MeasuredCostModel accepts (ISSUE 8 acceptance)."""
+    from flexflow_tpu.spec import SpecConfig
+
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 6, 4)]
+    rec = obs.enable()
+    try:
+        for speculate in (None, SpecConfig(width=2, depth=3)):
+            server = ff.serve_generation(slots=2, max_len=32, paged=True,
+                                         page_size=8, speculate=speculate)
+            try:
+                futs = [server.submit(p, max_new_tokens=4) for p in prompts]
+                for f in futs:
+                    f.result(timeout=300)
+            finally:
+                server.stop()
+    finally:
+        obs.disable()
+
+    names = {e[0] for e in rec.events}
+    assert {"tick_prep", "admit_pending", "prefill_tick", "decode_tick",
+            "draft", "verify", "commit"} <= names
+    assert len(rec.requests) == 2 * len(prompts)
+
+    # decode AND verify tick shapes landed in the ledger
+    phases = {parse_shape_key(k)["phase"] for k in rec.ledger.shapes()}
+    assert {"decode", "verify"} <= phases
+
+    # stamped ledger -> saved artifact -> calibration report, offline
+    stamp_ledger_meta(rec.ledger, ff, fixture="test")
+    path = rec.ledger.save(str(tmp_path / "ledger.json"))
+    rep = calibration_report(TickLedger.load(path))
+    assert rep["base"]["predicted_step_s"] > 0
+    assert set(rep["phases"]) >= {"decode", "verify"}
+    assert all(r > 0 for r in rep["tick_scales"].values())
+
+    trace = rec.export_chrome_trace(str(tmp_path / "trace.json"))
+    doc = json.load(open(trace))
+    assert any(e["ph"] == "X" and e["pid"] == 2 and e["name"] == "decode"
+               for e in doc["traceEvents"])
+
+
+def test_fftrace_calibrate_cli(tmp_path, capsys):
+    import tools.fftrace as fft
+
+    led = TickLedger()
+    led.record("decode", 0.03, batch=2)
+    led.meta.update({"predicted_step_s": 1.0, "graph_tokens": 100})
+    p = str(tmp_path / "led.json")
+    led.save(p)
+    out = str(tmp_path / "rep.json")
+    assert fft.main(["calibrate", p, "--out", out]) == 0
+    rep = json.load(open(out))
+    assert rep["tick_scales"][shape_key("decode", 2)] == pytest.approx(1.5)
+    # unstamped ledger -> clean CLI error, not a traceback
+    p2 = str(tmp_path / "bare.json")
+    TickLedger().save(p2)
+    assert fft.main(["calibrate", p2]) == 2
+    capsys.readouterr()

@@ -243,6 +243,73 @@ def test_http_metrics_endpoint_exposes_pool_and_prefix_cache():
         gen.stop()
 
 
+def test_http_prometheus_metrics_endpoint():
+    """GET /metrics serves Prometheus text exposition (ff_ prefix) off
+    the SAME registry as the JSON metrics payload: typed counters and
+    gauges from the flattened server metrics plus the tick-latency /
+    TTFT histograms with cumulative le buckets (ISSUE 8 satellite)."""
+    import urllib.request
+
+    from flexflow_tpu.serving import http_serve, serve
+
+    ff, lcfg = _causal_lm()
+    fwd = serve(ff, batch_sizes=(1,), warmup=False)
+    gen = ff.serve_generation(slots=2, max_len=32, paged=True, page_size=4)
+    httpd = http_serve(fwd, port=0, model_name="lm", generation_server=gen)
+    try:
+        rs = np.random.RandomState(5)
+        prompt = rs.randint(0, lcfg.vocab_size, (6,)).astype(np.int32)
+        gen.generate(prompt, max_new_tokens=3)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with urllib.request.urlopen(f"{base}/metrics") as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "# TYPE ff_generation_requests_served counter" in text
+        assert "ff_generation_requests_served 1" in text
+        assert "# TYPE ff_generation_pool_occupancy gauge" in text
+        assert "# TYPE ff_tick_latency_s histogram" in text
+        assert "# TYPE ff_ttft_s histogram" in text
+        assert 'ff_tick_latency_s_bucket{le="+Inf"}' in text
+        assert "ff_tick_latency_s_sum" in text
+        # histogram buckets are cumulative (non-decreasing)
+        vals = [int(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+                if ln.startswith("ff_tick_latency_s_bucket")]
+        assert vals == sorted(vals) and vals[-1] >= 1
+        # the Prometheus count and the JSON histogram agree — one registry
+        assert (f"ff_ttft_s_count "
+                f"{gen.registry.histogram('ttft_s').count}") in text
+    finally:
+        httpd.shutdown()
+        fwd.stop()
+        gen.stop()
+
+
+def test_request_metric_retention_is_bounded():
+    """Per-request records live in a ring buffer: with
+    request_record_limit=2 only the 2 newest records survive, while the
+    cumulative counters keep counting every request (ISSUE 8 satellite)."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(6)
+    server = ff.serve_generation(slots=2, max_len=32, paged=True,
+                                 page_size=4, request_record_limit=2)
+    try:
+        for n in (3, 5, 4):
+            server.generate(
+                rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32),
+                max_new_tokens=3)
+        m = server.metrics()
+        assert m["requests_served"] == 3          # counters: unaffected
+        assert len(m["requests"]) == 2            # records: bounded
+        # the retained records are the NEWEST two (prompts of 5 and 4)
+        assert [r["prefill_tokens"] + r["cached_prefill_tokens"]
+                for r in m["requests"]] == [5, 4]
+        assert m["histograms"]["ttft_s"]["count"] == 3
+    finally:
+        server.stop()
+    with pytest.raises(ValueError):
+        ff.serve_generation(slots=1, max_len=16, request_record_limit=0)
+
+
 def test_generation_server_stop_contract():
     """submit after stop raises; bad max_new_tokens rejected; stop cancels
     (never silently truncates) in-flight work."""

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""fftrace — trace/metrics tooling for the serving tick loop (obs/).
+
+Subcommands:
+
+  smoke [--out DIR] [--speculate]
+      Build a tiny causal LM on CPU, serve a handful of requests through
+      the paged scheduler (and the speculative server with --speculate,
+      the default) with the span recorder + tick ledger enabled, then
+      write into DIR (default ./fftrace_out):
+        trace.json.gz    Chrome-trace / Perfetto trace_event JSON
+        ledger.json      TickLedger with the priced base step stamped in
+        calibration.json predicted-vs-measured report (fftrace calibrate)
+      The last stdout line is a one-line JSON summary.
+
+  calibrate LEDGER [--out FILE]
+      Load a saved TickLedger and emit the calibration report: per
+      tick-shape measured-vs-predicted ratios (the scale factors
+      MeasuredCostModel.set_tick_calibration consumes) plus per-phase
+      medians. Runs from the artifact alone — no model, no accelerator.
+
+  summarize TRACE
+      Per-span-name counts and total/mean durations of a trace written
+      by `smoke` (or TraceRecorder.export_chrome_trace), .gz or plain.
+
+Open trace.json.gz directly in https://ui.perfetto.dev (it accepts
+gzipped Chrome traces) — pid 1 is the tick loop, pid 2 the per-request
+lifecycle tracks. See docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_tiny_ff():
+    """The bench/test smoke fixture: a tiny Llama compiled for serving."""
+    from flexflow_tpu import FFConfig, FFModel, LossType
+    from flexflow_tpu.ffconst import DataType
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+
+    ff = FFModel(FFConfig(batch_size=1, seed=0))
+    build_llama(ff, LlamaConfig.tiny(vocab=128), batch_size=1, seq_len=8,
+                dtype=DataType.FLOAT)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def cmd_smoke(args) -> int:
+    # CPU only: the smoke run must work headless in CI
+    from flexflow_tpu.parallel.compat import ensure_cpu_devices
+
+    ensure_cpu_devices(8)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from flexflow_tpu import obs
+    from flexflow_tpu.obs.calibrate import (
+        calibration_report,
+        stamp_ledger_meta,
+    )
+
+    out = args.out
+    os.makedirs(out, exist_ok=True)
+    ff = _build_tiny_ff()
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 128, (rs.randint(4, 13),)).astype(np.int32)
+               for _ in range(args.requests)]
+
+    rec = obs.enable()
+
+    def serve(speculate=None):
+        server = ff.serve_generation(slots=2, max_len=48, paged=True,
+                                     page_size=8, speculate=speculate)
+        try:
+            futs = [server.submit(p, max_new_tokens=args.max_new)
+                    for p in prompts]
+            for f in futs:
+                f.result(timeout=600)
+            return server.metrics()
+        finally:
+            server.stop()
+
+    try:
+        serve()  # plain paged: decode + prefill tick shapes
+        if args.speculate:
+            from flexflow_tpu.spec import SpecConfig
+
+            serve(SpecConfig(width=2, depth=3))  # verify tick shapes
+    finally:
+        obs.disable()
+
+    stamp_ledger_meta(rec.ledger, ff, fixture="fftrace smoke")
+    trace_path = rec.export_chrome_trace(os.path.join(out, "trace.json.gz"))
+    ledger_path = rec.ledger.save(os.path.join(out, "ledger.json"))
+    report = calibration_report(rec.ledger)
+    calib_path = os.path.join(out, "calibration.json")
+    with open(calib_path, "w") as f:
+        json.dump(report, f, indent=1)
+
+    print(json.dumps({
+        "trace": trace_path,
+        "ledger": ledger_path,
+        "calibration": calib_path,
+        "events": len(rec.events),
+        "requests": len(rec.requests),
+        "shapes": sorted(report["tick_scales"]),
+        "phases": {k: round(v, 3) for k, v in report["phases"].items()},
+    }))
+    return 0
+
+
+def cmd_calibrate(args) -> int:
+    from flexflow_tpu.obs.ledger import TickLedger
+    from flexflow_tpu.obs.calibrate import calibration_report
+
+    led = TickLedger.load(args.ledger)
+    try:
+        report = calibration_report(led)
+    except ValueError as e:
+        print(f"fftrace calibrate: {e}", file=sys.stderr)
+        return 2
+    doc = json.dumps(report, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc + "\n")
+        print(args.out)
+    else:
+        print(doc)
+    return 0
+
+
+def cmd_summarize(args) -> int:
+    opener = gzip.open if args.trace.endswith(".gz") else open
+    with opener(args.trace, "rt") as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    by_name = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev["name"].split(":", 1)[0]  # collapse per-request labels
+        n, total = by_name.get(name, (0, 0.0))
+        by_name[name] = (n + 1, total + float(ev.get("dur", 0.0)))
+    width = max((len(n) for n in by_name), default=4)
+    print(f"{'span':<{width}}  {'count':>6}  {'total_ms':>10}  {'mean_us':>9}")
+    for name, (n, total) in sorted(by_name.items(),
+                                   key=lambda kv: -kv[1][1]):
+        print(f"{name:<{width}}  {n:>6}  {total / 1e3:>10.2f}  "
+              f"{total / n:>9.1f}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fftrace", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sm = sub.add_parser("smoke", help="traced tiny-model serving run")
+    sm.add_argument("--out", default="fftrace_out")
+    sm.add_argument("--requests", type=int, default=4)
+    sm.add_argument("--max-new", type=int, default=8)
+    sm.add_argument("--no-speculate", dest="speculate", action="store_false")
+    sm.set_defaults(func=cmd_smoke, speculate=True)
+
+    ca = sub.add_parser("calibrate", help="predicted-vs-measured report")
+    ca.add_argument("ledger")
+    ca.add_argument("--out", default=None)
+    ca.set_defaults(func=cmd_calibrate)
+
+    su = sub.add_parser("summarize", help="per-span totals of a trace")
+    su.add_argument("trace")
+    su.set_defaults(func=cmd_summarize)
+
+    args = ap.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
