@@ -5,7 +5,7 @@ Unity-style search is only trustworthy while its invariants hold; round-5
 review enforced them by human advisor (two cost-model/lowering pricing
 divergences shipped, 377/408 corpus rules silently inert with no tool to
 say why). This subsystem turns those recurring review findings into a CI
-gate. Four passes ship (registered like op lowerings, so future PRs add
+gate. Five passes ship (registered like op lowerings, so future PRs add
 passes, not frameworks):
 
   consistency — strategy/sharding algebra per node: degrees divide dims,
@@ -24,6 +24,14 @@ passes, not frameworks):
       peak HBM) and diffs it against the cost model's priced-events
       manifest. Compiles XLA programs, so the CLI runs it only when
       selected (--passes hloaudit / all).
+  poolcheck   — the paged serving state machine: an explicit-state model
+      checker BFS-explores bounded configurations of the REAL PagePool +
+      scheduler bookkeeping (admission/COW/free/defrag/preempt/spec-
+      commit), asserting the declarative invariant catalog
+      (pool_invariants.py) at every reachable state and reporting
+      minimal counterexample traces; plus an AST lint arm for
+      write-after-share, page-table, pool-encapsulation, and
+      lock-discipline hazards (pragma-annotatable like hostsync).
 
 CLI: tools/fflint.py (--json, --strict, per-pass selection, --sarif);
 tier-1 gates on zero strict findings via tests/test_analysis.py. See
@@ -83,6 +91,16 @@ class AnalysisContext:
     hlo_opts: Optional[object] = None
     # hloaudit per-subject program summaries, filled by the pass
     hlo_summary: Optional[Dict] = None
+    # poolcheck controls: lint arm only (--since mode), a PagePool
+    # subclass to check (the seeded-mutation fixtures), harness-level
+    # mutation labels, and a directory for counterexample trace JSONs
+    poolcheck_lint_only: bool = False
+    poolcheck_pool_factory: Optional[Callable] = None
+    poolcheck_mutations: Optional[List[str]] = None
+    poolcheck_trace_dir: Optional[str] = None
+    # model-check summary (explored/distinct states per config), filled
+    # by the pass
+    poolcheck_summary: Optional[Dict] = None
 
 
 @dataclasses.dataclass
@@ -158,6 +176,7 @@ def _ensure_registered() -> None:
         consistency,
         hloaudit,
         hostsync,
+        poolcheck,
         rulesat,
     )
 

@@ -1,0 +1,226 @@
+"""Declarative invariant catalog for the paged serving state machine.
+
+ONE catalog, consumed by three clients:
+
+  * the poolcheck model checker (analysis/poolcheck.py) asserts every
+    entry at every reachable state of its bounded exploration;
+  * `PagePool.check_invariants()` (paged/pool.py) runs the pool-scope
+    entries as a debug hook — the randomized op-sequence fuzz test in
+    tests/test_paged.py calls it after every op;
+  * docs/paged.md renders the catalog as the invariant table that
+    replaced the old prose guarantees (each entry's name is the
+    poolcheck finding code, `inv-<name>`).
+
+Pool-scope entries take only the pool (plus an optional owners map);
+op-scope entries (cow-write, defrag-preserve) are enforced by the model
+checker AT THE MUTATING OPERATION, where the write/remap is visible —
+they have no `check` function here, only the spec the checker implements.
+
+This module is dependency-free on purpose: paged/pool.py imports it
+lazily inside check_invariants(), and analysis/poolcheck.py imports it
+eagerly, so neither direction creates an import cycle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Invariant:
+    """One catalog entry. `name` doubles as the poolcheck finding code
+    suffix (`inv-<name>`); `scope` is where it can be evaluated:
+
+      pool    — a function of the PagePool alone (check(pool));
+      owners  — needs the live owner map {owner_id: [pages]} the
+                scheduler/harness holds (check(pool, owners));
+      rows    — needs the per-page committed-row counts only the model
+                checker tracks (check(pool, committed));
+      op      — only observable at the mutating operation itself; the
+                model checker enforces it inline (check is None).
+    """
+
+    name: str
+    scope: str
+    description: str
+    check: Optional[Callable] = None
+
+
+# ---------------------------------------------------------------------------
+# pool-scope checks (each returns a list of "name: detail" violations)
+
+
+def _free_accounting(pool) -> List[str]:
+    v = []
+    free, lru, refs = set(pool._free), set(pool._lru), set(pool._refs)
+    if len(pool._free) != len(free):
+        v.append(f"free list holds duplicates: {sorted(pool._free)}")
+    for a, b, la, lb in ((free, lru, "free", "lru"),
+                         (free, refs, "free", "refs"),
+                         (lru, refs, "lru", "refs")):
+        both = a & b
+        if both:
+            v.append(f"pages {sorted(both)} are in both {la} and {lb}")
+    everywhere = free | lru | refs
+    if 0 in everywhere:
+        v.append("null page 0 entered the allocator")
+    bad = [p for p in everywhere if not 1 <= p < pool.num_pages]
+    if bad:
+        v.append(f"out-of-range page ids {sorted(bad)}")
+    total = len(free) + len(lru) + len(refs)
+    if total != pool.capacity:
+        v.append(f"free({len(free)}) + cached({len(lru)}) + "
+                 f"live({len(refs)}) = {total} != capacity "
+                 f"{pool.capacity}")
+    bad_refs = {p: r for p, r in pool._refs.items() if r < 1}
+    if bad_refs:
+        v.append(f"non-positive refcounts {bad_refs}")
+    return [f"free-accounting: {m}" for m in v]
+
+
+def _dead_list(pool) -> List[str]:
+    v = []
+    for p in pool._lru:
+        if p in pool._refs:
+            v.append(f"page {p} is dead-cached AND refcounted")
+        if not pool._keys_of.get(p):
+            v.append(f"page {p} is dead-cached but has no hash-index "
+                     "entry (unhittable; it should be on the free list)")
+    for p, keys in pool._keys_of.items():
+        if keys and p not in pool._refs and p not in pool._lru:
+            v.append(f"page {p} is hash-registered ({keys}) but neither "
+                     "live nor dead-cached — a lookup would revive a "
+                     "freed page")
+    return [f"dead-list: {m}" for m in v]
+
+
+def _index(pool) -> List[str]:
+    v = []
+    for h, p in pool._full.items():
+        if ("full", h) not in pool._keys_of.get(p, []):
+            v.append(f"full entry {h[:8]} -> {p} missing from the "
+                     "inverse index")
+    for h, (p, toks) in pool._partial.items():
+        if ("partial", h) not in pool._keys_of.get(p, []):
+            v.append(f"partial entry {h[:8]} -> {p} missing from the "
+                     "inverse index")
+        if not 0 < len(toks) < pool.page_size:
+            v.append(f"partial entry {h[:8]} -> {p} has {len(toks)} "
+                     f"tail tokens (must be in (0, page_size))")
+    for p, keys in pool._keys_of.items():
+        for kind, h in keys:
+            if kind == "full" and pool._full.get(h) != p:
+                v.append(f"inverse entry ('full', {h[:8]}) on page {p} "
+                         f"points elsewhere ({pool._full.get(h)})")
+            elif kind == "partial" and \
+                    pool._partial.get(h, (None,))[0] != p:
+                v.append(f"inverse entry ('partial', {h[:8]}) on page "
+                         f"{p} points elsewhere")
+    return [f"index: {m}" for m in v]
+
+
+def _refcount_owners(pool, owners: Dict[object, Sequence[int]]
+                     ) -> List[str]:
+    held: Dict[int, int] = {}
+    for pages in owners.values():
+        for p in pages:
+            held[p] = held.get(p, 0) + 1
+    v = []
+    for p in set(held) | set(pool._refs):
+        if pool._refs.get(p, 0) != held.get(p, 0):
+            v.append(f"page {p}: refcount {pool._refs.get(p, 0)} != "
+                     f"{held.get(p, 0)} live owner-table references")
+    return [f"refcount-owners: {m}" for m in v]
+
+
+def _spec_scratch(pool, committed: Dict[int, int]) -> List[str]:
+    """Published pages hold only COMMITTED K/V rows: a full entry
+    implies every row committed; a partial entry implies at least its
+    registered tail rows. Tree scratch (rows written past the committed
+    head by speculative verify) must never reach the index."""
+    v = []
+    for h, p in pool._full.items():
+        c = committed.get(p, 0)
+        if c < pool.page_size:
+            v.append(f"full-registered page {p} has only {c}/"
+                     f"{pool.page_size} committed rows (scratch or "
+                     "unwritten rows were published)")
+    for h, (p, toks) in pool._partial.items():
+        c = committed.get(p, 0)
+        if c < len(toks):
+            v.append(f"partial-registered page {p} names {len(toks)} "
+                     f"tail rows but only {c} are committed")
+    return [f"spec-scratch: {m}" for m in v]
+
+
+CATALOG: Tuple[Invariant, ...] = (
+    Invariant(
+        "free-accounting", "pool",
+        "free + dead-cached + live page counts sum to capacity; the "
+        "three sets are disjoint, in range, and never contain the null "
+        "page; refcounts are positive",
+        _free_accounting),
+    Invariant(
+        "dead-list", "pool",
+        "a page is on the LRU dead list iff its refcount is 0 AND it is "
+        "hash-registered; every registered page is live or dead-cached, "
+        "never free",
+        _dead_list),
+    Invariant(
+        "index", "pool",
+        "the full/partial hash indexes and the per-page inverse index "
+        "(_keys_of) agree exactly; partial tails name 1..page_size-1 "
+        "rows",
+        _index),
+    Invariant(
+        "refcount-owners", "owners",
+        "every page's refcount equals the number of live owner-table "
+        "references to it (checked at operation boundaries)",
+        _refcount_owners),
+    Invariant(
+        "spec-scratch", "rows",
+        "pages named by the hash index hold only committed K/V rows — "
+        "speculative tree scratch is never registered before its commit",
+        _spec_scratch),
+    Invariant(
+        "cow-write", "op",
+        "no row write lands in a page the writer does not own, a page "
+        "with refcount != 1, or rows a hash-index entry has published "
+        "(shared pages are written only via the COW clone helper)"),
+    Invariant(
+        "defrag-preserve", "op",
+        "defrag returns a true permutation that fixes the null page and "
+        "rewrites refcounts, LRU order, both hash indexes, and every "
+        "owner's page list by the same old→new bijection"),
+)
+
+
+def by_name(name: str) -> Invariant:
+    for entry in CATALOG:
+        if entry.name == name:
+            return entry
+    raise KeyError(name)
+
+
+def check_pool(pool, owners: Optional[Dict[object, Sequence[int]]] = None
+               ) -> List[str]:
+    """Run every pool-scope invariant (and refcount-owners when an
+    owners map is given). Returns 'name: detail' violation strings."""
+    v: List[str] = []
+    for entry in CATALOG:
+        if entry.scope == "pool":
+            v += entry.check(pool)
+        elif entry.scope == "owners" and owners is not None:
+            v += entry.check(pool, owners)
+    return v
+
+
+def check_committed(pool, committed: Dict[int, int]) -> List[str]:
+    """Run the committed-rows invariants (model checker / fuzz harness
+    only — the live scheduler does not track per-page committed rows)."""
+    v: List[str] = []
+    for entry in CATALOG:
+        if entry.scope == "rows":
+            v += entry.check(pool, committed)
+    return v

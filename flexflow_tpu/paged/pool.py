@@ -320,3 +320,19 @@ class PagePool:
                          for h, (p, t) in self._partial.items()}
         self._free = list(range(self.num_pages - 1, len(allocated), -1))
         return perm, old_to_new
+
+    def check_invariants(self, owners: Optional[dict] = None) -> None:
+        """Debug hook: assert the declarative invariant catalog
+        (analysis/pool_invariants.py, rendered in docs/paged.md) over
+        the current bookkeeping state. `owners` is an optional
+        {owner_id: [pages]} map of every live page list, enabling the
+        refcount-equals-owner-references check. Raises AssertionError
+        naming every violated invariant. O(pages + index entries) —
+        cheap enough for tests after every op (tests/test_paged.py's
+        fuzz harness), too hot for the serving loop."""
+        from flexflow_tpu.analysis import pool_invariants  # lazy: no cycle
+        violations = pool_invariants.check_pool(self, owners)
+        if violations:
+            raise AssertionError(
+                "PagePool invariant violation(s):\n  "
+                + "\n  ".join(violations))

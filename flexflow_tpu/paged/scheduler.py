@@ -136,7 +136,7 @@ class PagedGenerationServer(_GenerationServerBase):
                 f"{self.page_size}) but the pool only holds "
                 f"{self.pool.capacity}; raise num_pages")
 
-    def metrics(self) -> dict:
+    def metrics(self) -> dict:  # fflint: lock-ok (relaxed metrics snapshot; int/float reads are atomic, staleness is fine for scraping)
         """Aggregate serving metrics + the per-request records of the
         last MAX_REQUEST_RECORDS completed requests (queue time, TTFT,
         prefill/decode tokens, pages — see _GenerationServerBase), plus

@@ -97,7 +97,7 @@ class Server:
 
     def submit(self, *inputs: np.ndarray) -> Future:
         """Queue one request (batch dim may be any size ≥ 1)."""
-        if not self._running:
+        if not self._running:  # fflint: lock-ok (admission race is benign: a stop() after this check just drains the queued future)
             raise RuntimeError("server is stopped")
         req = _Request([np.asarray(x) for x in inputs])
         self._q.put(req)
@@ -124,7 +124,7 @@ class Server:
                 req.future.set_exception(RuntimeError("server stopped"))
 
     @property
-    def requests_served(self) -> int:
+    def requests_served(self) -> int:  # fflint: lock-ok (monotonic counter; a stale read is fine)
         return self._served
 
     # -- scheduler ------------------------------------------------------
@@ -584,7 +584,7 @@ class _GenerationServerBase:
                  temperature: float = 0.0) -> np.ndarray:
         return self.submit(prompt_ids, max_new_tokens, temperature).result()
 
-    def stop(self):
+    def stop(self):  # fflint: lock-ok (_thread is written once at _start, before any stop() can race)
         with self._lock:
             self._running = False
             self._stop.set()
@@ -596,14 +596,14 @@ class _GenerationServerBase:
             self._drain()
 
     @property
-    def requests_served(self) -> int:
+    def requests_served(self) -> int:  # fflint: lock-ok (monotonic counter; a stale read is fine)
         return self._served
 
     @property
-    def decode_steps(self) -> int:
+    def decode_steps(self) -> int:  # fflint: lock-ok (monotonic counter; a stale read is fine)
         return self._steps
 
-    def metrics(self) -> dict:
+    def metrics(self) -> dict:  # fflint: lock-ok (relaxed metrics snapshot; int reads are atomic, staleness is fine for scraping)
         """Aggregate serving metrics + per-request records of the last
         `request_record_limit` COMPLETED requests (subclasses extend:
         paged adds pool/preemption counters, speculative adds acceptance

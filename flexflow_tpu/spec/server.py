@@ -100,7 +100,7 @@ class SpeculativePagedServer(PagedGenerationServer):
 
     # -- metrics -----------------------------------------------------------
 
-    def metrics(self) -> dict:
+    def metrics(self) -> dict:  # fflint: lock-ok (relaxed metrics snapshot; int reads are atomic, staleness is fine for scraping)
         m = super().metrics()
         m["speculative"] = {
             "steps": self.spec_steps,

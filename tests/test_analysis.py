@@ -1,12 +1,16 @@
 """fflint static-analysis subsystem (flexflow_tpu.analysis): pass
-registry, the four passes (consistency / rulesat / hostsync / hloaudit),
-the seeded-defect regression fixtures from ISSUE 3 (a misdeclared
-cost-model comm-spec reintroducing the ulysses h_deg bug shape, an
-unsatisfiable corpus rule, a host-sync in a decode loop) and ISSUE 4 (a
-zeroed priced comm event the lowered-HLO diff must flag with the node
-named, a config whose priced memory exceeds the machine model's HBM
-budget), strategy-file import validation, and the CLI strict gate tier-1
-rides on."""
+registry, the five passes (consistency / rulesat / hostsync / hloaudit /
+poolcheck), the seeded-defect regression fixtures from ISSUE 3 (a
+misdeclared cost-model comm-spec reintroducing the ulysses h_deg bug
+shape, an unsatisfiable corpus rule, a host-sync in a decode loop),
+ISSUE 4 (a zeroed priced comm event the lowered-HLO diff must flag with
+the node named, a config whose priced memory exceeds the machine model's
+HBM budget) and ISSUE 9 (three injected pool defects — a dropped
+refcount decrement in defrag, an in-place write to a shared COW tail, a
+spec scratch page registered pre-commit — each of which the poolcheck
+model checker must catch with a named finding and a replayable minimal
+counterexample trace), strategy-file import validation, and the CLI
+strict gate tier-1 rides on."""
 
 import json
 import os
@@ -921,6 +925,12 @@ def test_fflint_cli_strict_clean_on_baselines_and_corpus():
     payload = json.loads(proc.stdout)
     assert payload["counts"]["error"] == 0
     assert payload["counts"]["warning"] == 0
+    # poolcheck rides the default gate: the model checker must have
+    # fully explored both bounded configs (truncation would be a
+    # warning and fail above)
+    mc = payload["stats"]["poolcheck"]["model_check"]
+    assert mc["explored_states"] > 1000
+    assert set(mc["configs"]) == {"base", "spec"}
     subjects = payload["stats"]["consistency"]["subjects"]
     for cfg_name in ("alexnet_cifar10", "resnet50", "bert_base",
                      "llama_tp_dp", "mixtral_ep", "inception_v3",
@@ -959,3 +969,242 @@ def test_fflint_cli_pass_selection_and_exit_codes(tmp_path):
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# poolcheck: explicit-state model checking + aliasing lints for the
+# paged serving state machine (ISSUE 9)
+
+
+def test_poolcheck_registered_and_in_default_gate():
+    assert "poolcheck" in available_passes()
+    # the CLI default gate includes poolcheck (hloaudit stays opt-in)
+    with open(os.path.join(REPO, "tools", "fflint.py")) as f:
+        src = f.read()
+    assert '"poolcheck")' in src.split("DEFAULT_PASSES")[1][:200]
+
+
+def test_poolcheck_model_clean_and_fully_explored_on_real_pool():
+    """The shipped PagePool + scheduler bookkeeping satisfy the whole
+    invariant catalog over EVERY reachable state of both bounded
+    scenarios — this is the executable spec future pool refactors
+    (ragged kernel, KV tiering, quantized pages) must keep green."""
+    from flexflow_tpu.analysis import poolcheck
+
+    for config in ("base", "spec"):
+        res = poolcheck.model_check(config)
+        assert res.hits == [], res.hits
+        assert not res.truncated
+        floor = 2000 if config == "base" else 800
+        assert res.explored >= floor, (config, res.explored)
+
+
+def test_poolcheck_flags_dropped_refcount_decrement_in_defrag():
+    """Seeded defect 1: defrag() that corrupts a refcount (models a
+    dropped decrement in the remap). The checker must name the broken
+    invariant and hand back a minimal trace ending in the defrag op."""
+    from flexflow_tpu.analysis import poolcheck
+    from flexflow_tpu.paged.pool import PagePool
+
+    class DroppedDecrementPool(PagePool):
+        def defrag(self):
+            perm, old_to_new = super().defrag()
+            if self._refs:
+                self._refs[sorted(self._refs)[0]] += 1
+            return perm, old_to_new
+
+    res = poolcheck.model_check("base", pool_factory=DroppedDecrementPool)
+    names = {h[0] for h in res.hits}
+    assert names & {"defrag-preserve", "refcount-owners"}, res.hits
+    for name, _msg, trace in res.hits:
+        assert trace[-1] == "defrag", trace
+        replayed = poolcheck.replay(trace, "base",
+                                    pool_factory=DroppedDecrementPool)
+        assert any(v.split(":")[0] == name for v in replayed), (trace,
+                                                               replayed)
+
+
+def test_poolcheck_flags_cow_bypass_write_to_shared_tail():
+    """Seeded defect 2: admission maps the shared donor tail page in
+    place of the COW clone — the first write into it must trip the
+    cow-write invariant (refcount!=1 / published rows overwritten)."""
+    from flexflow_tpu.analysis import poolcheck
+
+    res = poolcheck.model_check("base", mutations=("cow_bypass",))
+    assert any(h[0] == "cow-write" for h in res.hits), res.hits
+    name, msg, trace = next(h for h in res.hits if h[0] == "cow-write")
+    assert "refcount" in msg or "partial tail" in msg or "full" in msg
+    replayed = poolcheck.replay(trace, "base", mutations=("cow_bypass",))
+    assert any(v.split(":")[0] == "cow-write" for v in replayed)
+
+
+def test_poolcheck_flags_spec_scratch_registered_before_commit():
+    """Seeded defect 3: speculative verify publishes its drafted tree
+    page before the commit — uncommitted draft K/V reaches the hash
+    index, which the spec-scratch invariant forbids."""
+    from flexflow_tpu.analysis import poolcheck
+
+    res = poolcheck.model_check("spec",
+                                mutations=("scratch_preregister",))
+    assert any(h[0] == "spec-scratch" for h in res.hits), res.hits
+    _n, _m, trace = next(h for h in res.hits if h[0] == "spec-scratch")
+    replayed = poolcheck.replay(trace, "spec",
+                                mutations=("scratch_preregister",))
+    assert any(v.split(":")[0] == "spec-scratch" for v in replayed)
+
+
+def test_poolcheck_pass_reports_findings_summary_and_traces(tmp_path):
+    """Pass-function level: a seeded defect surfaces as an inv-* error
+    Finding with the minimal counterexample in the message, the trace
+    lands as a replayable JSON artifact, and the explored-state summary
+    is filled for the CLI/CI."""
+    from flexflow_tpu.analysis import poolcheck  # noqa: F401 (register)
+
+    ctx = AnalysisContext(subject="pool",
+                          poolcheck_mutations=["cow_bypass"],
+                          poolcheck_trace_dir=str(tmp_path))
+    report = run_passes(["poolcheck"], ctx)
+    errs = [f for f in report.findings if f.severity == "error"]
+    assert any(f.code == "inv-cow-write" for f in errs), report.findings
+    f = next(f for f in errs if f.code == "inv-cow-write")
+    assert f.where.startswith("poolcheck:model/")
+    assert "Minimal counterexample" in f.message
+    assert ctx.poolcheck_summary["explored_states"] > 0
+    traces = list(tmp_path.glob("*inv-cow-write.json"))
+    assert traces, list(tmp_path.iterdir())
+    with open(traces[0]) as fh:
+        blob = json.load(fh)
+    from flexflow_tpu.analysis.poolcheck import replay
+
+    replayed = replay(blob["trace"], blob["config"],
+                      mutations=("cow_bypass",))
+    assert any(v.split(":")[0] == blob["invariant"] for v in replayed)
+
+
+def test_poolcheck_lint_flags_page_and_table_writes(tmp_path):
+    """The static arm: .at[].set on a buffer outside the COW helper and
+    a self._tables mutation outside the admission/defrag lifecycle are
+    errors in state-machine files; cow-ok/table-ok pragmas suppress."""
+    from flexflow_tpu.analysis import poolcheck
+
+    bad = tmp_path / "scheduler.py"
+    bad.write_text(textwrap.dedent("""\
+        class S:
+            def _admit(self, x):
+                self._tables = x                       # allowlisted fn
+
+            def _sneaky(self, i, v, row):
+                self.kv = self.kv.at[i].set(v)
+                self._tables[i] = row
+                self.kv = self.kv.at[i].add(v)  # fflint: cow-ok (test)
+    """))
+    findings = poolcheck.lint_file(str(bad), rel="paged/scheduler.py")
+    codes = [(f.code, f.where) for f in findings]
+    assert ("page-write-outside-cow", "paged/scheduler.py:6") in codes
+    assert ("table-write-outside-admission",
+            "paged/scheduler.py:7") in codes
+    # the allowlisted _admit write and the pragma'd .add are silent
+    assert len([c for c, _ in codes
+                if c != "stale-pragma"]) == 2, findings
+
+
+def test_poolcheck_lint_ignores_kernel_files_and_flags_pool_privates(
+        tmp_path):
+    """.at[].set in a kernel/attention file is the normal functional
+    write (not a state-machine hazard); pool._underscore access outside
+    pool.py is a warning wherever it happens."""
+    from flexflow_tpu.analysis import poolcheck
+
+    kern = tmp_path / "attention.py"
+    kern.write_text("def w(kv, i, v):\n    return kv.at[i].set(v)\n")
+    assert poolcheck.lint_file(str(kern), rel="paged/attention.py") == []
+
+    snoop = tmp_path / "metrics.py"
+    snoop.write_text(textwrap.dedent("""\
+        def scrape(self):
+            return len(self.pool._refs)
+    """))
+    fs = poolcheck.lint_file(str(snoop), rel="obs/metrics.py")
+    assert [f.code for f in fs] == ["pool-private-access"]
+    assert fs[0].severity == "warning"
+
+
+def test_poolcheck_lint_lock_discipline_and_pragmas(tmp_path):
+    """A thread-owning server class whose public method reads a
+    loop-mutated field without the lock is flagged; reads under
+    `with self._lock` and def-line lock-ok pragmas are not; a pragma
+    suppressing nothing is a stale-pragma info finding."""
+    from flexflow_tpu.analysis import poolcheck
+
+    srv = tmp_path / "server.py"
+    srv.write_text(textwrap.dedent("""\
+        import threading
+
+        class Srv:
+            def _start(self):
+                self._thread = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                self._steps = 1
+
+            def racy(self):
+                return self._steps
+
+            def locked(self):
+                with self._lock:
+                    return self._steps
+
+            def blessed(self):  # fflint: lock-ok (snapshot)
+                return self._steps
+
+        def free_fn():  # fflint: lock-ok (suppresses nothing)
+            return 0
+    """))
+    fs = poolcheck.lint_file(str(srv), rel="spec/server.py")
+    codes = [(f.code, f.where) for f in fs]
+    assert ("unlocked-cross-thread-read", "spec/server.py:11") in codes
+    assert len([c for c, _ in codes
+                if c == "unlocked-cross-thread-read"]) == 1, fs
+    assert ("stale-pragma", "spec/server.py:20") in codes
+
+
+def test_poolcheck_repo_lint_clean_with_zero_suppression_debt():
+    """The shipped serving sources pass the lint arm with no findings
+    at all — including no stale pragmas, so every lock-ok/cow-ok in the
+    tree is load-bearing (the ISSUE-9 hygiene-sweep bar)."""
+    from flexflow_tpu.analysis import poolcheck
+
+    fs = poolcheck.lint_paths(poolcheck.default_lint_paths())
+    assert fs == [], [(f.code, f.where) for f in fs]
+
+
+def test_fflint_since_mode_selects_passes_by_changed_roots():
+    """--since maps diffs to the passes whose roots they touch; a
+    docs-only diff selects nothing, a paged/ diff selects the serving
+    lints but never hloaudit (opt-in only)."""
+    proc = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(f"""\
+            import sys
+            sys.path.insert(0, {os.path.join(REPO, 'tools')!r})
+            import importlib.util as u
+            spec = u.spec_from_file_location(
+                "ff_lint", {os.path.join(REPO, 'tools', 'fflint.py')!r})
+            m = u.module_from_spec(spec)
+            spec.loader.exec_module(m)
+            sel = m.passes_for_changes
+            cand = list(m.DEFAULT_PASSES) + ["hloaudit"]
+            assert sel(["docs/serving.md"], cand) == []
+            got = sel(["flexflow_tpu/paged/pool.py"], cand)
+            assert "poolcheck" in got and "hostsync" in got, got
+            assert "hloaudit" not in got, got
+            assert "consistency" not in got, got
+            got = sel(["flexflow_tpu/search/cost_model.py"], cand)
+            assert "consistency" in got and "rulesat" in got, got
+            assert m.changed_files("HEAD") == m.changed_files("HEAD")
+            print("OK")
+        """)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

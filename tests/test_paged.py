@@ -701,3 +701,67 @@ def test_requeue_prefix_never_double_folds():
     np.testing.assert_array_equal(req.seq_tokens(),
                                   [7, 8, 9, 1, 2, 3, 4])  # second one
     np.testing.assert_array_equal(req.prompt, prompt)  # never mutated
+
+
+# ---------------------------------------------------------------------------
+# randomized op-sequence fuzz over the pool invariant catalog (ISSUE 9):
+# the same declarative invariants the poolcheck model checker explores
+# exhaustively on tiny bounds, here driven through long seeded random
+# interleavings on larger configurations — breadth where BFS has depth
+
+
+def test_pool_fuzz_random_op_interleavings_hold_invariants():
+    """Seeded random walks over admit/prefill/decode/preempt(+resume)/
+    release/defrag through the poolcheck harness (which drives the REAL
+    PagePool): every state along every walk must satisfy the full
+    invariant catalog — both the harness's op-scope checks and the
+    PagePool.check_invariants() debug hook."""
+    import random
+
+    from flexflow_tpu.analysis import pool_invariants
+    from flexflow_tpu.analysis.poolcheck import CONFIGS, PoolModel
+
+    for config in ("base", "spec"):
+        for seed in range(4):
+            rng = random.Random(0xF00D + seed)
+            model = PoolModel(**CONFIGS[config])
+            for step in range(250):
+                ops = model.enabled_ops()
+                if not ops:
+                    break  # every request drained
+                model.violations = []
+                op = rng.choice(ops)
+                model.apply(op)
+                assert model.violations == [], (config, seed, step, op,
+                                                model.violations)
+                model.pool.check_invariants(owners=model.owners())
+                extra = pool_invariants.check_committed(model.pool,
+                                                        model.committed)
+                assert extra == [], (config, seed, step, op, extra)
+
+
+def test_pool_check_invariants_debug_hook():
+    """PagePool.check_invariants() passes on healthy bookkeeping (with
+    and without an owners map) and names the violated invariant when
+    the state is corrupted by hand."""
+    pool = PagePool(num_pages=8, page_size=4, max_pages_per_seq=4)
+    toks = np.arange(8, dtype=np.int32)
+    chain = pool.chain_hashes(toks)
+    pages = pool.alloc(2)
+    pool.register_full(pages[0], chain[0])
+    pool.check_invariants(owners={"req0": pages})
+    pool.free(list(reversed(pages)))  # leaf-first: page 1 parks on LRU
+    pool.check_invariants(owners={})
+
+    pool._refs[pages[1]] = 1  # corrupt: page is both live and dead
+    with pytest.raises(AssertionError) as ei:
+        pool.check_invariants()
+    msg = str(ei.value)
+    assert "free-accounting" in msg or "dead-list" in msg
+
+    del pool._refs[pages[1]]
+    pool.check_invariants()  # healthy again
+    with pytest.raises(AssertionError) as ei:
+        # owners disagree with refcounts
+        pool.check_invariants(owners={"req0": [pages[0]]})
+    assert "refcount-owners" in str(ei.value)

@@ -6,7 +6,14 @@ Default run (what tier-1 gates on through tests/test_analysis.py):
     (including the cost-model-vs-lowering attention comm-spec cross-check);
   - rulesat over the shipped corpus, with reachability against the built
     BASELINE graphs + the committed coverage snapshot;
-  - hostsync over runtime/, serving.py, paged/, spec/.
+  - hostsync over runtime/, serving.py, paged/, spec/;
+  - poolcheck: the AST lint arm (write-after-share / page-table /
+    pool-encapsulation / lock-discipline hazards) over serving.py,
+    paged/, spec/, plus the explicit-state model checker — BFS over the
+    bounded serving scenarios asserting the pool invariant catalog at
+    every reachable state (explored-state counts land in the pass
+    summary; counterexample traces become error findings and, with
+    --trace-dir, replayable JSON artifacts).
 
 The hloaudit pass — AOT-compile every BASELINE config's real entry
 points (train/eval/paged-decode/verify) and diff the optimized HLO's
@@ -15,15 +22,22 @@ priced-events manifest — runs only when selected (--passes hloaudit, or
 --passes all): it XLA-compiles each config and takes minutes, so it is
 its own CI step rather than part of every default invocation.
 
+Changed-files mode: `--since REV` (the pre-commit hook runs
+`--since HEAD`) keeps only the passes whose source roots intersect
+`git diff --name-only REV`, and demotes poolcheck to its lint arm —
+model checking and hloaudit stay opt-in, so the hook stays sub-second
+for docs-only diffs and a few seconds otherwise.
+
 Exit code: 1 when any error finding exists; --strict also gates on
 warnings. Info findings never gate.
 
 Usage:
   python tools/fflint.py [--strict] [--json] [--passes P1,P2|all]
-                         [--configs C1,C2] [--strategy FILE --config NAME]
+                         [--since REV] [--configs C1,C2]
+                         [--strategy FILE --config NAME]
                          [--rules FILE] [--no-baseline-reach]
                          [--write-coverage] [--out FILE] [--sarif FILE]
-                         [--hlo-dump DIR]
+                         [--hlo-dump DIR] [--trace-dir DIR]
 
   --strategy FILE --config NAME   validate an exported/imported strategy
                                   file against the named BASELINE config's
@@ -156,7 +170,56 @@ def write_coverage_classification(classification):
 
 # hloaudit XLA-compiles every config (minutes) — selected explicitly,
 # never part of the default invocation tier-1 rides on
-DEFAULT_PASSES = ("consistency", "rulesat", "hostsync")
+DEFAULT_PASSES = ("consistency", "rulesat", "hostsync", "poolcheck")
+
+# source roots per pass, for --since REV changed-files selection: a pass
+# runs only when the diff touches one of its roots (repo-relative file
+# or directory prefixes). hloaudit's roots are deliberately EMPTY — it
+# XLA-compiles for minutes and stays opt-in even when the diff would
+# select it; an empty tuple (never selected) is distinct from a missing
+# entry (unknown pass — fails open and always runs).
+PASS_ROOTS = {
+    "hloaudit": (),
+    "consistency": ("flexflow_tpu/parallel", "flexflow_tpu/search",
+                    "flexflow_tpu/analysis", "tools/fflint.py"),
+    "rulesat": ("flexflow_tpu/search", "flexflow_tpu/analysis",
+                "docs/rule_coverage.json", "tools/fflint.py"),
+    "hostsync": ("flexflow_tpu/runtime", "flexflow_tpu/serving.py",
+                 "flexflow_tpu/paged", "flexflow_tpu/spec",
+                 "flexflow_tpu/obs", "flexflow_tpu/analysis",
+                 "tools/fflint.py"),
+    "poolcheck": ("flexflow_tpu/paged", "flexflow_tpu/spec",
+                  "flexflow_tpu/serving.py", "flexflow_tpu/analysis",
+                  "tools/fflint.py"),
+}
+
+
+def changed_files(rev):
+    """Repo-relative paths touched since `rev` (committed + worktree)."""
+    import subprocess
+
+    out = subprocess.run(
+        ["git", "diff", "--name-only", rev, "--"],
+        cwd=REPO, capture_output=True, text=True, check=True)
+    return [ln.strip() for ln in out.stdout.splitlines() if ln.strip()]
+
+
+def passes_for_changes(files, candidates):
+    """The subset of `candidates` whose PASS_ROOTS intersect `files`.
+    Passes with no declared roots (future additions) always run —
+    failing open beats silently skipping a gate."""
+    selected = []
+    for name in candidates:
+        roots = PASS_ROOTS.get(name)
+        if roots is None:
+            selected.append(name)
+            continue
+        for f in files:
+            if any(f == r or f.startswith(r.rstrip("/") + "/")
+                   for r in roots):
+                selected.append(name)
+                break
+    return selected
 
 
 def main(argv=None):
@@ -193,6 +256,15 @@ def main(argv=None):
     ap.add_argument("--hlo-dump", default=None, dest="hlo_dump",
                     help="(hloaudit) dump each optimized HLO module to "
                          "this directory")
+    ap.add_argument("--since", default=None, metavar="REV",
+                    help="changed-files mode: run only the passes whose "
+                         "source roots intersect `git diff REV`; "
+                         "poolcheck runs lint-arm only (model checking "
+                         "and hloaudit stay opt-in)")
+    ap.add_argument("--trace-dir", default=None, dest="trace_dir",
+                    help="(poolcheck) write counterexample traces as "
+                         "replayable JSON files into this directory "
+                         "(CI uploads them as artifacts)")
     args = ap.parse_args(argv)
 
     if args.passes == "all":
@@ -210,6 +282,18 @@ def main(argv=None):
         ap.error("--strategy needs --config NAME")
     if args.config:
         names = args.config.split(",")
+
+    if args.since:
+        try:
+            files = changed_files(args.since)
+        except Exception as e:
+            ap.error(f"--since {args.since}: git diff failed: {e}")
+        passes = passes_for_changes(files, passes)
+        print(f"fflint --since {args.since}: {len(files)} changed "
+              f"file(s) select passes: {', '.join(passes) or '(none)'}",
+              file=sys.stderr)
+        if not passes:
+            return 0
 
     report = Report()
     baseline_graphs = None
@@ -238,6 +322,17 @@ def main(argv=None):
         from flexflow_tpu.analysis import AnalysisContext, run_passes
 
         run_passes(["hostsync"], AnalysisContext(subject="src"), report)
+    if "poolcheck" in passes:
+        from flexflow_tpu.analysis import AnalysisContext, run_passes
+
+        ctx = AnalysisContext(
+            subject="pool",
+            poolcheck_lint_only=bool(args.since),
+            poolcheck_trace_dir=args.trace_dir)
+        run_passes(["poolcheck"], ctx, report)
+        if ctx.poolcheck_summary:
+            report.stats.setdefault("poolcheck", {})["model_check"] = \
+                ctx.poolcheck_summary
     if "hloaudit" in passes:
         _hloaudit(report, names, hlo_dump=args.hlo_dump)
 
