@@ -440,6 +440,57 @@ def bench_decode() -> dict:
                    f"{len(shared)} requests",
     }
 
+    # ragged work packing A/B (ISSUE 10): a MIXED fixture — long prompts
+    # prefilling chunk by chunk while short prompts decode — served with
+    # packed per-slot descriptors (ragged_pack=True) and with the legacy
+    # fixed-shape rotating-chunk launches (False). Reported per arm:
+    # decode tokens/sec, TTFT p95 and the padded-row waste ratio; the
+    # acceptance bar is packed waste strictly below legacy at
+    # equal-or-better tokens/sec.
+    _log("decode bench: ragged packing A/B (mixed prefill/decode)")
+    chunk = 3 * page
+    mixed = []
+    for i in range(n_req):
+        if i % 2 == 0:
+            n = rs.randint(4, 10)            # decodes almost immediately
+        else:
+            n = chunk + rs.randint(1, 5)     # needs >= 2 prefill chunks
+        mixed.append(rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32))
+    ragged_ab = {}
+    for pack in (True, False):
+        server = ff.serve_generation(slots=4, max_len=max_len, paged=True,
+                                     page_size=page, prefill_chunk=chunk,
+                                     ragged_pack=pack)
+        try:
+            # warm both arms' launch shapes off the clock
+            server.generate(mixed[0][:3], max_new_tokens=2)
+            server.generate(mixed[1], max_new_tokens=2)
+            n_warm = 2
+            m0 = server.metrics()
+            t0 = time.perf_counter()
+            futs = [server.submit(p, max_new_tokens=max_new)
+                    for p in mixed]
+            outs = [f.result(timeout=1200) for f in futs]
+            dt = time.perf_counter() - t0
+            m = server.metrics()
+        finally:
+            server.stop()
+        rows = m["launch_rows"] - m0["launch_rows"]
+        pad = m["padded_rows"] - m0["padded_rows"]
+        ttfts = [r["ttft_s"] for r in m["requests"][n_warm:]
+                 if r["ttft_s"] is not None]
+        ragged_ab["packed" if pack else "legacy"] = {
+            "decode_tokens_per_sec": round(
+                sum(len(o) for o in outs) / dt, 2),
+            "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 6),
+            "padding_waste_ratio": round(pad / rows if rows else 0.0, 4),
+            "launch_rows": int(rows),
+            "kernel_variant": m["kernel_variant"],
+        }
+    ragged_ab["fixture"] = (
+        f"{n_req} requests, half short (4..9 tokens), half {chunk}+ "
+        f"tokens chunked at prefill_chunk={chunk}")
+
     # repetitive fixture: token-cyclic model (shared with tests/test_spec)
     from flexflow_tpu.spec.fixtures import make_token_cyclic
 
@@ -500,6 +551,7 @@ def bench_decode() -> dict:
         "tick_latency_p95_s": round(float(tick_h["p95"]), 6),
         "calibration": calibration,
         "prefix_cache": prefix_metrics,
+        "ragged_packing": ragged_ab,
         "speculative": {
             "tokens_per_sec": round(spec_tps, 2),
             "acceptance_rate": round(sm["acceptance_rate"], 4),

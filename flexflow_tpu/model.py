@@ -1217,6 +1217,7 @@ class FFModel:
                          page_size: int = 64, num_pages=None,
                          preemption: bool = True, prefix_cache: bool = True,
                          prefill_chunk: int = 64, speculate=None,
+                         ragged_pack: bool = True,
                          request_record_limit=None):
         """Continuous-batching autoregressive generation endpoint (KV-cache
         decode with per-slot positions — flexflow_tpu.serving). With
@@ -1237,7 +1238,7 @@ class FFModel:
                    seed=seed, paged=paged, page_size=page_size,
                    num_pages=num_pages, preemption=preemption,
                    prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
-                   speculate=speculate,
+                   speculate=speculate, ragged_pack=ragged_pack,
                    request_record_limit=request_record_limit)
 
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],

@@ -328,31 +328,20 @@ def _mha(attrs, inputs, params, ctx):
         k = k + params["bk"].astype(dt)
         v = v + params["bv"].astype(dt)
     if ctx.kv_cache is not None:
-        if ctx.page_tables is not None and ctx.spec_mask is not None:
-            # speculative tree verify (flexflow_tpu.spec): score a whole
-            # drafted token tree in one step — nodes write rows at
-            # pos + node, rope at pos + depth, and attend under the
-            # ancestor visibility mask
-            from flexflow_tpu.paged.attention import (
-                paged_cached_tree_attention,
-            )
+        if ctx.page_tables is not None:
+            # every paged step — decode, chunked-prefill chunk, spec
+            # tree verify — is the SAME ragged call: the cache is a
+            # global page pool, this slot's rows are reached through
+            # its page table, and the (q_lens, depths, anc) descriptor
+            # says which of the S window rows are live and what they
+            # may see (flexflow_tpu.paged.attention — one Pallas kernel
+            # or the gather fallback behind one gate)
+            from flexflow_tpu.paged.attention import ragged_paged_attention
 
-            out, kc, vc = paged_cached_tree_attention(
+            out, kc, vc = ragged_paged_attention(
                 q, k, v, ctx.kv_cache["k"], ctx.kv_cache["v"],
-                ctx.page_tables, ctx.cache_position, ctx.spec_depths,
-                ctx.spec_mask, scale=1.0 / (hd**0.5),
-                rope_theta=attrs.rope_theta if attrs.rope else None,
-            )
-        elif ctx.page_tables is not None:
-            # paged decode: the cache is a global page pool and this
-            # slot's rows are reached through its page table
-            # (flexflow_tpu.paged.attention — Pallas kernel or gather
-            # fallback, selected like flash_attention is)
-            from flexflow_tpu.paged.attention import paged_cached_attention
-
-            out, kc, vc = paged_cached_attention(
-                q, k, v, ctx.kv_cache["k"], ctx.kv_cache["v"],
-                ctx.page_tables, ctx.cache_position,
+                ctx.page_tables, ctx.cache_position, ctx.ragged_q_lens,
+                ctx.ragged_depths, ctx.ragged_anc,
                 scale=1.0 / (hd**0.5),
                 rope_theta=attrs.rope_theta if attrs.rope else None,
             )
@@ -425,10 +414,11 @@ def _element_binary(attrs, inputs, params, ctx):
         if pos.ndim == 0:
             rows = lax.dynamic_slice_in_dim(b, pos, a.shape[1], axis=0)
             b = rows[None]
-        elif ctx.spec_depths is not None:
-            # tree verify: node j sits at absolute position pos + depth
-            # (sibling branches share a row of the table)
-            b = b[pos[:, None] + ctx.spec_depths]
+        elif ctx.ragged_depths is not None:
+            # ragged paged step: row i sits at absolute position
+            # pos + depth[i] — arange for chunks/decode, node depth for
+            # tree verify (sibling branches share a row of the table)
+            b = b[pos[:, None] + ctx.ragged_depths]
         else:
             # continuous batching: per-row positions. S=1 is a decode
             # step; S>1 is a paged prefill CHUNK whose rows sit at

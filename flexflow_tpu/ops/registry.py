@@ -38,13 +38,17 @@ class LowerCtx:
     # page POOL (num_pages, page_size, Hkv, D) and page_tables maps each
     # decode slot's positions onto pool pages ((slots, max_pages) int32)
     page_tables: Optional[object] = None
-    # speculative tree verify (flexflow_tpu.spec): spec_depths is each
-    # slot's per-node tree depth ((B, T) int32 — node j scores at absolute
-    # position cache_position + depth) and spec_mask the ancestor-or-self
-    # relation ((B, T, T) bool). With page_tables set, attention routes
-    # through the paged tree-verify path
-    spec_depths: Optional[object] = None
-    spec_mask: Optional[object] = None
+    # the ragged work descriptor (flexflow_tpu.paged.attention module
+    # docstring): with page_tables set, every paged step — decode,
+    # chunked prefill, speculative tree verify — carries per-slot
+    # ragged_q_lens ((B,) int32 live query rows), ragged_depths
+    # ((B, S) int32 — row i scores at absolute position
+    # cache_position + depth, so sibling tree branches share one) and
+    # ragged_anc ((B, S, S) bool window visibility: tril for causal
+    # chains, ancestor-or-self for trees)
+    ragged_q_lens: Optional[object] = None
+    ragged_depths: Optional[object] = None
+    ragged_anc: Optional[object] = None
     cache_updates: Dict[str, object] = dataclasses.field(default_factory=dict)
     # lowering writes non-trainable state updates here (BatchNorm running
     # stats, Cache buffers): key = weight name within the op

@@ -2,13 +2,16 @@
 
 vLLM-style block paging, TPU-idiomatically: a global pool of fixed-size
 KV pages with per-request page tables (host-side numpy bookkeeping,
-int32 device mirrors), a paged-attention decode path (Pallas TPU kernel
-with a pure-JAX gather fallback), and a continuous-batching scheduler
-that admits by free-page budget instead of fixed dense slots.
+int32 device mirrors), ONE ragged paged-attention step that serves
+decode, chunked prefill and speculative tree verify alike (a Pallas TPU
+kernel with a pure-JAX gather fallback behind a single gate), and a
+continuous-batching scheduler that admits by free-page budget instead
+of fixed dense slots and packs each tick's mixed work into ragged
+launches.
 
 Layering:
   pool.py       host-side page allocator/free-list/defrag (plain numpy)
-  attention.py  paged decode attention (Pallas kernel + jnp.take fallback)
+  attention.py  ragged paged attention (Pallas kernel + jnp.take fallback)
   scheduler.py  PagedGenerationServer (admission, preemption, metrics)
 
 See docs/paged.md for the page-table layout and scheduler policy.
@@ -16,10 +19,11 @@ See docs/paged.md for the page-table layout and scheduler policy.
 
 from flexflow_tpu.paged.attention import (
     paged_attention_available,
-    paged_cached_attention,
-    paged_cached_tree_attention,
-    paged_gather_attention,
-    paged_tree_verify,
+    ragged_flash_attention,
+    ragged_gather_attention,
+    ragged_paged_attention,
+    ragged_visibility_mask,
+    reset_rejection_log,
     tree_visibility_mask,
 )
 from flexflow_tpu.paged.pool import PagePool
@@ -29,9 +33,10 @@ __all__ = [
     "PagePool",
     "PagedGenerationServer",
     "paged_attention_available",
-    "paged_cached_attention",
-    "paged_cached_tree_attention",
-    "paged_gather_attention",
-    "paged_tree_verify",
+    "ragged_flash_attention",
+    "ragged_gather_attention",
+    "ragged_paged_attention",
+    "ragged_visibility_mask",
+    "reset_rejection_log",
     "tree_visibility_mask",
 ]

@@ -1,29 +1,40 @@
-"""Paged decode attention: gather K/V through a page table.
+"""Ragged paged attention: ONE kernel for decode, chunked prefill, and
+speculative tree verify.
 
-Two selectable paths, chosen exactly the way ops/pallas/flash_attention
-picks its kernel (backend probe + env kill switch + shape gate):
+Every unit of paged work — a decode step, a chunk of a mid-prefill
+prompt, a drafted token tree — is the same shape of problem: S query
+rows per batch entry whose K/V rows land at cache rows pos..pos+S-1
+through a page table, attending over the committed prefix plus some
+subset of the in-flight window. The only thing that differs is the
+per-slot metadata:
 
-  * a Pallas TPU kernel whose grid walks (batch, kv head, page) with the
-    page table and per-slot positions SCALAR-PREFETCHED, so each page's
-    K/V block DMAs straight from its pooled HBM location into VMEM — no
-    gathered copy of the sequence ever materializes. GQA is handled by
-    grouping the q heads of one kv head into a single (rep, D) block, so
-    kv pages are read once per GROUP (not per q head) and never repeated.
-  * a pure-JAX `jnp.take` fallback (`pool[page_table]` gather + masked
-    dot-product attention) that runs anywhere and is the reference the
-    kernel is validated against.
+  * ``pos``    (B,)     absolute committed position (the write head);
+  * ``q_lens`` (B,)     how many of the S query rows are real work
+                        (decode 1, a chunk its token count, a tree its
+                        node count; 0 marks a padded batch entry);
+  * ``depths`` (B, S)   rope offset of row i relative to pos (chunks:
+                        arange(S); trees: node depth, so sibling
+                        branches score at the SAME absolute position);
+  * ``anc``    (B, S, S) the visibility relation INSIDE the window
+                        (chunks: lower-triangular causal; trees: the
+                        ancestor-or-self mask; decode: ones((1, 1))).
 
-A decode step is S=1; a chunked-prefill CHUNK is the same entry point
-with S>1 (rows land at pos+i through the table, causal kpos <= qpos
-mask), writing K/V straight into pool pages — there is no dense staging
-prefill (scheduler.py).
+One Pallas kernel consumes that descriptor: the grid walks
+(batch, kv head, page) with the page table, positions and query lengths
+SCALAR-PREFETCHED, so each page's K/V block DMAs straight from its
+pooled HBM location into VMEM — no gathered copy of the sequence ever
+materializes, and no (B, S, L) HBM mask is built either: the window
+visibility is derived IN-KERNEL from `anc` via a one-hot matmul against
+the page's relative positions. Pages wholly past a slot's visible
+horizon (pos + q_len - 1) are skipped, as are padded batch entries
+(q_len == 0). GQA groups the q heads of one kv head into a single
+(rep, S, D) block, so kv pages are read once per GROUP and never
+repeated.
 
-A third path extends both for SPECULATIVE tree verify
-(flexflow_tpu.spec): the step scores a whole token tree per slot in one
-pass — S = max_nodes queries whose visibility is committed-rows plus the
-query's own ancestor path (tree attention). The Pallas tree kernel
-reuses the scalar-prefetched page walk with a per-page mask block; the
-gather fallback is selected by the same availability gate.
+The single pure-JAX fallback (`ragged_gather_attention`) gathers
+``pool[page_table]`` and applies the same visibility as a materialized
+(B, S, L) mask (`ragged_visibility_mask`) — it runs anywhere and is the
+reference the kernel is validated against in tests/test_paged.py.
 """
 
 from __future__ import annotations
@@ -46,276 +57,137 @@ logger = logging.getLogger(__name__)
 _fallback_logged: set = set()
 
 
-def _reject(reason: str) -> bool:
-    """Log the CONCRETE kernel-rejection reason once per reason (the
-    flash-attention selection discipline: a silent fallback looks like a
-    10x paged-decode slowdown with no explanation in any log)."""
-    if reason not in _fallback_logged:
-        _fallback_logged.add(reason)
+def reset_rejection_log() -> None:
+    """Forget which kernel rejections were already logged. Server
+    construction calls this so a SECOND server (or an A/B run flipping
+    FF_TPU_NO_PAGED between runs in one process) logs its own gate
+    decisions instead of inheriting the first server's silence."""
+    _fallback_logged.clear()
+
+
+def _reject(reason: str, cfg: tuple) -> bool:
+    """Log the CONCRETE kernel-rejection reason once per
+    (reason, gate-config) pair (the flash-attention selection
+    discipline: a silent fallback looks like a 10x paged-decode
+    slowdown with no explanation in any log). Keying on the gate config
+    too means two servers with different shapes each get their own
+    line."""
+    key = (reason, cfg)
+    if key not in _fallback_logged:
+        _fallback_logged.add(key)
         logger.info(
-            "paged attention: Pallas kernel rejected (%s); using the "
-            "jnp.take gather fallback", reason)
+            "paged attention: ragged Pallas kernel rejected (%s) for "
+            "gate config %s; using the jnp.take gather fallback",
+            reason, cfg)
     return False
 
 
 def paged_attention_available(head_dim: int, page_size: int,
                               interpret: bool = False,
                               dtype=jnp.float32) -> bool:
-    """True when the Pallas paged kernel supports these shapes on this
-    backend. FF_TPU_NO_PAGED=1 disables the kernel everywhere (A/B runs
-    and kernel-bug escape hatch, like FF_TPU_NO_FLASH). On real TPUs the
+    """True when the ragged Pallas kernel supports these shapes on this
+    backend — the ONE gate for decode, chunked prefill and tree verify
+    (there is no per-variant rejection matrix any more).
+    FF_TPU_NO_PAGED=1 disables the kernel everywhere (A/B runs and
+    kernel-bug escape hatch, like FF_TPU_NO_FLASH). On real TPUs the
     head dim must be a lane multiple (the kernel reads lane-aligned D
     blocks; smaller head dims take the gather fallback, mirroring the
     flash bshd gate) and pages must tile the sublane dim AT THE POOL'S
     DTYPE — (8, 128) tiles for fp32 but (16, 128) for bf16/fp16 and
     (32, 128) for int8/fp8, so a bf16 pool needs page_size % 16 == 0.
-    Rejections log their concrete reason once (head_dim/page_size/dtype/
-    backend) instead of silently falling back."""
+    Rejections log their concrete reason once per (reason, config)."""
+    dt = jnp.dtype(dtype)
+    cfg = (head_dim, page_size, dt.name, jax.default_backend())
     if os.environ.get("FF_TPU_NO_PAGED") == "1":
-        return _reject("FF_TPU_NO_PAGED=1 kill switch set")
+        return _reject("FF_TPU_NO_PAGED=1 kill switch set", cfg)
     if interpret:
         return True
-    dt = jnp.dtype(dtype)
     itemsize = dt.itemsize
     if itemsize > 4:
         return _reject(
-            f"pool dtype {dt.name} is 8-byte (no TPU tiling story)")
+            f"pool dtype {dt.name} is 8-byte (no TPU tiling story)", cfg)
     sublane = 8 * (4 // max(itemsize, 1))
     if head_dim % LANES != 0:
         return _reject(
             f"head_dim={head_dim} is not a multiple of the {LANES}-lane "
-            "tile")
+            "tile", cfg)
     if page_size % sublane != 0:
         return _reject(
             f"page_size={page_size} does not tile the {sublane}-row "
-            f"sublane dim at pool dtype {dt.name}")
+            f"sublane dim at pool dtype {dt.name}", cfg)
     if jax.default_backend() != "tpu":
-        return _reject(f"backend is {jax.default_backend()!r}, not tpu")
+        return _reject(f"backend is {jax.default_backend()!r}, not tpu",
+                       cfg)
     return True
 
 
 # ---------------------------------------------------------------------------
-# pure-JAX fallback (and numerical reference)
+# visibility reference + pure-JAX fallback
 
 
-def paged_gather_attention(q, kc_pages, vc_pages, page_tables, pos, *,
-                           scale: float):
-    """q: (B, S, H, D); kc/vc_pages: (N, P, Hkv, D); page_tables:
-    (B, max_pages) int32; pos: (B,) int32 — the absolute position of each
-    row's FIRST query token. Gathers every table-mapped page and attends
-    with the same absolute-position mask as the dense cached path (rows
-    past a slot's write head — including everything in the null page —
-    stay masked)."""
-    B, S, _, D = q.shape
-    Hkv = kc_pages.shape[2]
-    dt = q.dtype
-    kg = kc_pages[page_tables].reshape(B, -1, Hkv, D)
-    vg = vc_pages[page_tables].reshape(B, -1, Hkv, D)
-    qpos = pos[:, None] + jnp.arange(S)[None, :]            # (B, S)
-    kpos = jnp.arange(kg.shape[1])                          # (T,)
-    mask = kpos[None, None, :] <= qpos[:, :, None]          # (B, S, T)
-    from flexflow_tpu.ops.jax_ops import _dot_product_attention
-
-    return _dot_product_attention(q, kg.astype(dt), vg.astype(dt),
-                                  causal=False, scale=scale, mask=mask)
-
-
-# ---------------------------------------------------------------------------
-# Pallas kernel: grid (B, Hkv, n_pages); page table + positions prefetched
-
-
-def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, scale, page_size,
-                         n_pages):
-    b, j = pl.program_id(0), pl.program_id(2)
-
-    @pl.when(j == 0)
-    def _():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    # pages wholly past the slot's write head contribute nothing — skip
-    # their MXU work entirely (the masked-out math would be exp(-inf)=0)
-    @pl.when(j * page_size <= pos_ref[b])
-    def _():
-        q = q_ref[...]                       # (rep, D)
-        k = k_ref[...]                       # (P, D)
-        v = v_ref[...]
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-        kpos = j * page_size + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(kpos <= pos_ref[b], s, NEG_INF)
-        m_prev = m_scr[:, 0:1]
-        l_prev = l_scr[:, 0:1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        corr = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
-        pv = lax.dot_general(p.astype(v.dtype), v,
-                             (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        acc_scr[:] = acc_scr[:] * corr + pv
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
-
-    @pl.when(j == n_pages - 1)
-    def _():
-        l_safe = jnp.maximum(l_scr[:, 0:1], 1e-30)
-        o_ref[...] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-
-
-def paged_flash_decode(q, kc_pages, vc_pages, page_tables, pos, *,
-                       scale: float, interpret: bool = False):
-    """Pallas paged-attention decode step. q: (B, 1, H, D); kc/vc_pages:
-    (N, P, Hkv, D); page_tables: (B, max_pages); pos: (B,). The page
-    table rides scalar prefetch, so each grid step's BlockSpec index map
-    resolves `pt[b, j]` BEFORE the DMA — K/V stream page-by-page from
-    their pooled locations."""
-    B, S, H, D = q.shape
-    if S != 1:
-        raise ValueError(f"paged decode is single-token (S=1), got S={S}")
-    N, P, Hkv, _ = kc_pages.shape
-    rep = H // Hkv
-    n_pages = page_tables.shape[1]
-    qr = q[:, 0].reshape(B, Hkv, rep, D)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, Hkv, n_pages),
-        in_specs=[
-            pl.BlockSpec((None, None, rep, D),
-                         lambda b, g, j, pt, ps: (b, g, 0, 0)),
-            pl.BlockSpec((None, P, None, D),
-                         lambda b, g, j, pt, ps: (pt[b, j], 0, g, 0)),
-            pl.BlockSpec((None, P, None, D),
-                         lambda b, g, j, pt, ps: (pt[b, j], 0, g, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, None, rep, D),
-                               lambda b, g, j, pt, ps: (b, g, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((rep, LANES), jnp.float32),
-            pltpu.VMEM((rep, LANES), jnp.float32),
-            pltpu.VMEM((rep, D), jnp.float32),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, scale=scale, page_size=P,
-                          n_pages=n_pages),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep, D), q.dtype),
-        interpret=interpret,
-    )(page_tables.astype(jnp.int32), pos.astype(jnp.int32), qr,
-      kc_pages, vc_pages)
-    return out.reshape(B, 1, H, D)
-
-
-# ---------------------------------------------------------------------------
-# the lowering entry: rope + page write + attend (mirrors cached_attention)
-
-
-def paged_cached_attention(q, k, v, cache_k, cache_v, page_tables, pos, *,
-                           scale: float, rope_theta: Optional[float] = None):
-    """One paged decode step OR one chunked-prefill chunk, the drop-in
-    analog of ops.jax_ops.cached_attention: rope at absolute positions
-    pos + i, scatter the new K/V rows into their table-mapped pages,
-    attend over everything written so far (kpos <= qpos). S=1 is the
-    per-tick decode step; S>1 is a prefill CHUNK writing straight into
-    pool pages (Executor.chunked_prefill_fn) — chunk lengths mix freely
-    across ticks, each compiles once per bucket. Idle slots (page table
-    all-null, pos 0) write into the null page and read garbage that
-    their mask discards; padded chunk rows past the table's last row are
-    redirected to the null page (their positions are garbage anyway and
-    later writes overwrite the in-range ones).
-
-    Returns (attention output, new k pool, new v pool)."""
-    from flexflow_tpu.ops.jax_ops import apply_rope
-
-    B, S = q.shape[0], q.shape[1]
-    P = cache_k.shape[1]
-    pos_v = jnp.asarray(pos)
-    if rope_theta is not None:
-        offs = pos_v if S == 1 else pos_v[:, None] + jnp.arange(S)[None, :]
-        q = apply_rope(q, rope_theta, pos_offset=offs)
-        k = apply_rope(k, rope_theta, pos_offset=offs)
-    L = page_tables.shape[1] * P
-    rows = pos_v[:, None] + jnp.arange(S)[None, :]        # (B, S)
-    safe = jnp.minimum(rows, L - 1)
-    bidx = jnp.arange(B)[:, None]
-    page = page_tables[bidx, safe // P]                   # (B, S)
-    # rows past the table (padded chunk tails) must not clobber the last
-    # real row — dump them in the null page with the other garbage
-    page = jnp.where(rows < L, page, 0)
-    off = safe % P
-    kc = cache_k.at[page, off].set(k.astype(cache_k.dtype))
-    vc = cache_v.at[page, off].set(v.astype(cache_v.dtype))
-
-    force_interp = os.environ.get("FF_TPU_FLASH_INTERPRET") == "1"
-    avail = paged_attention_available(q.shape[-1], P, interpret=force_interp,
-                                      dtype=kc.dtype)
-    if S == 1:
-        if avail:
-            out = paged_flash_decode(q, kc, vc, page_tables, pos_v,
-                                     scale=scale, interpret=force_interp)
-        else:
-            out = paged_gather_attention(q, kc, vc, page_tables, pos_v,
-                                         scale=scale)
-    elif avail:
-        # a chunk is a degenerate token tree (one chain): reuse the tree
-        # kernel's scalar-prefetched page walk with the causal chunk mask
-        kpos = jnp.arange(L)
-        qpos = pos_v[:, None] + jnp.arange(S)[None, :]
-        mask = kpos[None, None, :] <= qpos[:, :, None]    # (B, S, L)
-        out = paged_tree_verify(q, kc, vc, page_tables, pos_v, mask,
-                                scale=scale, interpret=force_interp)
-    else:
-        out = paged_gather_attention(q, kc, vc, page_tables, pos_v,
-                                     scale=scale)
-    return out, kc, vc
-
-
-# ---------------------------------------------------------------------------
-# speculative tree verify (flexflow_tpu.spec): score a token tree per slot
-# in ONE pass. Tree node j's K/V row lands at cache row pos + j; queries
-# see committed rows (kpos < pos) plus their own ancestor path.
-
-
-def tree_visibility_mask(page_tables, pos, anc_mask, page_size: int):
-    """(B, T, L) bool visibility for tree verify, L = max_pages x P.
-    anc_mask is the (B, T, T) ancestor-or-self relation of the flattened
-    tree; row kpos is visible to query q when it is committed
-    (kpos < pos) or holds a tree node on q's root path. Everything else —
-    padding nodes' rows, stale rows from earlier (wider) trees, the null
-    page — stays masked."""
-    B, T, _ = anc_mask.shape
+def ragged_visibility_mask(page_tables, pos, q_lens, anc_mask,
+                           page_size: int):
+    """(B, S, L) bool visibility, L = max_pages x P: the REFERENCE
+    semantics both paths implement. Cache row kpos is visible to query
+    row t of slot b when it is committed (kpos < pos[b]) or lies in the
+    slot's in-flight window (rel = kpos - pos[b] in [0, q_lens[b])) on
+    t's visibility path (anc_mask[b, t, rel]). Everything else — padded
+    window rows past q_len, stale rows from earlier wider launches, the
+    null page — stays masked. Chunks pass a lower-triangular anc_mask
+    (causal within the chunk); trees pass the ancestor-or-self
+    relation; decode is the S=1 special case of either."""
+    B, S, _ = anc_mask.shape
     L = page_tables.shape[1] * page_size
     kpos = jnp.arange(L)
     rel = jnp.broadcast_to(kpos[None, None, :] - pos[:, None, None],
-                           (B, T, L))
-    in_tree = (rel >= 0) & (rel < T)
-    anc = jnp.take_along_axis(anc_mask, jnp.clip(rel, 0, T - 1), axis=2)
-    return (kpos[None, None, :] < pos[:, None, None]) | (in_tree & anc)
+                           (B, S, L))
+    in_window = (rel >= 0) & (rel < q_lens[:, None, None])
+    anc = jnp.take_along_axis(anc_mask, jnp.clip(rel, 0, S - 1), axis=2)
+    return (kpos[None, None, :] < pos[:, None, None]) | (in_window & anc)
 
 
-def paged_tree_gather_attention(q, kc_pages, vc_pages, page_tables, mask, *,
-                                scale: float):
-    """Pure-JAX tree-verify reference: gather every table-mapped page and
-    attend under the precomputed (B, T, L) visibility mask. q is
-    (B, T, H, D) — T tree nodes, not sequence positions."""
-    B, T, _, D = q.shape
+def tree_visibility_mask(page_tables, pos, anc_mask, page_size: int):
+    """Tree-verify visibility (the pre-ragged name, kept as the test /
+    fallback reference): all S window rows are live, so this is
+    ragged_visibility_mask with q_lens = S."""
+    B, S, _ = anc_mask.shape
+    full = jnp.full((B,), S, jnp.int32)
+    return ragged_visibility_mask(page_tables, pos, full, anc_mask,
+                                  page_size)
+
+
+def ragged_gather_attention(q, kc_pages, vc_pages, page_tables, pos,
+                            q_lens, anc_mask, *, scale: float):
+    """Pure-JAX fallback AND numerical reference for the ragged kernel:
+    gather every table-mapped page (`pool[page_table]`) and run dense
+    masked dot-product attention under ragged_visibility_mask. q:
+    (B, S, H, D); kc/vc_pages: (N, P, Hkv, D); page_tables:
+    (B, max_pages) int32; pos/q_lens: (B,) int32; anc_mask: (B, S, S)
+    bool. Rows with no visible keys (padded entries) come out of the
+    all-masked softmax as a uniform average — garbage a caller's
+    q_len bookkeeping already discards, exactly like the kernel's
+    zero rows."""
+    B, S, _, D = q.shape
     Hkv = kc_pages.shape[2]
+    P = kc_pages.shape[1]
     dt = q.dtype
     kg = kc_pages[page_tables].reshape(B, -1, Hkv, D)
     vg = vc_pages[page_tables].reshape(B, -1, Hkv, D)
+    mask = ragged_visibility_mask(page_tables, pos, q_lens, anc_mask, P)
     from flexflow_tpu.ops.jax_ops import _dot_product_attention
 
     return _dot_product_attention(q, kg.astype(dt), vg.astype(dt),
                                   causal=False, scale=scale, mask=mask)
 
 
-def _paged_tree_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, mask_ref,
-                       o_ref, m_scr, l_scr, acc_scr, *, scale, page_size,
-                       n_pages, tree):
+# ---------------------------------------------------------------------------
+# the ragged Pallas kernel: grid (B, Hkv, page); page table, positions and
+# query lengths prefetched; window visibility derived in-kernel
+
+
+def _ragged_kernel(pt_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
+                   anc_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
+                   page_size, n_pages, window):
     b, j = pl.program_id(0), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -324,16 +196,34 @@ def _paged_tree_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, mask_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # visible rows reach at most pos + tree - 1 (committed prefix + the
-    # tree's own rows); pages wholly past that contribute nothing
-    @pl.when(j * page_size <= pos_ref[b] + tree - 1)
+    qlen = qlen_ref[b]
+    # pages wholly past the slot's visible horizon (committed prefix +
+    # its own q_len window rows) contribute nothing, and padded batch
+    # entries (q_len == 0) do no work at all — skip the MXU work
+    # entirely (the masked-out math would be exp(-inf) = 0)
+    @pl.when((j * page_size <= pos_ref[b] + qlen - 1) & (qlen > 0))
     def _():
-        q = q_ref[...]                       # (rep, T, D)
+        q = q_ref[...]                       # (rep, S, D)
         k = k_ref[...]                       # (P, D)
         v = v_ref[...]
         s = lax.dot_general(q, k, (((2,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-        s = s + mask_ref[...][None]          # additive (T, P) mask block
+        # window visibility without a gather and without an HBM mask:
+        # column c holds cache row j*P + c, i.e. window index
+        # rel[c] = j*P + c - pos. One-hot it against the window rows
+        # (zeroing indices past q_len) and contract with the (S, S)
+        # anc relation: (anc @ onehot)[t, c] = anc[t, rel[c]] when
+        # 0 <= rel[c] < q_len, else 0.
+        col = j * page_size + lax.broadcasted_iota(
+            jnp.int32, (window, page_size), 1)          # (S, P) abs row
+        rel = col - pos_ref[b]
+        krow = lax.broadcasted_iota(jnp.int32, (window, page_size), 0)
+        onehot = ((rel == krow) & (krow < qlen)).astype(jnp.float32)
+        tree_vis = lax.dot_general(
+            anc_ref[...], onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) > 0.5   # (S, P)
+        vis = (col < pos_ref[b]) | tree_vis
+        s = jnp.where(vis[None], s, NEG_INF)
         m_prev = m_scr[:, :, 0:1]
         l_prev = l_scr[:, :, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
@@ -347,99 +237,133 @@ def _paged_tree_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, mask_ref,
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
+    # finalize UNCONDITIONALLY: a padded entry whose every page was
+    # skipped must still write (zeros), not leave o_ref as garbage —
+    # and rows at or past q_len are forced to zero even when they
+    # accumulated prefix attention (they share the entry's pages, so
+    # the compute loop cannot skip them row-wise)
     @pl.when(j == n_pages - 1)
     def _():
         l_safe = jnp.maximum(l_scr[:, :, 0:1], 1e-30)
-        o_ref[...] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        live = lax.broadcasted_iota(jnp.int32, acc_scr.shape, 1) < qlen
+        o_ref[...] = jnp.where(live, acc_scr[:] / l_safe,
+                               0.0).astype(o_ref.dtype)
 
 
-def paged_tree_verify(q, kc_pages, vc_pages, page_tables, pos, mask, *,
-                      scale: float, interpret: bool = False):
-    """Pallas tree-verify step. q: (B, T, H, D) tree-node queries;
-    kc/vc_pages: (N, P, Hkv, D); mask: (B, T, L) bool visibility
-    (tree_visibility_mask). Same scalar-prefetched page walk as
-    paged_flash_decode — each grid step DMAs one page's K/V from its
-    pooled HBM location — plus one (T, P) mask block per page, so the
-    gathered sequence never materializes and the tree structure rides a
-    VMEM-resident additive mask."""
-    B, T, H, D = q.shape
+def ragged_flash_attention(q, kc_pages, vc_pages, page_tables, pos,
+                           q_lens, anc_mask, *, scale: float,
+                           interpret: bool = False):
+    """The ragged Pallas launch. q: (B, S, H, D) — S is the launch's
+    window width, per-entry real work is q_lens[b] <= S rows;
+    kc/vc_pages: (N, P, Hkv, D); page_tables: (B, max_pages); pos,
+    q_lens: (B,); anc_mask: (B, S, S) bool window visibility. The page
+    table, positions AND query lengths ride scalar prefetch, so each
+    grid step's BlockSpec index map resolves `pt[b, j]` BEFORE the DMA
+    and the horizon/padding skip predicates on prefetched scalars. The
+    anc relation is one (S, S) VMEM block per batch entry — the only
+    mask state, O(B*S^2) instead of the old (B, S, L) HBM add_mask.
+    Rows at or past q_lens[b] output zeros."""
+    B, S, H, D = q.shape
     N, P, Hkv, _ = kc_pages.shape
     rep = H // Hkv
     n_pages = page_tables.shape[1]
-    qr = q.transpose(0, 2, 1, 3).reshape(B, Hkv, rep, T, D)
-    add_mask = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
+    qr = q.transpose(0, 2, 1, 3).reshape(B, Hkv, rep, S, D)
+    anc_f = anc_mask.astype(jnp.float32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, Hkv, n_pages),
         in_specs=[
-            pl.BlockSpec((None, None, rep, T, D),
-                         lambda b, g, j, pt, ps: (b, g, 0, 0, 0)),
+            pl.BlockSpec((None, None, rep, S, D),
+                         lambda b, g, j, pt, ps, ql: (b, g, 0, 0, 0)),
             pl.BlockSpec((None, P, None, D),
-                         lambda b, g, j, pt, ps: (pt[b, j], 0, g, 0)),
+                         lambda b, g, j, pt, ps, ql: (pt[b, j], 0, g, 0)),
             pl.BlockSpec((None, P, None, D),
-                         lambda b, g, j, pt, ps: (pt[b, j], 0, g, 0)),
-            pl.BlockSpec((None, T, P),
-                         lambda b, g, j, pt, ps: (b, 0, j)),
+                         lambda b, g, j, pt, ps, ql: (pt[b, j], 0, g, 0)),
+            pl.BlockSpec((None, S, S),
+                         lambda b, g, j, pt, ps, ql: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, None, rep, T, D),
-                               lambda b, g, j, pt, ps: (b, g, 0, 0, 0)),
+        out_specs=pl.BlockSpec((None, None, rep, S, D),
+                               lambda b, g, j, pt, ps, ql: (b, g, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((rep, T, LANES), jnp.float32),
-            pltpu.VMEM((rep, T, LANES), jnp.float32),
-            pltpu.VMEM((rep, T, D), jnp.float32),
+            pltpu.VMEM((rep, S, LANES), jnp.float32),
+            pltpu.VMEM((rep, S, LANES), jnp.float32),
+            pltpu.VMEM((rep, S, D), jnp.float32),
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_tree_kernel, scale=scale, page_size=P,
-                          n_pages=n_pages, tree=T),
+        functools.partial(_ragged_kernel, scale=scale, page_size=P,
+                          n_pages=n_pages, window=S),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep, T, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep, S, D), q.dtype),
         interpret=interpret,
-    )(page_tables.astype(jnp.int32), pos.astype(jnp.int32), qr,
-      kc_pages, vc_pages, add_mask)
-    return out.transpose(0, 3, 1, 2, 4).reshape(B, T, H, D)
+    )(page_tables.astype(jnp.int32), pos.astype(jnp.int32),
+      q_lens.astype(jnp.int32), qr, kc_pages, vc_pages, anc_f)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, D)
 
 
-def paged_cached_tree_attention(q, k, v, cache_k, cache_v, page_tables,
-                                pos, depths, anc_mask, *, scale: float,
-                                rope_theta: Optional[float] = None):
-    """One speculative TREE-VERIFY step — the multi-node analog of
-    paged_cached_attention. q/k/v carry T tree nodes per slot; node j's
-    rope position is pos + depths[b, j] (siblings share a depth, so
-    alternative branches are scored at the SAME absolute position), its
-    K/V row is written at cache row pos + j, and attention runs under the
-    ancestor visibility mask. Accept/rollback afterwards is pure index
-    bookkeeping: the scheduler copies the accepted path's rows onto the
-    contiguous committed positions (Executor.paged_commit_fn) and
-    advances pos — rejected rows sit past the new write head, masked
-    exactly like any stale page content.
+# ---------------------------------------------------------------------------
+# the ONE lowering entry: rope + page write + attend, for every variant
 
-    Returns (attention output, new k pool, new v pool)."""
+
+def ragged_paged_attention(q, k, v, cache_k, cache_v, page_tables, pos,
+                           q_lens, depths, anc_mask, *, scale: float,
+                           rope_theta: Optional[float] = None):
+    """The single paged-attention step every caller lowers to — decode,
+    chunked prefill and tree verify are the same call with different
+    descriptors (module docstring). Ropes q/k at pos + depths, scatters
+    the live K/V rows into their table-mapped pages (rows past q_len or
+    past the table land in the null page with the other garbage — a
+    padded row must clobber neither a real row nor the pool bounds),
+    then attends via the ragged kernel or the gather fallback behind
+    the one availability gate.
+
+    Returns (attention output, new k pool, new v pool). Output rows at
+    or past q_lens[b] are garbage by contract (kernel: zeros; gather:
+    an unmasked-softmax average) — callers index by their own q_len
+    bookkeeping."""
     from flexflow_tpu.ops.jax_ops import apply_rope
 
-    B, T = q.shape[0], q.shape[1]
+    B, S = q.shape[0], q.shape[1]
     P = cache_k.shape[1]
     pos_v = jnp.asarray(pos)
-    positions = pos_v[:, None] + depths                    # (B, T)
+    qlen_v = jnp.asarray(q_lens)
     if rope_theta is not None:
+        positions = pos_v[:, None] + depths                # (B, S)
         q = apply_rope(q, rope_theta, pos_offset=positions)
         k = apply_rope(k, rope_theta, pos_offset=positions)
     L = page_tables.shape[1] * P
-    rows = jnp.minimum(pos_v[:, None] + jnp.arange(T)[None, :], L - 1)
+    rows = pos_v[:, None] + jnp.arange(S)[None, :]         # (B, S)
+    safe = jnp.minimum(rows, L - 1)
     bidx = jnp.arange(B)[:, None]
-    page = page_tables[bidx, rows // P]                    # (B, T)
-    off = rows % P
+    page = page_tables[bidx, safe // P]                    # (B, S)
+    live = (rows < L) & (jnp.arange(S)[None, :] < qlen_v[:, None])
+    page = jnp.where(live, page, 0)
+    off = safe % P
     kc = cache_k.at[page, off].set(k.astype(cache_k.dtype))
     vc = cache_v.at[page, off].set(v.astype(cache_v.dtype))
 
-    mask = tree_visibility_mask(page_tables, pos_v, anc_mask, P)
     force_interp = os.environ.get("FF_TPU_FLASH_INTERPRET") == "1"
     if paged_attention_available(q.shape[-1], P, interpret=force_interp,
                                  dtype=kc.dtype):
-        out = paged_tree_verify(q, kc, vc, page_tables, pos_v, mask,
-                                scale=scale, interpret=force_interp)
+        out = ragged_flash_attention(q, kc, vc, page_tables, pos_v,
+                                     qlen_v, anc_mask, scale=scale,
+                                     interpret=force_interp)
     else:
-        out = paged_tree_gather_attention(q, kc, vc, page_tables, mask,
-                                          scale=scale)
+        out = ragged_gather_attention(q, kc, vc, page_tables, pos_v,
+                                      qlen_v, anc_mask, scale=scale)
     return out, kc, vc
+
+
+def chain_descriptor(batch: int, window: int):
+    """The default (causal-chain) ragged descriptor: every window row
+    live, row i at depth i, lower-triangular visibility — exactly the
+    old kpos <= qpos chunk/decode semantics. Returns
+    (q_lens, depths, anc_mask) as traced-constant jnp arrays."""
+    q_lens = jnp.full((batch,), window, jnp.int32)
+    depths = jnp.broadcast_to(jnp.arange(window, dtype=jnp.int32),
+                              (batch, window))
+    anc = jnp.broadcast_to(
+        jnp.tril(jnp.ones((window, window), jnp.bool_)),
+        (batch, window, window))
+    return q_lens, depths, anc

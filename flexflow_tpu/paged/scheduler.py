@@ -25,16 +25,30 @@ mid-prefill slots by one budgeted chunk and then runs the normal decode
 tick for everyone else, so a long prompt never stalls in-flight decodes
 for more than the one tick its chunk shares.
 
+RAGGED WORK PACKING: every model call is the ONE ragged step
+(Executor.ragged_step_fn — flexflow_tpu.paged.attention): the tick
+assembles WORK ITEMS (a decode row, a window-sized piece of a prefill
+chunk, a drafted tree) into a (B, S) launch whose per-item descriptor
+(pos, q_len, depths, anc) says which rows are live; items padded to the
+launch shape carry q_len 0 and are skipped by the kernel, with their
+writes redirected to the null page. Splitting a chunk into window
+pieces is sound because every item's K/V rows scatter into the pool
+BEFORE attention runs at each layer, so piece i+1 sees piece i's rows
+as committed (kpos < pos) — the same mechanism that lets chunks span
+ticks. `ragged_pack=False` keeps the kernel but reverts to the
+pre-ragged packing (one full-bucket launch per prefilling slot) — the
+bench baseline the padding-waste metric is judged against.
+
 Decode flow per tick:
   1. admit queued requests into free slots while pages last (FIFO;
      preempted requests re-enter ahead of the queue); admission maps
      prefix-cache hits and allocates the remaining pages — no model run
   2. grow: decoding slots whose next write position crosses a page
      boundary allocate a page, preempting under pressure
-  3. one budgeted prefill chunk per mid-prefill slot (last chunk samples
-     the first token)
-  4. one jitted paged decode step for the decoding slots (idle and
-     mid-prefill slots write their garbage row into the null page)
+  3. one budgeted prefill launch packing every mid-prefill slot's chunk
+     pieces (a finishing chunk samples the first token)
+  4. one jitted ragged decode step for the decoding slots (idle and
+     mid-prefill slots carry q_len 0: no work, writes to the null page)
   5. sample, append, publish freshly filled pages to the prefix cache,
      finish/free
 """
@@ -66,6 +80,7 @@ class PagedGenerationServer(_GenerationServerBase):
                  page_size: int = 64, num_pages: Optional[int] = None,
                  preemption: bool = True, table_slack_tokens: int = 0,
                  prefix_cache: bool = True, prefill_chunk: int = 64,
+                 ragged_pack: bool = True,
                  request_record_limit: Optional[int] = None):
         import jax
 
@@ -88,11 +103,17 @@ class PagedGenerationServer(_GenerationServerBase):
         self.preemption = bool(preemption)
         self.prefix_cache = bool(prefix_cache)
         self.prefill_chunk = max(1, int(prefill_chunk))
+        self.ragged_pack = bool(ragged_pack)
+        # packed prefill windows are capped at this many rows (the fp32
+        # sublane tile and the _bucket floor): chunks larger than it
+        # split into pieces, so launch shapes stay within a small
+        # (n_items, window<=8) family instead of per-chunk pow2 buckets
+        self._chunk_rows = 8
         ex = ff.executor
-        self._step = ex.paged_decode_fn()
-        # chunked prefill writes K/V straight into pool pages — there is
-        # no dense staging cache and no post-prefill page scatter
-        self._chunk_step = ex.chunked_prefill_fn()
+        # one ragged step serves decode AND chunked prefill (and tree
+        # verify in the speculative subclass): K/V writes land straight
+        # in pool pages, there is no dense staging cache
+        self._step = ex.ragged_step_fn()
         self._caches = ex.init_paged_kv_cache(num_pages, self.page_size)
         self._tables = np.zeros((self.slots, self.max_pages_per_seq),
                                 np.int32)
@@ -108,6 +129,33 @@ class PagedGenerationServer(_GenerationServerBase):
         # nothing was live or admitted, and total seconds spent asleep
         self._c_idle = self.registry.counter("idle_ticks_total")
         self._c_idle_s = self.registry.counter("idle_wait_seconds_total")
+        # ragged-launch accounting: how many launch rows each tick
+        # shipped vs how many were padding (q_len 0 items / rows past an
+        # item's q_len). The gauge holds the LAST tick's waste ratio;
+        # the counters aggregate for the bench's end-to-end ratio.
+        self._c_rows = self.registry.counter("launch_rows_total")
+        self._c_pad = self.registry.counter("padded_rows_total")
+        self._g_waste = self.registry.gauge("padding_waste_ratio")
+        # one gate decision, surfaced: which attention path this server's
+        # launches take (evaluated host-side at init — the gate only
+        # depends on shapes/dtype/backend/env, all fixed for the server's
+        # lifetime). A second server re-logs its own gate decisions.
+        import os
+
+        from flexflow_tpu.paged.attention import (
+            paged_attention_available,
+            reset_rejection_log,
+        )
+
+        reset_rejection_log()
+        kbuf = next(iter(self._caches.values()))["k"]
+        self.kernel_variant = "ragged_pallas" if paged_attention_available(
+            kbuf.shape[-1], self.page_size,
+            interpret=os.environ.get("FF_TPU_FLASH_INTERPRET") == "1",
+            dtype=kbuf.dtype) else "ragged_gather"
+        self._g_kernel = self.registry.gauge("ragged_kernel_active")
+        self._g_kernel.set(1.0 if self.kernel_variant == "ragged_pallas"
+                           else 0.0)
 
         @jax.jit
         def copy_page(caches, src, dst):
@@ -154,6 +202,12 @@ class PagedGenerationServer(_GenerationServerBase):
             "pool_occupancy": pool.pages_in_use / pool.capacity,
             "fragmentation": pool.fragmentation(),
             "prefill_ticks": self.prefill_ticks,
+            "kernel_variant": self.kernel_variant,
+            "launch_rows": int(self._c_rows.value),
+            "padded_rows": int(self._c_pad.value),
+            "padding_waste_ratio": (
+                self._c_pad.value / self._c_rows.value
+                if self._c_rows.value else 0.0),
             "prefix_cache": {
                 "enabled": self.prefix_cache,
                 "hit_tokens": pool.hit_tokens,
@@ -442,17 +496,48 @@ class PagedGenerationServer(_GenerationServerBase):
         req = self._active[slot]
         return req is not None and req.prefill_pos < req.prefill_target
 
-    def _decode_table(self) -> np.ndarray:
-        """Device table for a decode/verify tick: mid-prefill slots' rows
-        are NULLED so the fixed-shape batched step's write row for them
-        lands in the null page instead of their real, partially filled
-        pages (the step writes a K/V row for every slot, live or not)."""
-        pre = [s for s in self._admit_order if self._mid_prefill(s)]
-        if not pre:
-            return self._tables
-        t = self._tables.copy()
-        t[pre] = 0
-        return t
+    def _launch(self, items, window, tr, ntr):
+        """Run ONE ragged step over packed work items. Each item is
+        (slot, pos, tokens, depths, anc): `tokens` the item's q_len <=
+        window live token ids, depths/anc None for the causal-chain
+        default (decode rows, chunk pieces) or the (window,) node depths
+        and (window, window) ancestor relation of a drafted tree. Rows
+        past an item's q_len are padding: the kernel skips them and the
+        entry point redirects their K/V writes to the null page — an
+        item NEVER needs its table row nulled, so mid-prefill and idle
+        slots simply aren't packed. Returns (probs, padded, total) with
+        probs (len(items), window, vocab); padding is also rolled into
+        the launch counters and the per-tick waste gauge."""
+        import jax.numpy as jnp
+
+        B = len(items)
+        ids = np.zeros((B, window), np.int32)
+        pos = np.zeros((B,), np.int32)
+        qls = np.zeros((B,), np.int32)
+        deps = np.tile(np.arange(window, dtype=np.int32), (B, 1))
+        anc = np.tile(np.tril(np.ones((window, window), np.bool_)),
+                      (B, 1, 1))
+        tables = np.zeros((B, self.max_pages_per_seq), np.int32)
+        for i, (slot, p, toks, d, a) in enumerate(items):
+            ql = len(toks)
+            ids[i, :ql] = toks
+            pos[i] = p
+            qls[i] = ql
+            tables[i] = self._tables[slot]
+            if d is not None:
+                deps[i] = d
+            if a is not None:
+                anc[i] = a
+        probs, upd = self._step(
+            tr, ntr, self._caches,
+            jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(qls),
+            jnp.asarray(deps), jnp.asarray(anc), jnp.asarray(ids))
+        self._caches = upd
+        total = B * window
+        padded = total - int(qls.sum())
+        self._c_rows.inc(total)
+        self._c_pad.inc(padded)
+        return probs, padded, total
 
     def _tick_prep(self) -> Optional[List[int]]:
         """Shared tick prologue (base and speculative loops): defrag if
@@ -503,9 +588,14 @@ class PagedGenerationServer(_GenerationServerBase):
         slot's prefill out of the budget indefinitely. The chunk
         finishing a prompt samples the request's first token from its
         own last-row logits — the same rng/_pick discipline as the
-        dense server's admission prefill."""
-        import jax.numpy as jnp
+        dense server's admission prefill.
 
+        With ragged_pack every slot's chunk is split into window-sized
+        pieces and the whole tick rides ONE packed launch (piece i+1
+        sees piece i's rows as committed because K/V scatter precedes
+        attention at each layer); ragged_pack=False reverts to one
+        full-bucket launch per slot — the rotating-chunk baseline whose
+        padding the packed path is measured against."""
         budget = self.prefill_chunk
         self.prefill_ticks += 1
         rot = self._prefill_rr % len(slots)
@@ -513,27 +603,52 @@ class PagedGenerationServer(_GenerationServerBase):
         slots = slots[rot:] + slots[:rot]
         t0 = time.monotonic()
         sp = obs.span("prefill_tick").__enter__()
-        for s in slots:  # fflint: host-ok (one chunk per prefilling slot per tick, not per token)
+        padded = total = 0
+        # plan the tick's chunks first (budget in rotated order), then
+        # launch, then publish/sample per slot in the SAME rotated order
+        # the per-slot launches used — the rng split sequence of a
+        # finishing chunk is packing-invariant
+        plan = []  # (slot, req, start, take)
+        for s in slots:
             if budget <= 0:
                 break
             req = self._active[s]
-            n = req.prefill_target
-            take = min(budget, n - req.prefill_pos)
-            bucket = self._bucket(take)
-            chunk = np.zeros((1, bucket), np.int32)
-            chunk[0, :take] = req.prefill_seq[
-                req.prefill_pos:req.prefill_pos + take]
-            probs, upd = self._chunk_step(
-                tr, ntr, self._caches,
-                jnp.asarray(self._tables[s:s + 1]),
-                jnp.asarray(np.array([req.prefill_pos], np.int32)),
-                jnp.asarray(chunk))
-            self._caches = upd
-            req.prefill_pos += take
-            req.prefill_tokens += take
+            take = min(budget, req.prefill_target - req.prefill_pos)
+            plan.append((s, req, req.prefill_pos, take))
             budget -= take
+        if self.ragged_pack:
+            items = []
+            ends = []  # index+row of each chunk's last piece in `items`
+            # window = the tick's largest chunk, capped at _chunk_rows:
+            # small chunks never pad past their own length (the legacy
+            # buckets floor at 8) and big chunks split into pieces
+            # instead of rounding up to the next power-of-two bucket
+            W = min(self._chunk_rows, max(take for _, _, _, take in plan))
+            for s, req, start, take in plan:
+                for off in range(0, take, W):
+                    piece = min(W, take - off)
+                    items.append((s, start + off,
+                                  req.prefill_seq[start + off:
+                                                  start + off + piece],
+                                  None, None))
+                ends.append((len(items) - 1, (take - 1) % W))
+            probs, padded, total = self._launch(items, W, tr, ntr)
+            rows = [probs[i:i + 1, r, :] for i, r in ends]
+        else:
+            rows = []
+            for s, req, start, take in plan:
+                bucket = self._bucket(take)
+                p, pad, tot = self._launch(
+                    [(s, start, req.prefill_seq[start:start + take],
+                      None, None)], bucket, tr, ntr)
+                rows.append(p[0:1, take - 1, :])
+                padded += pad
+                total += tot
+        for (s, req, start, take), row in zip(plan, rows):
+            req.prefill_pos = start + take
+            req.prefill_tokens += take
             self._publish_prefix(req, req.prefill_pos)
-            if req.prefill_pos >= n:
+            if req.prefill_pos >= req.prefill_target:
                 # publish the PROMPT's partial tail now, before decode
                 # appends rows to the same page: the entry only names
                 # rows [0, tail) and those are immutable, so an
@@ -542,11 +657,13 @@ class PagedGenerationServer(_GenerationServerBase):
                 # token is appended below, so seq_tokens() still equals
                 # prefill_seq here)
                 self._publish_tail(req)
-                self._sample_first_token(s, req, probs[:, take - 1, :])
+                self._sample_first_token(s, req, row)
                 self._finish_if_done(s)
         chunked = self.prefill_chunk - budget
+        self._g_waste.set(padded / total if total else 0.0)
         if sp:
-            sp.set(slots=len(slots), chunk_tokens=chunked)
+            sp.set(slots=len(slots), chunk_tokens=chunked,
+                   padded_rows=padded, total_rows=total)
         sp.__exit__(None, None, None)
         dt = time.monotonic() - t0
         self._h_prefill.observe(dt)
@@ -567,12 +684,19 @@ class PagedGenerationServer(_GenerationServerBase):
         sp = obs.span("decode_tick").__enter__()
         if sp:
             sp.set(live=len(live), pages_in_use=self.pool.pages_in_use)
-        pos = np.array([self._active[s].pos if self._active[s] else 0
-                        for s in range(self.slots)], np.int32)
-        probs, upd = self._step(
-            tr, ntr, self._caches, jnp.asarray(self._decode_table()),
-            jnp.asarray(pos), jnp.asarray(self._tokens)[:, None])
-        self._caches = upd
+        # one item per slot — q_len 1 for the decoding slots, 0 for idle
+        # and mid-prefill ones (no work, writes to the null page), so the
+        # launch compiles once for (slots, 1) and probs stays
+        # slot-indexed for the one shared _pick split
+        dec = set(live)
+        items = [(s, self._active[s].pos if s in dec else 0,
+                  [int(self._tokens[s])] if s in dec else [],
+                  None, None)
+                 for s in range(self.slots)]
+        probs, padded, total = self._launch(items, 1, tr, ntr)
+        self._g_waste.set(padded / total if total else 0.0)
+        if sp:
+            sp.set(padded_rows=padded, total_rows=total)
         temps = np.array(
             [self._active[s].temperature if self._active[s] else 0.0
              for s in range(self.slots)], np.float32)

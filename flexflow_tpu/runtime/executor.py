@@ -140,6 +140,7 @@ class Executor:
         self._forward = None
         self._decode_fn = None
         self._paged_decode_fn = None
+        self._ragged_step_fn = None
         self._verify_fn = None
         self._paged_commit_fn = None
         # remat="hidden": recompute MLP hidden activations in backward
@@ -454,7 +455,7 @@ class Executor:
     def run_forward(self, trainable, nontrainable, inputs: Sequence, *,
                     training: bool, rng, skip_sink_softmax: bool = False,
                     kv_caches=None, cache_position=None, cache_out=None,
-                    page_tables=None, spec_tree=None):
+                    page_tables=None, ragged=None):
         """Topo-order lowering. Returns (sink output, state_updates, aux_loss).
         With `skip_sink_softmax` the final Softmax node passes its input
         (raw logits) through — used when the loss fuses the softmax.
@@ -462,11 +463,13 @@ class Executor:
         autoregressive cache mode; updated buffers land in `cache_out`.
         `page_tables` additionally switches the cache mode to PAGED:
         kv_caches are global page pools and each slot's rows are reached
-        through its (slots, max_pages) int32 table row. `spec_tree`
-        (a (depths, ancestor_mask) pair — flexflow_tpu.spec) further
-        switches the paged step into speculative TREE VERIFY: the inputs
-        carry a whole drafted token tree per slot and attention applies
-        the ancestor visibility mask."""
+        through its (slots, max_pages) int32 table row, and `ragged`
+        carries the per-slot work descriptor (q_lens, depths, anc) that
+        says which of the step's S query rows are live and what they may
+        see — decode, chunked prefill and speculative tree verify are
+        all this one step (flexflow_tpu.paged.attention). With
+        page_tables set and `ragged` None, the causal-chain default
+        (every row live, tril visibility) is used."""
         values: Dict[Tuple[int, int], Any] = {}
         if len(inputs) != len(self.input_nodes):
             raise ValueError(
@@ -476,8 +479,16 @@ class Executor:
             values[(n.guid, 0)] = x
         state_updates: Dict[str, Dict[str, Any]] = {}
         aux_loss = 0.0
-        spec_depths, spec_mask = spec_tree if spec_tree is not None else (
-            None, None)
+        if page_tables is not None and ragged is None:
+            # causal-chain default: reproduces the pre-ragged decode /
+            # chunk semantics (every row live, kpos <= qpos) for callers
+            # that don't pack their own descriptor
+            from flexflow_tpu.paged.attention import chain_descriptor
+
+            ragged = chain_descriptor(inputs[0].shape[0],
+                                      inputs[0].shape[1])
+        ragged_q_lens, ragged_depths, ragged_anc = (
+            ragged if ragged is not None else (None, None, None))
         remat_groups = self._remat_groups if training else {}
         for n in self.topo:
             if n.op_type == OpType.INPUT:
@@ -509,8 +520,9 @@ class Executor:
                           else None),
                 cache_position=cache_position,
                 page_tables=page_tables,
-                spec_depths=spec_depths,
-                spec_mask=spec_mask,
+                ragged_q_lens=ragged_q_lens,
+                ragged_depths=ragged_depths,
+                ragged_anc=ragged_anc,
             )
             if (
                 skip_sink_softmax
@@ -823,16 +835,51 @@ class Executor:
         def step(trainable, nontrainable, caches, page_tables, pos,
                  depths, tree_mask, *inputs):
             cache_out = {}
+            # all max_nodes window rows live: padding nodes are made
+            # invisible by the anc relation itself (a pad node sees only
+            # itself and nothing sees it), the pre-ragged contract
+            q_lens = jnp.full((inputs[0].shape[0],), inputs[0].shape[1],
+                              jnp.int32)
             out, _, _ = self.run_forward(
                 trainable, nontrainable, inputs, training=False,
                 rng=jax.random.key(0), kv_caches=caches,
                 cache_position=pos, cache_out=cache_out,
-                page_tables=page_tables, spec_tree=(depths, tree_mask),
+                page_tables=page_tables,
+                ragged=(q_lens, depths, tree_mask),
             )
             return out, cache_out
 
         self._verify_fn = jax.jit(step)
         return self._verify_fn
+
+    def ragged_step_fn(self):
+        """jitted (params, pools, page_tables, pos, q_lens, depths, anc,
+        ids) -> (probs, new_pools): ONE ragged paged step over a packed
+        batch of work items — decode rows, prefill chunks and drafted
+        trees in the same launch (flexflow_tpu.paged.attention). Each
+        batch entry b carries q_lens[b] live rows of the (B, S) ids
+        window writing K/V at pos[b]..pos[b]+q_lens[b]-1 through its
+        table row, scoring at pos[b] + depths[b] under the anc[b]
+        window visibility; entries padded to the launch shape pass
+        q_len 0 and do no work. Compiled once per (B, S) launch shape —
+        the scheduler packs items into a small set of launch shapes, so
+        admission order and work mix never recompile it."""
+        if self._ragged_step_fn is not None:
+            return self._ragged_step_fn
+
+        def step(trainable, nontrainable, caches, page_tables, pos,
+                 q_lens, depths, anc, *inputs):
+            cache_out = {}
+            out, _, _ = self.run_forward(
+                trainable, nontrainable, inputs, training=False,
+                rng=jax.random.key(0), kv_caches=caches,
+                cache_position=pos, cache_out=cache_out,
+                page_tables=page_tables, ragged=(q_lens, depths, anc),
+            )
+            return out, cache_out
+
+        self._ragged_step_fn = jax.jit(step)
+        return self._ragged_step_fn
 
     def paged_commit_fn(self):
         """jitted (pools, page_tables, src, dst) -> pools: copy the

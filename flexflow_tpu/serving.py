@@ -857,6 +857,7 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                      prefix_cache: bool = True,
                      prefill_chunk: int = 64,
                      speculate=None,
+                     ragged_pack: bool = True,
                      request_record_limit: Optional[int] = None
                      ) -> "_GenerationServerBase":
     """Continuous-batching generation endpoint over a compiled causal-LM
@@ -887,6 +888,14 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
     token-identical to the non-speculative paged path while emitting up
     to depth+1 tokens per step.
 
+    `ragged_pack` (paged only, default True) packs each tick's mixed
+    work — decode rows, chunk pieces, drafted trees — into ragged
+    launches of the one paged-attention step, skipping idle slots and
+    padding (docs/paged.md). `ragged_pack=False` keeps the kernel but
+    reverts to the pre-ragged per-slot, widest-variant packing: the A/B
+    baseline for the `padding_waste_ratio` metric. Token output is
+    identical either way.
+
     `request_record_limit` bounds how many completed requests keep their
     per-request metric record (default _GenerationServerBase
     .MAX_REQUEST_RECORDS); cumulative counters and histograms are
@@ -902,7 +911,7 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             ff, speculate, slots=slots, max_len=max_len, eos_id=eos_id,
             seed=seed, page_size=page_size, num_pages=num_pages,
             preemption=preemption, prefix_cache=prefix_cache,
-            prefill_chunk=prefill_chunk,
+            prefill_chunk=prefill_chunk, ragged_pack=ragged_pack,
             request_record_limit=request_record_limit)
     if paged:
         from flexflow_tpu.paged.scheduler import PagedGenerationServer
@@ -911,6 +920,7 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             ff, slots=slots, max_len=max_len, eos_id=eos_id, seed=seed,
             page_size=page_size, num_pages=num_pages, preemption=preemption,
             prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
+            ragged_pack=ragged_pack,
             request_record_limit=request_record_limit)
     return GenerationServer(ff, slots=slots, max_len=max_len, eos_id=eos_id,
                             seed=seed,

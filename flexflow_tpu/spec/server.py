@@ -14,8 +14,12 @@ No page is copied, no cache is rebuilt.
 Tick flow (vs the base scheduler's one-token step):
   1. admit (base policy, but the page gate also covers the tree width)
   2. grow pages to cover pos + max_nodes rows (tree scratch included)
-  3. draft: trailing-context trees per live slot, padded to max_nodes
-  4. ONE verify step for the whole slot pool
+  3. draft: trailing-context trees for the live GREEDY slots
+  4. ONE ragged verify launch: tree items for greedy slots (q_len =
+     real node count), single-row items for temperature>0 slots, and —
+     unlike the pre-ragged fixed layout — NO rows at all for idle or
+     mid-prefill slots (ragged_pack=False keeps the old every-slot
+     width as q_len-0 filler items, the bench's padding baseline)
   5. accept: greedy argmax walk per slot; temperature>0 slots take only
      the root's sample (exactness under sampling needs rejection
      sampling — not implemented), so they decode at 1 token/step
@@ -50,6 +54,7 @@ class SpeculativePagedServer(PagedGenerationServer):
                  seed: int = 0, page_size: int = 64,
                  num_pages: Optional[int] = None, preemption: bool = True,
                  prefix_cache: bool = True, prefill_chunk: int = 64,
+                 ragged_pack: bool = True,
                  request_record_limit: Optional[int] = None):
         if not isinstance(spec, SpecConfig):
             raise TypeError(
@@ -57,7 +62,8 @@ class SpeculativePagedServer(PagedGenerationServer):
         self.spec = spec
         self.drafter = spec.build_drafter()
         ex = ff.executor
-        self._verify = ex.verify_fn()
+        # verify rides the base server's ragged step (_launch); only the
+        # accepted-path row copy needs its own program
         self._commit = ex.paged_commit_fn()
         self.spec_steps = 0
         self.spec_drafted = 0
@@ -71,6 +77,7 @@ class SpeculativePagedServer(PagedGenerationServer):
                          table_slack_tokens=spec.max_nodes,
                          prefix_cache=prefix_cache,
                          prefill_chunk=prefill_chunk,
+                         ragged_pack=ragged_pack,
                          request_record_limit=request_record_limit)
         # per-tick draft acceptance rate (accepted / drafted this tick)
         self._h_accept = self.registry.histogram("spec_acceptance",
@@ -148,32 +155,41 @@ class SpeculativePagedServer(PagedGenerationServer):
                 self._decode_tick(live, tr, ntr)
                 continue
 
-            # draft: one padded tree per live slot (host-side; idle slots
-            # carry a root-only tree into the null page). temperature>0
-            # slots skip the drafter entirely — their accept path is the
-            # root's sample only, so drafts would be paid for and thrown
-            # away (and would dilute the acceptance metrics)
+            # draft: one tree WORK ITEM per live greedy slot.
+            # temperature>0 slots skip the drafter entirely — their
+            # accept path is the root's sample only, so they pack as
+            # single-row decode items instead of max_nodes-wide trees
+            # (drafts would be paid for and thrown away, and would
+            # dilute the acceptance metrics). Idle and mid-prefill slots
+            # pack NOTHING under ragged_pack (the pre-ragged layout
+            # carried a full tree of null-page scratch for every slot;
+            # ragged_pack=False keeps that for the bench baseline, as
+            # q_len-0 items).
             t0 = time.monotonic()
             tick_drafted = 0
             sp = obs.span("draft").__enter__()
-            tokens = np.zeros((self.slots, T), np.int32)
-            parents = np.full((self.slots, T), -1, np.int32)
-            depths = np.zeros((self.slots, T), np.int32)
+            order = live if self.ragged_pack else list(range(self.slots))
+            slots_of = []   # item index -> slot
             trees = {}
-            for s in live:
+            tree_rows = []  # item indexes carrying a real tree
+            parents = []
+            for s in order:
                 req = self._active[s]
-                if req.temperature > 0.0:
-                    chains = []
-                else:
-                    chains = self.drafter.draft(req.seq_tokens(),
-                                                self.spec.width,
-                                                self.spec.depth)
+                if req is None:
+                    slots_of.append(s)      # legacy filler: q_len 0
+                    continue
+                if s not in live or req.temperature > 0.0:
+                    slots_of.append(s)      # 1-row (or filler) item
+                    continue
+                chains = self.drafter.draft(req.seq_tokens(),
+                                            self.spec.width,
+                                            self.spec.depth)
                 tree = build_tree(req.tokens[-1], chains, T,
                                   max_depth=self.spec.depth)
                 trees[s] = tree
-                tokens[s] = tree.tokens
-                parents[s] = tree.parents
-                depths[s] = tree.depths
+                tree_rows.append(len(slots_of))
+                parents.append(tree.parents)
+                slots_of.append(s)
                 drafted = tree.n_nodes - 1
                 self.spec_drafted += drafted
                 req.spec_drafted += drafted
@@ -181,47 +197,72 @@ class SpeculativePagedServer(PagedGenerationServer):
             if sp:
                 sp.set(live=len(live), width=T, drafted=tick_drafted)
             sp.__exit__(None, None, None)
-            anc = ancestor_masks(parents)
+            anc = (ancestor_masks(np.stack(parents)) if parents
+                   else np.zeros((0, T, T), bool))
             pos = np.array([self._active[s].pos if self._active[s] else 0
                             for s in range(self.slots)], np.int32)
 
-            # _decode_table nulls mid-prefill slots' rows: the verify
-            # writes T scratch rows for EVERY slot, and a mid-prefill
-            # slot's must land in the null page, not its real pages
+            # items: a tree (q_len = its real node count — padding nodes
+            # are skipped work whose writes land in the null page), one
+            # committed-token row for a sampled slot, or a q_len-0
+            # filler. Mid-prefill slots pack no item, so their partially
+            # filled pages are never a write target — the table-nulling
+            # trick is gone
+            items = []
+            ti = iter(range(len(tree_rows)))
+            for i, s in enumerate(slots_of):
+                req = self._active[s]
+                if s in trees:
+                    k = next(ti)
+                    tree = trees[s]
+                    items.append((s, req.pos,
+                                  tree.tokens[:tree.n_nodes],
+                                  tree.depths, anc[k]))
+                elif req is not None and s in live:
+                    items.append((s, req.pos, [req.tokens[-1]],
+                                  None, None))
+                else:
+                    items.append((s, 0, [], None, None))
             sp = obs.span("verify").__enter__()
             if sp:
                 sp.set(live=len(live), width=T,
                        pages_in_use=self.pool.pages_in_use)
-            probs, upd = self._verify(
-                tr, ntr, self._caches, jnp.asarray(self._decode_table()),  # fflint: host-ok (per-tick batch transfer)
-                jnp.asarray(pos), jnp.asarray(depths), jnp.asarray(anc),  # fflint: host-ok (per-tick batch transfer)
-                jnp.asarray(tokens))  # fflint: host-ok (per-tick batch transfer)
-            self._caches = upd
+            probs, padded, total = self._launch(items, T, tr, ntr)
+            self._g_waste.set(padded / total if total else 0.0)
+            if sp:
+                sp.set(padded_rows=padded, total_rows=total)
             for s in self._admit_order:
                 if self._mid_prefill(s):
                     self._active[s].decode_overlap_ticks += 1
 
             # accept: greedy argmax walk. Both reductions run ON DEVICE —
-            # per-node argmaxes for the walk and the root row's _pick for
+            # per-node argmaxes for the walk and the root rows' _pick for
             # temperature>0 slots (one rng split per tick, same
             # discipline as the non-speculative servers) — so only
-            # (slots, max_nodes) + (slots,) ints cross to the host, never
-            # the (slots, max_nodes, vocab) probs
+            # (items, max_nodes) + (slots,) ints cross to the host, never
+            # the (items, max_nodes, vocab) probs. The root rows scatter
+            # back to slot order on device so the shared slot-shaped
+            # _pick program serves packed launches of any size
             temps = np.array(
                 [self._active[s].temperature if self._active[s] else 0.0
                  for s in range(self.slots)], np.float32)
             self._rng, sub = jax.random.split(self._rng)
-            preds = np.asarray(jnp.argmax(probs, axis=-1))  # (slots, T)  # fflint: host-ok (on-device reduction, one sync per tick)
-            sampled = np.asarray(self._pick(probs[:, 0, :],
-                                            jnp.asarray(temps), sub))  # fflint: host-ok (per-tick batch transfer)
+            idx = jnp.asarray(np.array(slots_of, np.int32))  # fflint: host-ok (per-tick batch transfer)
+            root = jnp.zeros((self.slots, probs.shape[-1]), probs.dtype)  # fflint: host-ok (per-tick scratch alloc)
+            root = root.at[idx].set(probs[:, 0, :])  # fflint: cow-ok (fresh logits scatter buffer, never a pool page)
+            preds = np.asarray(jnp.argmax(probs, axis=-1))  # (items, T)  # fflint: host-ok (on-device reduction, one sync per tick)
+            temps_d = jnp.asarray(temps)  # fflint: host-ok (per-tick batch transfer)
+            sampled = np.asarray(self._pick(root, temps_d, sub))  # fflint: host-ok (per-tick batch transfer)
             sp.__exit__(None, None, None)  # verify: closes at host sync
+            item_of = {s: i for i, s in enumerate(slots_of)}
             plans = {}
             for s in live:
                 req = self._active[s]
                 if req.temperature > 0.0:
                     plans[s] = ([0], [], int(sampled[s]))
                 else:
-                    path, emitted = accept_greedy(trees[s], preds[s])
+                    path, emitted = accept_greedy(trees[s],
+                                                  preds[item_of[s]])
                     plans[s] = (path, emitted[:-1], emitted[-1])
             self._steps += 1
             self.spec_steps += 1

@@ -440,9 +440,10 @@ def test_hostsync_repo_hot_paths_clean():
 def test_hostsync_gate_covers_prefix_cache_and_chunked_prefill():
     """The tier-1 hostsync gate (fflint --passes hostsync) actually scans
     the prefix-cache/chunked-prefill hot paths (ISSUE 5 satellite): the
-    scheduler, pool, and executor files are inside default_src_paths, and
-    the per-chunk host transfers in the prefill tick are pragma-annotated
-    rather than silently unscanned."""
+    scheduler, pool, and executor files are inside default_src_paths and
+    scan clean — the ragged-launch refactor centralized the per-tick
+    host transfers in the straight-line `_launch` helper, so the tick
+    loops themselves carry no per-token syncs (and need no pragmas)."""
     import os
 
     from flexflow_tpu.analysis.hostsync import default_src_paths, scan_file
@@ -460,12 +461,13 @@ def test_hostsync_gate_covers_prefix_cache_and_chunked_prefill():
         gating = [f for f in findings
                   if f.severity in ("error", "warning")]
         assert gating == [], [(f.where, f.code) for f in gating]
-    # the intentional per-chunk sync in the prefill tick is annotated
+    # the per-tick transfers live in the shared packed-launch helper
+    # (one descriptor transfer per launch, not per token) — the prefill
+    # tick itself no longer hosts an in-loop sync to annotate
     with open(sched) as f:
         src = f.read()
     assert "def _prefill_tick" in src
-    assert "# fflint: host-ok" in src.split("def _prefill_tick", 1)[1] \
-        .split("def ", 1)[0]
+    assert "def _launch" in src
 
 
 def test_hostsync_gate_covers_obs_instrumentation():

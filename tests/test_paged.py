@@ -150,35 +150,75 @@ def test_page_pool_defrag_compacts_and_remaps():
 
 
 # ---------------------------------------------------------------------------
-# kernel vs gather reference (interpret mode; the same validation pattern
-# as test_pallas_flash)
+# ragged kernel vs gather reference (interpret mode; the same validation
+# pattern as test_pallas_flash) — MIXED batches: decode rows, prefill
+# chunks, token trees and padded entries in ONE launch
 
 
-@pytest.mark.parametrize("H,Hkv", [(8, 2), (4, 4)])  # GQA and MHA
-def test_paged_kernel_matches_gather_reference(H, Hkv):
+def _ragged_entry(kind, S, rs):
+    """(pos, q_len, anc) for one batch entry of a window-S launch."""
+    anc = np.zeros((S, S), bool)
+    if kind == "pad":
+        return 0, 0, anc
+    if kind == "decode":
+        anc[0, 0] = True
+        return int(rs.randint(1, 28)), 1, anc
+    if kind == "chunk":
+        n = int(rs.randint(2, S + 1))
+        anc[:n, :n] = np.tril(np.ones((n, n), bool))
+        return int(rs.randint(0, 24)), n, anc
+    # tree: root + two branches sharing the root (a real non-causal mask)
+    from flexflow_tpu.spec.tree import ancestor_masks
+
+    n = min(S, 5)
+    parents = np.full((S,), -1, np.int32)
+    parents[:n] = np.array([-1, 0, 1, 0, 3], np.int32)[:n]
+    anc[:] = ancestor_masks(parents[None])[0]
+    return int(rs.randint(0, 24)), n, anc
+
+
+@pytest.mark.parametrize("H,Hkv,S,mix", [
+    (8, 2, 1, ["decode", "decode", "decode"]),
+    (8, 2, 4, ["chunk", "chunk"]),
+    (8, 2, 4, ["decode", "chunk", "pad"]),
+    (8, 2, 6, ["decode", "tree"]),
+    (8, 2, 6, ["decode", "chunk", "tree", "pad"]),
+    (4, 4, 6, ["decode", "chunk", "tree", "pad"]),  # MHA rep=1
+])
+def test_ragged_kernel_matches_gather_reference(H, Hkv, S, mix):
     import jax
     import jax.numpy as jnp
 
     from flexflow_tpu.paged.attention import (
-        paged_flash_decode,
-        paged_gather_attention,
+        ragged_flash_attention,
+        ragged_gather_attention,
     )
 
-    B, D, P, N, MAXP = 3, 32, 8, 12, 4
+    B, D, P, N, MAXP = len(mix), 32, 8, 24, 4
+    rs = np.random.RandomState(1000 * S + len(mix))
     ks = jax.random.split(jax.random.key(0), 3)
-    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.float32)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
     kc = jax.random.normal(ks[1], (N, P, Hkv, D), jnp.float32)
     vc = jax.random.normal(ks[2], (N, P, Hkv, D), jnp.float32)
-    # ragged rows at different depths, incl. one spilling into page 4
-    pt = jnp.asarray(np.array([[1, 2, 3, 0], [4, 5, 0, 0],
-                               [6, 7, 8, 9]], np.int32))
-    pos = jnp.asarray(np.array([18, 9, 30], np.int32))
+    perm = rs.permutation(N - 1)[:B * MAXP] + 1  # distinct non-null pages
+    pt = jnp.asarray(perm.reshape(B, MAXP).astype(np.int32))
+    entries = [_ragged_entry(k, S, rs) for k in mix]
+    pos = jnp.asarray(np.array([e[0] for e in entries], np.int32))
+    q_lens = jnp.asarray(np.array([e[1] for e in entries], np.int32))
+    anc = jnp.asarray(np.stack([e[2] for e in entries]))
     scale = 1.0 / np.sqrt(D)
-    ref = paged_gather_attention(q, kc, vc, pt, pos, scale=scale)
-    got = paged_flash_decode(q, kc, vc, pt, pos, scale=scale,
-                             interpret=True)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               atol=2e-5, rtol=2e-5)
+    ref = np.asarray(ragged_gather_attention(q, kc, vc, pt, pos, q_lens,
+                                             anc, scale=scale))
+    got = np.asarray(ragged_flash_attention(q, kc, vc, pt, pos, q_lens,
+                                            anc, scale=scale,
+                                            interpret=True))
+    for b, kind in enumerate(mix):
+        n = int(q_lens[b])
+        np.testing.assert_allclose(got[b, :n], ref[b, :n], atol=2e-5,
+                                   rtol=2e-5, err_msg=f"entry {b} {kind}")
+        # the kernel's contract: rows at or past q_len are exact zeros
+        # (the gather fallback's garbage rows differ — both discarded)
+        assert not got[b, n:].any(), f"entry {b} {kind} padded tail"
 
 
 # ---------------------------------------------------------------------------
@@ -640,6 +680,72 @@ def test_chunked_prefill_does_not_stall_decodes():
     long_rec = [r for r in m["requests"] if r["decode_tokens"] == 4][0]
     assert long_rec["prefill_tokens"] >= 24
     assert long_rec["decode_overlap_ticks"] >= 2, long_rec
+
+
+# ---------------------------------------------------------------------------
+# ragged work packing (ISSUE 10): packed descriptors vs the legacy
+# fixed-shape launches — identical tokens, strictly less padding
+
+
+def test_ragged_pack_token_identity_and_less_waste():
+    """ragged_pack=True (packed per-slot work descriptors) and
+    ragged_pack=False (the pre-ragged rotating-chunk launch shapes) emit
+    IDENTICAL greedy tokens on a mixed chunked-prefill + decode
+    workload, packing's padded-row waste ratio is strictly below the
+    legacy path's, and the pool invariants hold after the churn."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(21)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 17, 5, 11, 2)]  # two prompts prefill in chunks
+    want = [ff.generate(p[None, :], max_new_tokens=6)[0] for p in prompts]
+    waste = {}
+    for pack in (True, False):
+        server = ff.serve_generation(slots=3, max_len=32, paged=True,
+                                     page_size=4, prefill_chunk=6,
+                                     ragged_pack=pack)
+        try:
+            futs = [server.submit(p, max_new_tokens=6) for p in prompts]
+            got = [f.result(timeout=120) for f in futs]
+            m = server.metrics()
+        finally:
+            server.stop()
+        for i, (w, g) in enumerate(zip(want, got)):
+            np.testing.assert_array_equal(w, g,
+                                          err_msg=f"pack={pack} req {i}")
+        assert m["launch_rows"] > 0
+        assert 0.0 <= m["padding_waste_ratio"] < 1.0
+        assert m["kernel_variant"] in ("ragged_pallas", "ragged_gather")
+        waste[pack] = m["padded_rows"] / m["launch_rows"]
+        server.pool.check_invariants(owners={})
+    assert waste[True] < waste[False], waste
+
+
+def test_ragged_pack_preempt_mid_prefill_poolcheck_green():
+    """Packed prefill under page pressure: chunked prompts racing a
+    tight pool get preempted MID-PREFILL and resume; output stays
+    dense-identical and the pool invariant catalog stays green (the
+    ragged tick assembly must never leak or alias a page)."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(22)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (13, 11, 9)]
+    want = [ff.generate(p[None, :], max_new_tokens=5)[0] for p in prompts]
+    server = ff.serve_generation(slots=3, max_len=32, paged=True,
+                                 page_size=4, num_pages=8,
+                                 prefill_chunk=4)
+    try:
+        futs = [server.submit(p, max_new_tokens=5) for p in prompts]
+        got = [f.result(timeout=180) for f in futs]
+        m = server.metrics()
+    finally:
+        server.stop()
+    for i, (w, g) in enumerate(zip(want, got)):
+        np.testing.assert_array_equal(w, g, err_msg=f"request {i}")
+    assert m["preemptions"] > 0, "pool pressure never preempted"
+    assert m["pages_in_use"] == 0
+    pool = server.pool
+    pool.check_invariants(owners={})
+    assert pool._refs == {}, pool._refs
 
 
 def test_paged_submit_contract():

@@ -106,8 +106,9 @@ def test_ngram_drafter_prompt_lookup():
 
 
 # ---------------------------------------------------------------------------
-# tree-verify kernel vs gather reference (interpret mode, like the decode
-# kernel's test)
+# tree verify through the RAGGED kernel vs the gather reference
+# (interpret mode, like the decode kernel's test): trees of different
+# node counts in one launch, ancestor visibility derived in-kernel
 
 
 @pytest.mark.parametrize("H,Hkv", [(8, 2), (4, 4)])  # GQA and MHA
@@ -116,9 +117,8 @@ def test_tree_kernel_matches_gather_reference(H, Hkv):
     import jax.numpy as jnp
 
     from flexflow_tpu.paged.attention import (
-        paged_tree_gather_attention,
-        paged_tree_verify,
-        tree_visibility_mask,
+        ragged_flash_attention,
+        ragged_gather_attention,
     )
 
     B, D, P, N, T = 3, 32, 8, 12, 6
@@ -130,14 +130,20 @@ def test_tree_kernel_matches_gather_reference(H, Hkv):
                                [6, 7, 8, 9]], np.int32))
     pos = jnp.asarray(np.array([14, 6, 24], np.int32))
     parents = np.tile(np.array([-1, 0, 1, 2, 1, 0], np.int32), (B, 1))
-    mask = tree_visibility_mask(pt, pos, jnp.asarray(ancestor_masks(parents)),
-                                P)
+    anc = jnp.asarray(ancestor_masks(parents))
+    # ragged node counts: entry 1's tree only drafted 4 real nodes
+    q_lens = jnp.asarray(np.array([T, 4, T], np.int32))
     scale = 1.0 / np.sqrt(D)
-    ref = paged_tree_gather_attention(q, kc, vc, pt, mask, scale=scale)
-    got = paged_tree_verify(q, kc, vc, pt, pos, mask, scale=scale,
-                            interpret=True)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                               atol=2e-5, rtol=2e-5)
+    ref = np.asarray(ragged_gather_attention(q, kc, vc, pt, pos, q_lens,
+                                             anc, scale=scale))
+    got = np.asarray(ragged_flash_attention(q, kc, vc, pt, pos, q_lens,
+                                            anc, scale=scale,
+                                            interpret=True))
+    for b in range(B):
+        n = int(q_lens[b])
+        np.testing.assert_allclose(got[b, :n], ref[b, :n], atol=2e-5,
+                                   rtol=2e-5, err_msg=f"tree {b}")
+        assert not got[b, n:].any(), f"tree {b} padded tail"
 
 
 # ---------------------------------------------------------------------------
@@ -315,6 +321,43 @@ def test_spec_preemption_stays_correct():
     for i, (w, g) in enumerate(zip(want, got)):
         np.testing.assert_array_equal(w, g, err_msg=f"request {i}")
     assert server.metrics()["pages_in_use"] == 0
+
+
+def test_spec_ragged_pack_identity_with_mixed_temperatures():
+    """Verify-tick packing (greedy slots send trees, sampled slots send
+    single rows, idle slots send NOTHING) vs the legacy every-slot
+    layout: greedy output is token-identical either way, and the packed
+    path records strictly fewer padded rows."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(9)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (4, 6)]
+    want = [ff.generate(p[None, :], max_new_tokens=5)[0] for p in prompts]
+    waste = {}
+    for pack in (True, False):
+        # 4 slots for 3 requests: the guaranteed idle slot is exactly
+        # what the legacy layout pays a full-width filler row block for
+        # on every verify tick and the packed path simply omits
+        server = ff.serve_generation(slots=4, max_len=32, paged=True,
+                                     page_size=4, ragged_pack=pack,
+                                     speculate=SpecConfig(width=2, depth=3))
+        try:
+            futs = [server.submit(p, max_new_tokens=5) for p in prompts]
+            # one sampled request rides the same verify ticks (1-row item)
+            fs = server.submit(prompts[0], max_new_tokens=5,
+                               temperature=0.8)
+            got = [f.result(timeout=120) for f in futs]
+            sampled = fs.result(timeout=120)
+            m = server.metrics()
+        finally:
+            server.stop()
+        for i, (w, g) in enumerate(zip(want, got)):
+            np.testing.assert_array_equal(w, g,
+                                          err_msg=f"pack={pack} req {i}")
+        assert 1 <= len(sampled) <= 5
+        assert m["pages_in_use"] == 0
+        waste[pack] = m["padded_rows"] / max(m["launch_rows"], 1)
+    assert waste[True] < waste[False], waste
 
 
 def test_spec_requires_paged():
