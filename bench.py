@@ -491,6 +491,52 @@ def bench_decode() -> dict:
         f"{n_req} requests, half short (4..9 tokens), half {chunk}+ "
         f"tokens chunked at prefill_chunk={chunk}")
 
+    # decode megastep A/B (ISSUE 11): the SAME decode-heavy fixture
+    # served with the one-tick host loop (megastep_ticks=1) and with
+    # 8 ticks fused per dispatch (megastep_ticks=8, the device-resident
+    # while_loop). Reported per arm: decode tokens/sec, effective
+    # per-tick latency p50/p95 (the histogram divides each megastep's
+    # wall time by its tick count, so widths stay comparable) and host
+    # roundtrips per decoded token. The acceptance bar is N=8 strictly
+    # higher tokens/sec AND strictly fewer roundtrips/token than N=1.
+    _log("decode bench: megastep A/B (N=1 vs N=8)")
+    mega_prompts = [rs.randint(0, lcfg.vocab_size, (rs.randint(4, 9),))
+                    .astype(np.int32) for _ in range(n_req)]
+    mega_ab = {}
+    for n_ticks in (1, 8):
+        server = ff.serve_generation(slots=4, max_len=max_len, paged=True,
+                                     page_size=page,
+                                     megastep_ticks=n_ticks)
+        try:
+            # trace both arms' launch shapes off the clock
+            server.generate(mega_prompts[0], max_new_tokens=max_new)
+            m0 = server.metrics()
+            t0 = time.perf_counter()
+            futs = [server.submit(p, max_new_tokens=max_new)
+                    for p in mega_prompts]
+            outs = [f.result(timeout=1200) for f in futs]
+            dt = time.perf_counter() - t0
+            m = server.metrics()
+        finally:
+            server.stop()
+        rt = m["megastep"]["host_roundtrips"] \
+            - m0["megastep"]["host_roundtrips"]
+        dtok = m["megastep"]["decode_tokens"] \
+            - m0["megastep"]["decode_tokens"]
+        th = m["histograms"]["tick_latency_s"]
+        mega_ab[f"n{n_ticks}"] = {
+            "decode_tokens_per_sec": round(
+                sum(len(o) for o in outs) / dt, 2),
+            "tick_latency_p50_s": round(float(th["p50"]), 6),
+            "tick_latency_p95_s": round(float(th["p95"]), 6),
+            "host_roundtrips_per_token": round(rt / dtok, 4) if dtok
+            else 0.0,
+            "megastep_breaks": dict(m["megastep"]["breaks"]),
+        }
+    mega_ab["fixture"] = (
+        f"{n_req} short prompts (4..8 tokens), {max_new} new tokens "
+        f"each, page_size={page}")
+
     # repetitive fixture: token-cyclic model (shared with tests/test_spec)
     from flexflow_tpu.spec.fixtures import make_token_cyclic
 
@@ -552,6 +598,7 @@ def bench_decode() -> dict:
         "calibration": calibration,
         "prefix_cache": prefix_metrics,
         "ragged_packing": ragged_ab,
+        "megastep": mega_ab,
         "speculative": {
             "tokens_per_sec": round(spec_tps, 2),
             "acceptance_rate": round(sm["acceptance_rate"], 4),

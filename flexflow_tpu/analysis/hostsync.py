@@ -17,6 +17,16 @@ non-transitive — it reads each function's own AST, not its callees):
       inside a jit-wrapped function: shapes are trace-time constants, so
       the branch recompiles per shape class (fine for deliberate kernel
       selection, a retrace storm when shapes vary per request).
+  device-loop         (error)   a host sync inside the body/cond of a
+      `lax.while_loop`/`fori_loop`/`scan`: `.item()`, `np.*`/`numpy.*`
+      calls, `jax.device_get` or a host callback
+      (`pure_callback`/`io_callback`) in a traced device-loop body
+      either fails on tracers or silently re-enters the host mid-loop —
+      the decode megastep's whole contract is that its inner loop has
+      ZERO of these, so this rule takes no pragma suppression.
+      `device_loop_bodies(path)` reports which bodies were analyzed, so
+      a gate test can assert the rule engaged (a clean result proves
+      nothing if no loop was seen).
 
 Suppression: any flagged line (or its enclosing loop header) carrying a
 `# fflint: host-ok` / `# fflint: ignore` comment is skipped — intentional
@@ -43,6 +53,12 @@ DEFAULT_ROOTS = ("runtime", "serving.py", "paged", "spec", "obs")
 _SYNC_CALLS = {("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
                ("numpy", "array"), ("jax", "device_get")}
 _DEVICE_MODULES = {"jnp", "lax"}
+
+# structured-control-flow primitives whose function arguments trace as
+# DEVICE loop bodies (argument index of each body-like callable)
+_DEVICE_LOOP_FNS = {"while_loop": (0, 1), "fori_loop": (2,), "scan": (0,)}
+_HOST_MODULES = {"np", "numpy"}
+_HOST_CALLBACKS = {"pure_callback", "io_callback", "device_get"}
 
 
 def default_src_paths() -> List[str]:
@@ -213,6 +229,101 @@ class _FnScanner(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _DeviceLoopScanner(ast.NodeVisitor):
+    """Scan one lax.while_loop/fori_loop/scan body for host syncs. No
+    pragma suppression: a sync inside a traced device loop is never an
+    intentional per-tick transfer — it is a bug (trace failure or a
+    host re-entry mid-loop), the exact property the decode megastep's
+    inner loop is built to prove away."""
+
+    def __init__(self, findings, rel, kind, body_name):
+        self.findings = findings
+        self.rel = rel
+        self.where = f"{kind} body {body_name!r}"
+
+    def _add(self, lineno, msg):
+        self.findings.append(Finding(
+            "hostsync", "error", "device-loop", f"{self.rel}:{lineno}",
+            f"in {self.where}: {msg}"))
+
+    # a def nested inside a loop body still traces as part of it when
+    # called there — v1 stays direct-body like the rest of the pass, so
+    # nested defs are skipped (documented non-transitivity)
+    def visit_FunctionDef(self, node):
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        d = _dotted(node.func)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args:
+            self._add(node.lineno,
+                      ".item() — a per-element device sync cannot trace "
+                      "inside a device loop body")
+        elif d and d[0] in _HOST_MODULES:
+            self._add(node.lineno,
+                      f"{'.'.join(d)} — numpy executes on host at trace "
+                      "time; inside a device loop it fails on tracers or "
+                      "bakes a stale constant")
+        elif d and d[-1] in _HOST_CALLBACKS and d[0] == "jax":
+            self._add(node.lineno,
+                      f"{'.'.join(d)} — a host round-trip inside the "
+                      "device loop defeats the fused dispatch")
+        self.generic_visit(node)
+
+
+def _device_loop_scan(tree: ast.Module, rel: str, findings: List[Finding],
+                      bodies: Optional[List[Dict]] = None) -> None:
+    """Find every lax.while_loop/fori_loop/scan call site, resolve its
+    body-like arguments (local function names or inline lambdas), and
+    scan each body for host syncs. `bodies` collects what was analyzed
+    (for device_loop_bodies / gate tests)."""
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if not d or d[-1] not in _DEVICE_LOOP_FNS or "lax" not in d:
+            continue
+        kind = d[-1]
+        for idx in _DEVICE_LOOP_FNS[kind]:
+            if idx >= len(node.args):
+                continue
+            arg = node.args[idx]
+            targets = []
+            if isinstance(arg, ast.Name):
+                # same-name defs elsewhere in the module are scanned
+                # too — an over-approximation a lint can afford
+                targets = [(arg.id, fn) for fn in defs.get(arg.id, ())]
+            elif isinstance(arg, ast.Lambda):
+                targets = [("<lambda>", arg)]
+            for name, fn in targets:
+                if bodies is not None:
+                    bodies.append({"kind": kind, "body": name,
+                                   "line": node.lineno})
+                scanner = _DeviceLoopScanner(findings, rel, kind, name)
+                body = fn.body if isinstance(fn.body, list) else [fn.body]
+                for child in body:
+                    scanner.visit(child)
+
+
+def device_loop_bodies(path: str) -> List[Dict]:
+    """The device-loop bodies the `device-loop` rule analyzed in `path`
+    ({kind, body, line} per body). A gate test pairs this with
+    scan_file: zero device-loop findings only proves something when at
+    least one body was actually seen."""
+    with open(path) as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    bodies: List[Dict] = []
+    _device_loop_scan(tree, os.path.basename(path), [], bodies)
+    return bodies
+
+
 def scan_file(path: str, rel: Optional[str] = None) -> List[Finding]:
     rel = rel or os.path.basename(path)
     with open(path) as f:
@@ -232,6 +343,7 @@ def scan_file(path: str, rel: Optional[str] = None) -> List[Finding]:
                                  jitted, used_pragmas)
             for child in node.body:
                 scanner.visit(child)
+    _device_loop_scan(tree, rel, findings)
     # suppression hygiene: a directive that silenced nothing is stale —
     # the hazard it annotated was refactored away and the annotation must
     # not survive to blanket-silence a future real finding

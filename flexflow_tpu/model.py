@@ -1217,7 +1217,7 @@ class FFModel:
                          page_size: int = 64, num_pages=None,
                          preemption: bool = True, prefix_cache: bool = True,
                          prefill_chunk: int = 64, speculate=None,
-                         ragged_pack: bool = True,
+                         ragged_pack: bool = True, megastep_ticks: int = 1,
                          request_record_limit=None):
         """Continuous-batching autoregressive generation endpoint (KV-cache
         decode with per-slot positions — flexflow_tpu.serving). With
@@ -1231,7 +1231,10 @@ class FFModel:
         decodes). `speculate=SpecConfig(...)` (with paged=True) adds
         speculative tree decoding (flexflow_tpu.spec): drafted token
         trees verified in one step, greedy output token-identical, up to
-        depth+1 tokens emitted per step."""
+        depth+1 tokens emitted per step. `megastep_ticks=N` (paged, no
+        speculate) fuses up to N decode ticks into one jitted dispatch
+        with zero host syncs in the inner loop — token output stays
+        identical (docs/paged.md "Decode megasteps")."""
         from flexflow_tpu.serving import serve_generation as _sg
 
         return _sg(self, slots=slots, max_len=max_len, eos_id=eos_id,
@@ -1239,6 +1242,7 @@ class FFModel:
                    num_pages=num_pages, preemption=preemption,
                    prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
                    speculate=speculate, ragged_pack=ragged_pack,
+                   megastep_ticks=megastep_ticks,
                    request_record_limit=request_record_limit)
 
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],

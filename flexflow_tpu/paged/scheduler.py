@@ -80,7 +80,7 @@ class PagedGenerationServer(_GenerationServerBase):
                  page_size: int = 64, num_pages: Optional[int] = None,
                  preemption: bool = True, table_slack_tokens: int = 0,
                  prefix_cache: bool = True, prefill_chunk: int = 64,
-                 ragged_pack: bool = True,
+                 ragged_pack: bool = True, megastep_ticks: int = 1,
                  request_record_limit: Optional[int] = None):
         import jax
 
@@ -114,9 +114,28 @@ class PagedGenerationServer(_GenerationServerBase):
         # verify in the speculative subclass): K/V writes land straight
         # in pool pages, there is no dense staging cache
         self._step = ex.ragged_step_fn()
+        # megastep_ticks > 1: pure-decode ticks run up to N ticks per
+        # dispatch inside one jitted while_loop (docs/paged.md "Decode
+        # megasteps"); 1 keeps the per-tick host loop. Ticks with
+        # mid-prefill chunks in flight always take the one-tick path, so
+        # chunk completion resumes the host scheduler between ticks.
+        self.megastep_ticks = int(megastep_ticks)
+        if self.megastep_ticks < 1:
+            raise ValueError(
+                f"megastep_ticks must be >= 1, got {megastep_ticks}")
+        self._megastep = (ex.paged_megastep_fn(self.megastep_ticks, eos_id)
+                          if self.megastep_ticks > 1 else None)
         self._caches = ex.init_paged_kv_cache(num_pages, self.page_size)
         self._tables = np.zeros((self.slots, self.max_pages_per_seq),
                                 np.int32)
+        # device-resident descriptor mirrors (dirty-flagged, not re-
+        # uploaded per tick): the page-table matrix changes only on
+        # admission / growth / release / defrag, per-slot temps only on
+        # admission / release, and the causal-chain depths/anc defaults
+        # are pure functions of the launch shape
+        self._tables_dev = None
+        self._temps_dev = None
+        self._chain_desc_cache = {}
         self._admit_order: List[int] = []  # live slots, oldest first
         self._requeue: List[_GenRequest] = []  # preempted, ahead of queue
         self._defrag_req = threading.Event()
@@ -136,6 +155,18 @@ class PagedGenerationServer(_GenerationServerBase):
         self._c_rows = self.registry.counter("launch_rows_total")
         self._c_pad = self.registry.counter("padded_rows_total")
         self._g_waste = self.registry.gauge("padding_waste_ratio")
+        # megastep accounting: ticks fused per dispatch, why each
+        # megastep handed control back, and host round-trips per decoded
+        # token — the one-tick path counts one round-trip per tick, so
+        # the N=1 vs N=8 bench A/B reads the same counters
+        self._h_mega = self.registry.histogram("megastep_ticks",
+                                               obs.COUNT_BUCKETS)
+        self._c_rt = self.registry.counter("host_roundtrips_total")
+        self._c_dtok = self.registry.counter("decode_tokens_total")
+        self._g_rt_tok = self.registry.gauge("host_roundtrips_per_token")
+        self._c_break = {
+            r: self.registry.counter(f"megastep_break_{r}_total")
+            for r in ("finish", "page", "limit")}
         # one gate decision, surfaced: which attention path this server's
         # launches take (evaluated host-side at init — the gate only
         # depends on shapes/dtype/backend/env, all fixed for the server's
@@ -208,6 +239,16 @@ class PagedGenerationServer(_GenerationServerBase):
             "padding_waste_ratio": (
                 self._c_pad.value / self._c_rows.value
                 if self._c_rows.value else 0.0),
+            "megastep": {
+                "ticks_max": self.megastep_ticks,
+                "host_roundtrips": int(self._c_rt.value),
+                "decode_tokens": int(self._c_dtok.value),
+                "host_roundtrips_per_token": (
+                    self._c_rt.value / self._c_dtok.value
+                    if self._c_dtok.value else 0.0),
+                "breaks": {r: int(c.value)
+                           for r, c in self._c_break.items()},
+            },
             "prefix_cache": {
                 "enabled": self.prefix_cache,
                 "hit_tokens": pool.hit_tokens,
@@ -280,6 +321,8 @@ class PagedGenerationServer(_GenerationServerBase):
         self.pool.free(list(reversed(req.pages)))
         req.pages = []
         self._tables[slot] = 0
+        self._mark_tables_dirty()
+        self._mark_temps_dirty()
         if slot in self._admit_order:
             self._admit_order.remove(slot)
         super()._release_slot(slot, req, completed)
@@ -297,6 +340,8 @@ class PagedGenerationServer(_GenerationServerBase):
         req.pages = []
         self._reset_prefill_state(req)
         self._tables[slot] = 0
+        self._mark_tables_dirty()
+        self._mark_temps_dirty()
         self._active[slot] = None
         if slot in self._admit_order:
             self._admit_order.remove(slot)
@@ -363,6 +408,8 @@ class PagedGenerationServer(_GenerationServerBase):
         req.peak_pages = max(req.peak_pages, len(pages))
         self._tables[slot] = 0
         self._tables[slot, :len(pages)] = pages
+        self._mark_tables_dirty()
+        self._mark_temps_dirty()
         if cow_src is not None:
             self._caches = self._copy_page(
                 self._caches, jnp.asarray(cow_src, jnp.int32),
@@ -416,6 +463,7 @@ class PagedGenerationServer(_GenerationServerBase):
                     req.pages.append(got[0])
                     req.peak_pages = max(req.peak_pages, len(req.pages))
                     self._tables[slot, len(req.pages) - 1] = got[0]
+                    self._mark_tables_dirty()
                     continue
                 victims = [s for s in self._admit_order if s != slot]
                 if self.preemption and victims:
@@ -438,6 +486,7 @@ class PagedGenerationServer(_GenerationServerBase):
         # old_to_new is one global map. The pool rewrote the hash index
         # and LRU inside defrag().
         self._tables = old_to_new[self._tables]
+        self._mark_tables_dirty()
         for s in self._admit_order:
             req = self._active[s]
             if req is not None:
@@ -496,6 +545,54 @@ class PagedGenerationServer(_GenerationServerBase):
         req = self._active[slot]
         return req is not None and req.prefill_pos < req.prefill_target
 
+    # -- device-resident descriptor mirrors --------------------------------
+
+    def _mark_tables_dirty(self):
+        """Every `self._tables` write funnels through a call to this:
+        the device mirror re-uploads on next use, never per tick."""
+        self._tables_dev = None
+
+    def _mark_temps_dirty(self):
+        self._temps_dev = None
+
+    def _tables_device(self):
+        """The (slots, max_pages) page-table matrix on device, uploaded
+        only when admission/growth/release/defrag dirtied it."""
+        import jax.numpy as jnp
+
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self._tables)
+        return self._tables_dev
+
+    def _temps_device(self):
+        """Per-slot sampling temperatures on device (0.0 = greedy,
+        also the empty-slot filler), uploaded only when slot occupancy
+        changed."""
+        import jax.numpy as jnp
+
+        if self._temps_dev is None:
+            self._temps_dev = jnp.asarray(np.array(
+                [self._active[s].temperature if self._active[s] else 0.0
+                 for s in range(self.slots)], np.float32))
+        return self._temps_dev
+
+    def _chain_descriptor_device(self, B, window):
+        """Cached device copies of the default causal-chain descriptor
+        for a (B, window) launch: depths 0..window-1 and the lower-
+        triangular ancestor relation, identical every tick of the same
+        shape — only tree launches (speculative verify) override them."""
+        import jax.numpy as jnp
+
+        key = (B, window)
+        hit = self._chain_desc_cache.get(key)
+        if hit is None:
+            deps = np.tile(np.arange(window, dtype=np.int32), (B, 1))
+            anc = np.tile(np.tril(np.ones((window, window), np.bool_)),
+                          (B, 1, 1))
+            hit = (jnp.asarray(deps), jnp.asarray(anc))
+            self._chain_desc_cache[key] = hit
+        return hit
+
     def _launch(self, items, window, tr, ntr):
         """Run ONE ragged step over packed work items. Each item is
         (slot, pos, tokens, depths, anc): `tokens` the item's q_len <=
@@ -514,24 +611,40 @@ class PagedGenerationServer(_GenerationServerBase):
         ids = np.zeros((B, window), np.int32)
         pos = np.zeros((B,), np.int32)
         qls = np.zeros((B,), np.int32)
-        deps = np.tile(np.arange(window, dtype=np.int32), (B, 1))
-        anc = np.tile(np.tril(np.ones((window, window), np.bool_)),
-                      (B, 1, 1))
-        tables = np.zeros((B, self.max_pages_per_seq), np.int32)
+        slot_idx = np.zeros((B,), np.int32)
+        # the causal-chain default (decode rows, chunk pieces) is a pure
+        # function of the launch shape — reuse its device copy instead of
+        # re-uploading it every tick; only drafted trees override it
+        chain = all(d is None and a is None for (_s, _p, _t, d, a) in items)
+        if chain:
+            deps_d, anc_d = self._chain_descriptor_device(B, window)
+        else:
+            deps = np.tile(np.arange(window, dtype=np.int32), (B, 1))
+            anc = np.tile(np.tril(np.ones((window, window), np.bool_)),
+                          (B, 1, 1))
         for i, (slot, p, toks, d, a) in enumerate(items):
             ql = len(toks)
             ids[i, :ql] = toks
             pos[i] = p
             qls[i] = ql
-            tables[i] = self._tables[slot]
+            slot_idx[i] = slot
             if d is not None:
                 deps[i] = d
             if a is not None:
                 anc[i] = a
+        if not chain:
+            deps_d, anc_d = jnp.asarray(deps), jnp.asarray(anc)
+        # page tables ride the dirty-flagged device mirror: the canonical
+        # one-item-per-slot decode launch uses it as-is, packed launches
+        # gather their rows on device from a (B,) index upload
+        tbl = self._tables_device()
+        if B != self.slots or not np.array_equal(
+                slot_idx, np.arange(self.slots, dtype=np.int32)):
+            tbl = jnp.take(tbl, jnp.asarray(slot_idx), axis=0)
         probs, upd = self._step(
-            tr, ntr, self._caches,
-            jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(qls),
-            jnp.asarray(deps), jnp.asarray(anc), jnp.asarray(ids))
+            tr, ntr, self._caches, tbl,
+            jnp.asarray(pos), jnp.asarray(qls), deps_d, anc_d,
+            jnp.asarray(ids))
         self._caches = upd
         total = B * window
         padded = total - int(qls.sum())
@@ -678,7 +791,6 @@ class PagedGenerationServer(_GenerationServerBase):
         Mid-prefill slots ride along with nulled table rows (fixed-shape
         program) and count the tick as decode/prefill overlap."""
         import jax
-        import jax.numpy as jnp
 
         t0 = time.monotonic()
         sp = obs.span("decode_tick").__enter__()
@@ -697,13 +809,16 @@ class PagedGenerationServer(_GenerationServerBase):
         self._g_waste.set(padded / total if total else 0.0)
         if sp:
             sp.set(padded_rows=padded, total_rows=total)
-        temps = np.array(
-            [self._active[s].temperature if self._active[s] else 0.0
-             for s in range(self.slots)], np.float32)
         self._rng, sub = jax.random.split(self._rng)
         toks = np.asarray(self._pick(probs[:, -1, :],
-                                     jnp.asarray(temps), sub))
+                                     self._temps_device(), sub))
         self._steps += 1
+        # one host round-trip bought len(live) tokens — the same
+        # counters the megastep path feeds, so N=1 vs N>1 compare
+        self._c_rt.inc()
+        self._c_dtok.inc(len(live))
+        if self._c_dtok.value:
+            self._g_rt_tok.set(self._c_rt.value / self._c_dtok.value)
         for s in self._admit_order:
             if self._mid_prefill(s):
                 self._active[s].decode_overlap_ticks += 1
@@ -722,6 +837,105 @@ class PagedGenerationServer(_GenerationServerBase):
         if led is not None:
             led.record("decode", dt, batch=len(live))
 
+    def _decode_megastep(self, live, tr, ntr):
+        """Up to `megastep_ticks` decode ticks in ONE jitted dispatch
+        (Executor.paged_megastep_fn): positions, page-table tail
+        capacity, finish flags, temps, the rng chain and the sampled-
+        token buffer all live on device inside a `jax.lax.while_loop`;
+        the host consumes the whole (ticks, slots) buffer in a single
+        transfer, then replays its bookkeeping (append, prefix
+        publication, finish) token by token in the one-tick order.
+
+        The device loop breaks BEFORE any tick it cannot run alone:
+        after a slot finishes (length, or eos mid-megastep) or when a
+        slot's next write row would cross its allocated pages — so page
+        growth, admission, eviction and defrag stay host-side exactly
+        where poolcheck models them, and the prefix cache sees the same
+        page-boundary publications the one-tick loop produces. Only
+        dispatched on pure-decode ticks: mid-prefill chunks keep host
+        granularity (_loop_body), so a finishing chunk always resumes
+        the host. Greedy AND fixed-seed sampled output are token-
+        identical to the one-tick loop — the rng advances by the same
+        split chain, one split per tick."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        sp = obs.span("megastep").__enter__()
+        if sp:
+            sp.set(live=len(live), pages_in_use=self.pool.pages_in_use)
+        P = self.page_size
+        pos = np.zeros((self.slots,), np.int32)
+        rem = np.zeros((self.slots,), np.int32)
+        cap = np.zeros((self.slots,), np.int32)
+        act = np.zeros((self.slots,), np.bool_)
+        for s in live:
+            req = self._active[s]
+            pos[s] = req.pos
+            rem[s] = req.max_new - len(req.tokens)
+            cap[s] = len(req.pages) * P
+            act[s] = True
+        caches, out, done, rng, ticks = self._megastep(
+            tr, ntr, self._caches, self._tables_device(),
+            jnp.asarray(pos), jnp.asarray(self._tokens),
+            self._temps_device(), jnp.asarray(rem), jnp.asarray(cap),
+            jnp.asarray(act), self._rng)
+        self._caches = caches
+        self._rng = rng
+        # the ONE host sync of the megastep: token buffer + finish
+        # flags + tick count in a single transfer
+        out_np, done_np, n = jax.device_get((out, done, ticks))
+        n = int(n)
+        if done_np.any():
+            reason = "finish"
+        elif n < self.megastep_ticks:
+            reason = "page"
+        else:
+            reason = "limit"
+        # replay host bookkeeping tick by tick in the one-tick order:
+        # every executed tick emitted a token for every live slot (the
+        # loop breaks before the tick AFTER a finish, so finishes only
+        # ever land on the last replayed tick)
+        for t in range(n):
+            self._steps += 1
+            for s in live:
+                req = self._active[s]
+                tok = int(out_np[t, s])
+                req.pos += 1
+                req.tokens.append(tok)
+                self._tokens[s] = tok
+                self._publish_prefix(req, req.pos)
+                self._finish_if_done(s)
+        self._on_megastep_resume()
+        rows, padded = n * self.slots, n * (self.slots - len(live))
+        self._c_rows.inc(rows)
+        self._c_pad.inc(padded)
+        self._g_waste.set(padded / rows if rows else 0.0)
+        self._c_rt.inc()
+        self._c_dtok.inc(n * len(live))
+        if self._c_dtok.value:
+            self._g_rt_tok.set(self._c_rt.value / self._c_dtok.value)
+        self._h_mega.observe(n)
+        self._c_break[reason].inc()
+        if sp:
+            sp.set(ticks=n, break_reason=reason)
+        sp.__exit__(None, None, None)
+        dt = time.monotonic() - t0
+        # per-tick effective latency: the histogram stays comparable
+        # across megastep widths (the A/B's p50/p95 read)
+        self._h_tick.observe(dt / max(n, 1))
+        self._h_tokens.observe(len(live))
+        led = obs.ledger()
+        if led is not None:
+            led.record("decode", dt, batch=len(live), width=max(n, 1))
+
+    def _on_megastep_resume(self):
+        """Hook fired after a megastep's host bookkeeping replay, before
+        its metrics are recorded — the host-resume point. Tests override
+        it to assert pool invariants after every resume; the base server
+        does nothing (check_invariants is too hot for the serving
+        loop)."""
+
     def _loop_body(self, tr, ntr):
         while not self._stop.is_set():
             live = self._tick_prep()
@@ -731,7 +945,10 @@ class PagedGenerationServer(_GenerationServerBase):
             if pre:
                 self._prefill_tick(pre, tr, ntr)
             if dec:
-                self._decode_tick(dec, tr, ntr)
+                if self._megastep is not None and not pre:
+                    self._decode_megastep(dec, tr, ntr)
+                else:
+                    self._decode_tick(dec, tr, ntr)
 
     def _drain(self):
         super()._drain()

@@ -141,6 +141,7 @@ class Executor:
         self._decode_fn = None
         self._paged_decode_fn = None
         self._ragged_step_fn = None
+        self._megastep_fns: Dict[Any, Any] = {}
         self._verify_fn = None
         self._paged_commit_fn = None
         # remat="hidden": recompute MLP hidden activations in backward
@@ -880,6 +881,90 @@ class Executor:
 
         self._ragged_step_fn = jax.jit(step)
         return self._ragged_step_fn
+
+    def paged_megastep_fn(self, max_ticks: int, eos_id=None):
+        """jitted decode MEGASTEP: up to `max_ticks` single-token decode
+        ticks inside one `jax.lax.while_loop`, every fast-path state
+        device-resident (flexflow_tpu.paged megastep driver).
+
+        (params, pools, page_tables, pos, toks, temps, remaining,
+         cap_rows, active, rng) ->
+            (new_pools, out_tokens, done, new_rng, ticks)
+
+        Per-slot inputs are (slots,)-shaped: `pos` the next write row,
+        `toks` the last sampled token (next tick's input), `remaining`
+        tokens the request may still emit, `cap_rows` the rows its
+        ALLOCATED pages cover, `active` which slots decode (inactive
+        rows carry q_len 0: no work, K/V writes redirected to the null
+        page). Each iteration runs the same per-tick compute as
+        ragged_step_fn at window 1, advances the rng by the identical
+        `jax.random.split` chain the host one-tick loop uses, samples
+        via serving.pick_tokens, and appends into the
+        (max_ticks, slots) token buffer (-1 on inactive rows). The loop
+        stops BEFORE a tick that cannot run on device alone: after any
+        active slot finishes (remaining exhausted, or sampled `eos_id`
+        when given) or when a slot's next write row would cross its
+        allocated capacity (page growth is host bookkeeping). `ticks`
+        counts executed iterations; `done` marks who finished, so the
+        host scheduler consumes the whole buffer in one transfer.
+        Compiled once per (max_ticks, eos_id, slots) — table/positions
+        are contents, never shapes."""
+        key = (int(max_ticks), eos_id)
+        fn = self._megastep_fns.get(key)
+        if fn is not None:
+            return fn
+        from flexflow_tpu.serving import pick_tokens  # lazy: no cycle
+
+        N = int(max_ticks)
+
+        def megastep(trainable, nontrainable, caches, page_tables, pos,
+                     toks, temps, remaining, cap_rows, active, rng):
+            slots = pos.shape[0]
+            q_lens = jnp.where(active, 1, 0).astype(jnp.int32)
+            depths = jnp.zeros((slots, 1), jnp.int32)
+            anc = jnp.ones((slots, 1, 1), jnp.bool_)
+            out0 = jnp.full((N, slots), -1, jnp.int32)
+
+            def cond(state):
+                t, _caches, p, _tk, _rem, done, _rng, _out = state
+                # next tick writes row p per active slot: it needs
+                # cap >= p+1 rows; a finished slot hands control back
+                room = jnp.all(jnp.logical_or(
+                    jnp.logical_not(active), p + 1 <= cap_rows))
+                return (t < N) & jnp.logical_not(jnp.any(done)) & room
+
+            def body(state):
+                t, caches_t, p, tk, rem, _done, rng_t, out = state
+                cache_out = {}
+                probs, _, _ = self.run_forward(
+                    trainable, nontrainable, (tk[:, None],),
+                    training=False, rng=jax.random.key(0),
+                    kv_caches=caches_t, cache_position=p,
+                    cache_out=cache_out, page_tables=page_tables,
+                    ragged=(q_lens, depths, anc),
+                )
+                rng_t, sub = jax.random.split(rng_t)
+                nxt = pick_tokens(probs[:, -1, :], temps, sub)
+                tk2 = jnp.where(active, nxt, tk)
+                p2 = jnp.where(active, p + 1, p)
+                rem2 = jnp.where(active, rem - 1, rem)
+                fin = active & (rem2 <= 0)
+                if eos_id is not None:
+                    fin = fin | (active & (tk2 == eos_id))
+                out2 = out.at[t].set(jnp.where(active, nxt, -1))
+                return (t + 1, cache_out, p2, tk2, rem2, fin, rng_t,
+                        out2)
+
+            t, caches, pos, toks, remaining, done, rng, out = \
+                jax.lax.while_loop(
+                    cond, body,
+                    (jnp.int32(0), caches, pos, toks, remaining,
+                     jnp.zeros_like(active), rng, out0))
+            return caches, out, done, rng, t
+
+        fn = jax.jit(megastep)
+        self._megastep_fns[key] = fn
+        return fn
 
     def paged_commit_fn(self):
         """jitted (pools, page_tables, src, dst) -> pools: copy the

@@ -33,6 +33,25 @@ import numpy as np
 from flexflow_tpu import obs
 
 
+def pick_tokens(probs_last, temps, rng):
+    """Sample one token per row: greedy where temp<=0, else temperature-
+    scaled categorical. Pure jnp on its arguments — safe to trace both as
+    the host-side jitted `_pick` AND inside a `jax.lax.while_loop` carry
+    (the decode megastep), where the rng advances by the SAME
+    `jax.random.split` chain the host loop uses, so megastep and one-tick
+    decode draw identical key sequences. Row b's draw depends only on
+    (rng, row b's logits): padded/idle rows never perturb live rows."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(probs_last, axis=-1).astype(jnp.int32)
+    logits = jnp.log(jnp.maximum(probs_last, 1e-30)) / jnp.maximum(
+        temps[:, None], 1e-6)
+    sampled = jax.random.categorical(rng, logits, axis=-1).astype(
+        jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
 class ModelInstance:
     """One compiled forward per allowed batch size (the reference's
     per-instance compiled model, triton/src/instance.cc analog)."""
@@ -490,7 +509,6 @@ class _GenerationServerBase:
                  eos_id: Optional[int], seed: int,
                  request_record_limit: Optional[int] = None):
         import jax
-        import jax.numpy as jnp
 
         self.ff = ff
         self.slots = int(slots)
@@ -508,17 +526,9 @@ class _GenerationServerBase:
         self._params = ff._params
         self._rng = jax.random.key(seed)
 
-        @jax.jit
-        def pick(probs_last, temps, rng):
-            # probs_last: (B, V) — greedy where temp<=0, else sampled
-            greedy = jnp.argmax(probs_last, axis=-1).astype(jnp.int32)
-            logits = jnp.log(jnp.maximum(probs_last, 1e-30)) / jnp.maximum(
-                temps[:, None], 1e-6)
-            sampled = jax.random.categorical(rng, logits, axis=-1).astype(
-                jnp.int32)
-            return jnp.where(temps > 0.0, sampled, greedy)
-
-        self._pick = pick
+        # probs_last: (B, V) — the one sampling program every decode path
+        # shares (dense, paged, packed spec roots, megastep inner loop)
+        self._pick = jax.jit(pick_tokens)
         self._queue: "queue.Queue[_GenRequest]" = queue.Queue()
         self._active: List[Optional[_GenRequest]] = [None] * self.slots
         self._tokens = np.zeros((self.slots,), np.int32)
@@ -858,6 +868,7 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                      prefill_chunk: int = 64,
                      speculate=None,
                      ragged_pack: bool = True,
+                     megastep_ticks: int = 1,
                      request_record_limit: Optional[int] = None
                      ) -> "_GenerationServerBase":
     """Continuous-batching generation endpoint over a compiled causal-LM
@@ -896,10 +907,29 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
     baseline for the `padding_waste_ratio` metric. Token output is
     identical either way.
 
+    `megastep_ticks=N` (paged only, N > 1) runs up to N decode ticks
+    per dispatch inside ONE jitted `jax.lax.while_loop` — positions,
+    sampler state and sampled tokens stay device-resident and control
+    returns to the host scheduler only when a slot finishes, a page
+    fills, or N ticks elapse (docs/paged.md "Decode megasteps"). Token
+    output is identical to the one-tick loop, greedy and sampled alike;
+    the default N=1 keeps the per-tick host loop. Ticks with mid-prefill
+    chunks in flight keep host granularity either way, so chunk
+    completion always resumes the host between ticks.
+
     `request_record_limit` bounds how many completed requests keep their
     per-request metric record (default _GenerationServerBase
     .MAX_REQUEST_RECORDS); cumulative counters and histograms are
     unaffected."""
+    megastep_ticks = int(megastep_ticks)
+    if megastep_ticks < 1:
+        raise ValueError(
+            f"megastep_ticks must be >= 1, got {megastep_ticks}")
+    if megastep_ticks > 1 and (not paged or speculate is not None):
+        raise ValueError(
+            "megastep_ticks > 1 rides the paged one-tick decode loop; "
+            "pass paged=True and no speculate (the speculative server's "
+            "verify step already emits multiple tokens per dispatch)")
     if speculate is not None:
         if not paged:
             raise ValueError(
@@ -920,7 +950,7 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             ff, slots=slots, max_len=max_len, eos_id=eos_id, seed=seed,
             page_size=page_size, num_pages=num_pages, preemption=preemption,
             prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
-            ragged_pack=ragged_pack,
+            ragged_pack=ragged_pack, megastep_ticks=megastep_ticks,
             request_record_limit=request_record_limit)
     return GenerationServer(ff, slots=slots, max_len=max_len, eos_id=eos_id,
                             seed=seed,

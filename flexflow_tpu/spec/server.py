@@ -295,7 +295,7 @@ class SpeculativePagedServer(PagedGenerationServer):
                 req.spec_emitted += L
                 self.spec_emitted += L
             self._caches = self._commit(self._caches,
-                                        jnp.asarray(self._tables),  # fflint: host-ok (per-tick batch transfer)
+                                        self._tables_device(),
                                         jnp.asarray(src),  # fflint: host-ok (per-tick batch transfer)
                                         jnp.asarray(dst))  # fflint: host-ok (per-tick batch transfer)
             for s in live:

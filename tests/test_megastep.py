@@ -1,0 +1,320 @@
+"""Decode megasteps (flexflow_tpu.paged, megastep_ticks=N).
+
+Contract under test: running up to N decode ticks inside one jitted
+`jax.lax.while_loop` (Executor.paged_megastep_fn) is a pure dispatch
+fusion — token output is IDENTICAL to the one-tick loop and to dense
+FFModel.generate, greedy and fixed-seed temperature sampling alike,
+because the device loop advances the same rng split chain and breaks
+back to the host before any tick it cannot run alone (slot finished,
+page boundary). Host bookkeeping (pages, prefix cache, admission) must
+hold the poolcheck invariant catalog after every host-resume point.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+from flexflow_tpu.ffconst import DataType
+from flexflow_tpu.models.llama import LlamaConfig, build_llama
+
+
+def _causal_lm(seed=7):
+    lcfg = LlamaConfig(vocab_size=512, dim=64, layers=2, heads=4,
+                       kv_heads=2, hidden=128, rope_theta=10000.0)
+    ff = FFModel(FFConfig(batch_size=1, seed=seed))
+    build_llama(ff, lcfg, batch_size=1, seq_len=8, dtype=DataType.FLOAT)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff, lcfg
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _causal_lm()
+
+
+# ---------------------------------------------------------------------------
+# token identity: megastep vs one-tick vs dense
+
+
+@pytest.mark.parametrize("n_ticks", [1, 4, 8])
+def test_megastep_greedy_identity_vs_dense(lm, n_ticks):
+    """Greedy output through megastep_ticks in {1, 4, 8} must equal
+    dense FFModel.generate token for token (N=1 is the legacy one-tick
+    loop — the same assertion pins megastep and one-tick to each other
+    through the shared dense reference)."""
+    ff, lcfg = lm
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 6, 5)]
+    want = [ff.generate(p[None, :], max_new_tokens=12)[0] for p in prompts]
+    server = ff.serve_generation(slots=4, max_len=64, paged=True,
+                                 page_size=4, megastep_ticks=n_ticks)
+    try:
+        futs = [server.submit(p, max_new_tokens=12) for p in prompts]
+        got = [f.result(timeout=600) for f in futs]
+        m = server.metrics()
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    ms = m["megastep"]
+    assert ms["ticks_max"] == n_ticks
+    assert ms["decode_tokens"] > 0
+    if n_ticks > 1:
+        # fused dispatches: strictly fewer host round-trips than tokens
+        assert ms["host_roundtrips"] < ms["decode_tokens"]
+        # every megastep dispatch records its break reason (decode
+        # ticks concurrent with a prefill chunk take the one-tick path,
+        # which counts a roundtrip without a break)
+        assert 1 <= sum(ms["breaks"].values()) <= ms["host_roundtrips"]
+    else:
+        # the one-tick loop pays one round-trip per token batch
+        assert ms["host_roundtrips_per_token"] == pytest.approx(
+            ms["host_roundtrips"] / ms["decode_tokens"])
+
+
+@pytest.mark.parametrize("n_ticks", [4, 8])
+def test_megastep_temperature_identity_fixed_seed(lm, n_ticks):
+    """Fixed-seed temperature sampling is megastep-width invariant: the
+    device loop advances the rng by the SAME jax.random.split chain the
+    host one-tick loop uses (one split per tick), so the sampled stream
+    cannot depend on how many ticks fused into one dispatch."""
+    ff, lcfg = lm
+    rs = np.random.RandomState(1)
+    p = rs.randint(0, lcfg.vocab_size, (5,)).astype(np.int32)
+    outs = {}
+    for n in (1, n_ticks):
+        server = ff.serve_generation(slots=4, max_len=64, paged=True,
+                                     page_size=8, seed=11,
+                                     megastep_ticks=n)
+        try:
+            outs[n] = server.generate(p, max_new_tokens=14,
+                                      temperature=0.8)
+        finally:
+            server.stop()
+    np.testing.assert_array_equal(outs[1], outs[n_ticks])
+
+
+# ---------------------------------------------------------------------------
+# early-break correctness
+
+
+def test_megastep_page_boundary_break(lm):
+    """page_size=4 forces a page-allocation break at most every 4 fused
+    ticks: output stays dense-identical and the break counters show the
+    megastep handing control back for page growth, never running a tick
+    past a slot's allocated capacity."""
+    ff, lcfg = lm
+    rs = np.random.RandomState(2)
+    p = rs.randint(0, lcfg.vocab_size, (5,)).astype(np.int32)
+    want = ff.generate(p[None, :], max_new_tokens=16)[0]
+    server = ff.serve_generation(slots=2, max_len=64, paged=True,
+                                 page_size=4, megastep_ticks=8)
+    try:
+        got = server.generate(p, max_new_tokens=16)
+        m = server.metrics()
+    finally:
+        server.stop()
+    np.testing.assert_array_equal(want, got)
+    assert m["megastep"]["breaks"]["page"] > 0
+    # a 4-row page caps every megastep at <= 4 fused ticks
+    assert m["megastep"]["decode_tokens"] <= 4 * m["megastep"][
+        "host_roundtrips"]
+
+
+def test_megastep_length_finish_mid_megastep(lm):
+    """max_new smaller than the megastep width: the request finishes
+    mid-megastep (finish break), emits exactly max_new tokens, and the
+    stream matches dense."""
+    ff, lcfg = lm
+    rs = np.random.RandomState(3)
+    p = rs.randint(0, lcfg.vocab_size, (4,)).astype(np.int32)
+    want = ff.generate(p[None, :], max_new_tokens=5)[0]
+    server = ff.serve_generation(slots=2, max_len=64, paged=True,
+                                 page_size=16, megastep_ticks=8)
+    try:
+        got = server.generate(p, max_new_tokens=5)
+        m = server.metrics()
+    finally:
+        server.stop()
+    np.testing.assert_array_equal(want, got)
+    assert len(got) == 5
+    assert m["megastep"]["breaks"]["finish"] > 0
+
+
+def test_megastep_stop_token_mid_megastep(lm):
+    """eos sampled mid-megastep truncates the stream exactly where the
+    one-tick loop truncates it: learn a token the greedy stream emits,
+    re-serve with it as eos_id through both paths, compare."""
+    ff, lcfg = lm
+    rs = np.random.RandomState(4)
+    p = rs.randint(0, lcfg.vocab_size, (5,)).astype(np.int32)
+    probe = ff.serve_generation(slots=2, max_len=64, paged=True,
+                                page_size=16, megastep_ticks=1)
+    try:
+        stream = probe.generate(p, max_new_tokens=10)
+    finally:
+        probe.stop()
+    eos = int(stream[3])  # finishes on tick 4 of an 8-tick megastep
+    got = {}
+    for n in (1, 8):
+        server = ff.serve_generation(slots=2, max_len=64, paged=True,
+                                     page_size=16, eos_id=eos,
+                                     megastep_ticks=n)
+        try:
+            got[n] = server.generate(p, max_new_tokens=10)
+            breaks = server.metrics()["megastep"]["breaks"]
+        finally:
+            server.stop()
+    np.testing.assert_array_equal(got[1], got[8])
+    assert got[8][-1] == eos and len(got[8]) == 4
+    assert breaks["finish"] > 0  # the N=8 server broke on the stop token
+
+
+def test_megastep_mixed_finish_orders(lm):
+    """Slots finishing at different ticks inside the same megastep run:
+    staggered max_new across concurrent requests, every stream
+    dense-identical, finished slots freed while others keep decoding
+    (requests_served == all)."""
+    ff, lcfg = lm
+    rs = np.random.RandomState(5)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 5, 4, 6)]
+    new = [3, 7, 12, 5]
+    want = [ff.generate(p[None, :], max_new_tokens=mn)[0]
+            for p, mn in zip(prompts, new)]
+    server = ff.serve_generation(slots=4, max_len=64, paged=True,
+                                 page_size=4, megastep_ticks=8)
+    try:
+        futs = [server.submit(p, max_new_tokens=mn)
+                for p, mn in zip(prompts, new)]
+        got = [f.result(timeout=600) for f in futs]
+        m = server.metrics()
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert m["requests_served"] == len(prompts)
+    assert m["megastep"]["breaks"]["finish"] > 0
+
+
+# ---------------------------------------------------------------------------
+# pool invariants at every host-resume point
+
+
+def test_megastep_pool_invariants_at_every_resume(lm):
+    """The megastep coarsens host bookkeeping from per-token to
+    per-dispatch — the poolcheck invariant catalog must hold at every
+    host-resume point (after the replay of each megastep's token
+    buffer), not just at drain. Exercised with page pressure: small pool
+    forcing growth/preemption between megasteps."""
+    from flexflow_tpu.paged.scheduler import PagedGenerationServer
+
+    resumes = []
+
+    class CheckedServer(PagedGenerationServer):
+        def _on_megastep_resume(self):
+            owners = {}
+            for s in self._admit_order:
+                req = self._active[s]
+                if req is not None and req.pages:
+                    owners[s] = list(req.pages)
+            self.pool.check_invariants(owners=owners)
+            resumes.append(len(owners))
+
+    ff, lcfg = lm
+    rs = np.random.RandomState(6)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 6, 5, 4)]
+    want = [ff.generate(p[None, :], max_new_tokens=10)[0] for p in prompts]
+    server = CheckedServer(ff, slots=3, max_len=64, page_size=4,
+                           num_pages=24, megastep_ticks=8)
+    try:
+        futs = [server.submit(p, max_new_tokens=10) for p in prompts]
+        got = [f.result(timeout=600) for f in futs]
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert len(resumes) > 0  # the hook actually fired
+
+
+# ---------------------------------------------------------------------------
+# observability
+
+
+def test_megastep_obs_spans_and_ledger_width(lm):
+    """Megastep spans carry ticks/break_reason attrs, the
+    megastep_ticks histogram fills, and TickLedger decode keys carry
+    the megastep width so `fftrace calibrate` prices the fused rows."""
+    from flexflow_tpu import obs
+    from flexflow_tpu.obs.calibrate import tick_tokens
+    from flexflow_tpu.obs.ledger import parse_shape_key
+
+    ff, lcfg = lm
+    rs = np.random.RandomState(7)
+    p = rs.randint(0, lcfg.vocab_size, (5,)).astype(np.int32)
+    rec = obs.enable()
+    try:
+        server = ff.serve_generation(slots=2, max_len=64, paged=True,
+                                     page_size=8, megastep_ticks=4)
+        try:
+            server.generate(p, max_new_tokens=12)
+            m = server.metrics()
+        finally:
+            server.stop()
+    finally:
+        obs.disable()
+    # rec.events entries are (name, t0_ns, dur_ns, tid, attrs) tuples
+    attrs = [e[4] for e in rec.events if e[0] == "megastep"]
+    assert attrs, "no megastep spans recorded"
+    assert all(a and "ticks" in a and "break_reason" in a for a in attrs)
+    # single request -> one live slot -> megastep decode tokens == ticks
+    assert sum(a["ticks"] for a in attrs) == m["megastep"]["decode_tokens"]
+    hist = m["histograms"]["megastep_ticks"]
+    assert hist["count"] == len(attrs)
+    decode_keys = [k for k in rec.ledger.shapes()
+                   if k.startswith("decode|")]
+    assert decode_keys
+    widths = {parse_shape_key(k)["width"] for k in decode_keys}
+    assert widths - {1}, f"no megastep-width decode keys: {decode_keys}"
+    # the calibration model prices batch*width rows for a fused tick
+    assert tick_tokens("decode", batch=2, chunk=0, width=4) == 8
+    assert tick_tokens("decode", batch=2, chunk=0, width=1) == 2
+
+
+def test_megastep_rejects_invalid_configs(lm):
+    from flexflow_tpu.spec import SpecConfig
+
+    ff, _ = lm
+    with pytest.raises(ValueError, match="megastep_ticks"):
+        ff.serve_generation(max_len=64, megastep_ticks=0, paged=True)
+    with pytest.raises(ValueError, match="paged"):
+        ff.serve_generation(max_len=64, megastep_ticks=8, paged=False)
+    with pytest.raises(ValueError, match="speculate"):
+        ff.serve_generation(max_len=64, megastep_ticks=8, paged=True,
+                            speculate=SpecConfig(width=2, depth=3))
+
+
+def test_megastep_with_chunked_prefill_mixed_batch(lm):
+    """Mid-prefill chunks keep host granularity (a finishing chunk
+    always resumes the host): a mixed batch — a long prompt prefilling
+    chunk by chunk while short prompts decode through megasteps — stays
+    dense-identical."""
+    ff, lcfg = lm
+    rs = np.random.RandomState(8)
+    long_p = rs.randint(0, lcfg.vocab_size, (24,)).astype(np.int32)
+    shorts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+              for n in (3, 5)]
+    prompts = [shorts[0], long_p, shorts[1]]
+    want = [ff.generate(p[None, :], max_new_tokens=8)[0] for p in prompts]
+    server = ff.serve_generation(slots=3, max_len=64, paged=True,
+                                 page_size=4, prefill_chunk=6,
+                                 megastep_ticks=8)
+    try:
+        futs = [server.submit(p, max_new_tokens=8) for p in prompts]
+        got = [f.result(timeout=600) for f in futs]
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
