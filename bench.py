@@ -381,8 +381,15 @@ def bench_decode() -> dict:
         toks = sum(len(o) for o in outs)
         return toks / dt, toks, metrics
 
-    prompts = [rs.randint(0, lcfg.vocab_size, (rs.randint(4, 17),))
-               .astype(np.int32) for _ in range(n_req)]
+    # fixtures come from the named traffic profiles (search/traffic.py)
+    # so the bench and the serving-strategy search (ISSUE 12) score
+    # against the SAME workloads; each profile draws through `rs` in the
+    # order the inline fixtures always used, so seeded draws are stable
+    from flexflow_tpu.search import traffic as traffic_mod
+
+    smoke_prof = traffic_mod.get_profile("smoke", requests=n_req,
+                                         new_tokens=max_new)
+    prompts = smoke_prof.sample(rs, lcfg.vocab_size).prompts
     _log("decode bench: plain paged serving")
     tps, toks, plain_m = run_server(prompts)
     # tick-latency percentiles ride the always-on serving histograms
@@ -393,13 +400,13 @@ def bench_decode() -> dict:
     # system prefix, so the prefix cache serves the bulk of prefill for
     # the second and later requests — report TTFT p50/p95 and the hit
     # rate (ISSUE 5: >=50% of 2nd+ prefill tokens from cache)
-    sys_len = 2 * page
-    sys_prompt = rs.randint(0, lcfg.vocab_size, (sys_len,)).astype(np.int32)
-    shared = [np.concatenate([sys_prompt,
-                              rs.randint(0, lcfg.vocab_size,
-                                         (rs.randint(4, 17),))
-                              .astype(np.int32)])
-              for _ in range(n_req)]
+    shared_prof = traffic_mod.get_profile("shared-system-prompt",
+                                          page_size=page, requests=n_req,
+                                          new_tokens=max_new)
+    sys_len = shared_prof.shared_prefix_tokens
+    shared_sample = shared_prof.sample(rs, lcfg.vocab_size)
+    sys_prompt = shared_sample.shared_prefix
+    shared = shared_sample.prompts
     _log("decode bench: shared-system-prompt fixture (prefix cache)")
     server = ff.serve_generation(slots=4, max_len=max_len, paged=True,
                                  page_size=page)
@@ -449,13 +456,11 @@ def bench_decode() -> dict:
     # equal-or-better tokens/sec.
     _log("decode bench: ragged packing A/B (mixed prefill/decode)")
     chunk = 3 * page
-    mixed = []
-    for i in range(n_req):
-        if i % 2 == 0:
-            n = rs.randint(4, 10)            # decodes almost immediately
-        else:
-            n = chunk + rs.randint(1, 5)     # needs >= 2 prefill chunks
-        mixed.append(rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32))
+    mixed_prof = traffic_mod.get_profile("mixed-length", page_size=page,
+                                         prefill_chunk=chunk,
+                                         requests=n_req,
+                                         new_tokens=max_new)
+    mixed = mixed_prof.sample(rs, lcfg.vocab_size).prompts
     ragged_ab = {}
     for pack in (True, False):
         server = ff.serve_generation(slots=4, max_len=max_len, paged=True,
@@ -537,6 +542,60 @@ def bench_decode() -> dict:
         f"{n_req} short prompts (4..8 tokens), {max_new} new tokens "
         f"each, page_size={page}")
 
+    # searched-vs-default A/B (ISSUE 12): run the serving-strategy
+    # search at a small budget on the smoke profile, then serve BOTH the
+    # hand default and the searched winner on the plain fixture —
+    # simulated objective side by side with realized decode tokens/sec
+    # and TTFT p95, so the search's wins are checked against a real
+    # server, not just its own tick pricing. Must run before
+    # make_token_cyclic below, which rewrites the weights.
+    _log("decode bench: searched-vs-default serving strategy A/B")
+    from flexflow_tpu.search.servesearch import (
+        ServeStrategy,
+        search_serve_strategy,
+    )
+
+    sres = search_serve_strategy(
+        ff, traffic=smoke_prof, budget=120, seed=0, slots=4,
+        max_len=max_len, default=ServeStrategy(page_size=page))
+    searched_ab = {
+        "objective": {
+            "default": round(sres.default_objective, 8),
+            "searched": round(sres.best_objective, 8),
+            "improvement": round(sres.improvement, 4),
+        },
+        "strategy": sres.best.to_json(),
+    }
+    for label, strat in (("default", sres.default),
+                         ("searched", sres.best)):
+        server = ff.serve_generation(slots=4, max_len=max_len,
+                                     serve_strategy=strat)
+        try:
+            # full warm pass off the clock: each strategy compiles its
+            # own launch shapes (chunk buckets, megastep loop, packing
+            # variant), so serve the whole fixture once untimed — the
+            # timed pass then measures serving, not jit tracing
+            for f in [server.submit(p, max_new_tokens=max_new)
+                      for p in prompts]:
+                f.result(timeout=1200)
+            n_warm = len(prompts)
+            t0 = time.perf_counter()
+            futs = [server.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+            outs = [f.result(timeout=1200) for f in futs]
+            dt = time.perf_counter() - t0
+            m = server.metrics()
+        finally:
+            server.stop()
+        ttfts = [r["ttft_s"] for r in m["requests"][n_warm:]
+                 if r["ttft_s"] is not None]
+        searched_ab[label] = {
+            "decode_tokens_per_sec": round(
+                sum(len(o) for o in outs) / dt, 2),
+            "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 6),
+            "describe": strat.describe(),
+        }
+
     # repetitive fixture: token-cyclic model (shared with tests/test_spec)
     from flexflow_tpu.spec.fixtures import make_token_cyclic
 
@@ -599,6 +658,7 @@ def bench_decode() -> dict:
         "prefix_cache": prefix_metrics,
         "ragged_packing": ragged_ab,
         "megastep": mega_ab,
+        "servesearch": searched_ab,
         "speculative": {
             "tokens_per_sec": round(spec_tps, 2),
             "acceptance_rate": round(sm["acceptance_rate"], 4),

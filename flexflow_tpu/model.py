@@ -1218,7 +1218,8 @@ class FFModel:
                          preemption: bool = True, prefix_cache: bool = True,
                          prefill_chunk: int = 64, speculate=None,
                          ragged_pack: bool = True, megastep_ticks: int = 1,
-                         request_record_limit=None):
+                         request_record_limit=None, serve_strategy=None,
+                         search_budget=None, traffic="smoke"):
         """Continuous-batching autoregressive generation endpoint (KV-cache
         decode with per-slot positions — flexflow_tpu.serving). With
         `paged=True` the KV cache is a block-paged pool shared by all
@@ -1234,7 +1235,12 @@ class FFModel:
         depth+1 tokens emitted per step. `megastep_ticks=N` (paged, no
         speculate) fuses up to N decode ticks into one jitted dispatch
         with zero host syncs in the inner loop — token output stays
-        identical (docs/paged.md "Decode megasteps")."""
+        identical (docs/paged.md "Decode megasteps").
+        `search_budget=N` auto-tunes the paged/spec/megastep knobs with
+        the serving-strategy search against the `traffic` profile before
+        serving; `serve_strategy` applies a previously searched
+        ServeStrategy (or its JSON dict) directly (docs/search.md,
+        "Serving strategy search")."""
         from flexflow_tpu.serving import serve_generation as _sg
 
         return _sg(self, slots=slots, max_len=max_len, eos_id=eos_id,
@@ -1243,7 +1249,9 @@ class FFModel:
                    prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
                    speculate=speculate, ragged_pack=ragged_pack,
                    megastep_ticks=megastep_ticks,
-                   request_record_limit=request_record_limit)
+                   request_record_limit=request_record_limit,
+                   serve_strategy=serve_strategy,
+                   search_budget=search_budget, traffic=traffic)
 
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],
                 batch_size: Optional[int] = None) -> np.ndarray:

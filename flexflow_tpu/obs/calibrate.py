@@ -21,9 +21,15 @@ the artifact alone — no model, no recompile, no accelerator.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 from flexflow_tpu.obs.ledger import TickLedger, parse_shape_key
+
+# Report schema: v2 added the created-at stamp consumers use for
+# staleness (search/servesearch.py refuses reports older than its
+# max-age window, mirroring bench.py's last-green guard).
+CALIBRATION_SCHEMA_VERSION = 2
 
 
 def graph_tokens(graph) -> int:
@@ -46,19 +52,12 @@ def predict_step_seconds(ff) -> Dict:
     time plus everything calibration needs to scale it per tick shape."""
     from flexflow_tpu.search import eventsim
     from flexflow_tpu.search.api import _cost_model
-    from flexflow_tpu.search.cost_model import graph_cost
 
     graph = ff.graph
     strategy = {n.name: n.sharding for n in graph.nodes
                 if n.sharding is not None}
     cost = _cost_model(ff.mesh, ff.config)
-    info: Dict = {}
-    t = eventsim.simulate_graph(graph, strategy, cost, training=False,
-                                info=info)
-    mode = info.get("mode", "eventsim")
-    if t is None:
-        t = graph_cost(graph, strategy, cost, training=False).time
-        mode = f"graph_cost (eventsim: {mode})"
+    t, mode = eventsim.step_seconds(graph, strategy, cost, training=False)
     return {
         "predicted_step_s": float(t),
         "pricing_mode": mode,
@@ -101,6 +100,8 @@ def calibration_report(ledger: TickLedger,
     stamp_ledger_meta). Raises if neither carries a priced step.
 
     Report structure:
+      version / created_at(_unix): schema + staleness stamp — consumers
+                   with a freshness window (servesearch) check these
       shapes:      {key: {measured p50/p95/mean, predicted_s, ratio}}
       tick_scales: {key: ratio}      — MeasuredCostModel.set_tick_calibration
       phases:      {phase: median ratio across that phase's shapes}
@@ -141,8 +142,11 @@ def calibration_report(ledger: TickLedger,
     for phase, ratios in sorted(by_phase.items()):
         rs = sorted(ratios)
         phases[phase] = rs[len(rs) // 2]
+    now = time.time()
     return {
-        "version": 1,
+        "version": CALIBRATION_SCHEMA_VERSION,
+        "created_at_unix": float(now),
+        "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now)),
         "base": {"predicted_step_s": base_s, "graph_tokens": base_tokens,
                  "pricing_mode": src.get("pricing_mode", "unknown")},
         "meta": {k: v for k, v in ledger.meta.items()

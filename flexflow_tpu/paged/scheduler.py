@@ -66,6 +66,11 @@ from flexflow_tpu import obs
 from flexflow_tpu.paged.pool import EMPTY_HASH, PagePool
 from flexflow_tpu.serving import _GenerationServerBase, _GenRequest
 
+# Packed prefill windows are capped at this many rows — the fp32 sublane
+# tile. Exported so the tick pricer (search/servesearch.py) models the
+# same ceil-to-window padding the scheduler actually launches with.
+PREFILL_WINDOW_ROWS = 8
+
 
 class PagedGenerationServer(_GenerationServerBase):
     """Continuous batching over the block-paged KV cache
@@ -108,7 +113,7 @@ class PagedGenerationServer(_GenerationServerBase):
         # sublane tile and the _bucket floor): chunks larger than it
         # split into pieces, so launch shapes stay within a small
         # (n_items, window<=8) family instead of per-chunk pow2 buckets
-        self._chunk_rows = 8
+        self._chunk_rows = PREFILL_WINDOW_ROWS
         ex = ff.executor
         # one ragged step serves decode AND chunked prefill (and tree
         # verify in the speculative subclass): K/V writes land straight

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from flexflow_tpu.ffconst import OpType, PARALLEL_OP_TYPES
 from flexflow_tpu.parallel.sharding import ShardingView, Spec
@@ -814,3 +814,127 @@ def graph_cost(graph: Graph, strategy: Dict[str, ShardingView],
             )
     time = compute + comm * (1.0 - overlap)
     return GraphCost(time, mem)
+
+
+# ---------------------------------------------------------------------------
+# Serving-tick pricing (search/servesearch.py). The training-side model
+# above prices one train_step; serving strategies are judged on the
+# DECODE TICK instead: how many live rows a launch carries, how much of
+# the launch is padding, how many ticks fuse into one dispatch, and how
+# often the host is paid. The per-token compute rate comes from the same
+# graph pricing (eventsim.step_seconds over the compiled forward), so
+# tick prices inherit every sharding/mesh decision the step price saw.
+
+# Host-side cost of ONE dispatch: argument marshalling, the jitted-call
+# bridge, and the device->host token readback the scheduler blocks on.
+# This is the constant the decode megastep amortizes (N fused ticks pay
+# it once); `fftrace calibrate` scale factors absorb the machine-specific
+# truth on top of this default.
+HOST_DISPATCH_SECONDS = 5e-5
+
+
+@dataclasses.dataclass
+class TickPricer:
+    """Prices one serving-tick dispatch from a calibrated per-token rate.
+
+    base_step_s / base_tokens: priced seconds and token count of ONE full
+      forward step of the compiled graph (eventsim.step_seconds +
+      obs.calibrate.graph_tokens) — their ratio is the marginal
+      per-token-row compute rate every tick shape scales from.
+    host_dispatch_s: per-dispatch host cost (see HOST_DISPATCH_SECONDS).
+    pad_row_cost: relative cost of a padded launch row vs a live one.
+      Padded rows skip attention reads (q_len 0) but still ride the
+      dense projections, so they are discounted, not free.
+    tick_scale: optional (phase, batch, chunk, width) -> float hook,
+      wired to MeasuredCostModel.tick_scale when an `fftrace calibrate`
+      report is loaded — measured wall-time truth multiplies the
+      analytic price per tick shape.
+    """
+
+    base_step_s: float
+    base_tokens: int
+    host_dispatch_s: float = HOST_DISPATCH_SECONDS
+    pad_row_cost: float = 0.5
+    tick_scale: Optional[Callable[[str, int, int, int], float]] = None
+
+    @property
+    def token_seconds(self) -> float:
+        return self.base_step_s / max(int(self.base_tokens), 1)
+
+    def _scale(self, phase: str, batch: float, chunk: int = 0,
+               width: float = 1) -> float:
+        if self.tick_scale is None:
+            return 1.0
+        return float(self.tick_scale(phase, max(int(round(batch)), 1),
+                                     int(chunk), max(int(round(width)), 1)))
+
+    def decode_dispatch(self, live_rows: float, padded_rows: float = 0.0,
+                        megastep: float = 1.0) -> float:
+        """Seconds for ONE decode dispatch fusing `megastep` ticks over a
+        launch of live_rows + padded_rows. Compute scales with rows and
+        fused ticks; the host is paid once per DISPATCH — which is the
+        whole megastep story: N fused ticks amortize host_dispatch_s to
+        host_dispatch_s / N per tick."""
+        rows = max(live_rows, 0.0) + max(padded_rows, 0.0) * self.pad_row_cost
+        comp = (self.token_seconds * max(rows, 1.0) * max(megastep, 1.0)
+                * self._scale("decode", live_rows, width=megastep))
+        return comp + self.host_dispatch_s
+
+    def verify_dispatch(self, live_rows: float, tree_nodes: int,
+                        padded_rows: float = 0.0) -> float:
+        """Seconds for one speculative verify dispatch: every live slot
+        scores its whole padded token tree (`tree_nodes` rows, the
+        SpecConfig.max_nodes launch shape), idle slots pad at tree
+        width."""
+        nodes = max(int(tree_nodes), 1)
+        rows = (max(live_rows, 0.0)
+                + max(padded_rows, 0.0) * self.pad_row_cost) * nodes
+        comp = (self.token_seconds * max(rows, 1.0)
+                * self._scale("verify", live_rows, width=nodes))
+        return comp + self.host_dispatch_s
+
+    def prefill_tick(self, chunk_tokens: int, padded_rows: float = 0.0,
+                     batch: int = 1) -> float:
+        """Seconds for one chunked-prefill launch: `chunk_tokens` live
+        rows plus the ceil-to-window padding the packed scheduler
+        launches with (paged.scheduler.PREFILL_WINDOW_ROWS pieces, or
+        the legacy pow2 bucket when ragged_pack=False)."""
+        rows = max(int(chunk_tokens), 1) + max(padded_rows, 0.0) * self.pad_row_cost
+        comp = (self.token_seconds * rows
+                * self._scale("prefill", batch, chunk=int(chunk_tokens)))
+        return comp + self.host_dispatch_s
+
+
+def kv_cache_token_bytes(graph: Graph,
+                         strategy: Optional[Dict[str, ShardingView]] = None,
+                         axis_sizes: Optional[Dict[str, int]] = None) -> int:
+    """Per-chip K/V-cache bytes ONE token row occupies across all
+    attention layers: 2 (K and V) x num_kv x head_dim x dtype bytes per
+    layer, divided by the head-parallel degree the strategy shards wk/wv
+    over. This is what prices the paged pool against the HBM budget in
+    the serving-strategy search: pool_pages x page_size x this = resident
+    cache bytes (the hlo-hbm-budget counterpart for serving state)."""
+    total = 0
+    for node in graph.nodes:
+        attrs = node.attrs
+        if node.op_type in (OpType.MULTIHEAD_ATTENTION,
+                            OpType.RING_ATTENTION) \
+                and attrs is not None and hasattr(attrs, "num_kv"):
+            row = 2 * int(attrs.num_kv) * int(attrs.kdim)
+        elif node.op_type == OpType.PIPELINE and attrs is not None \
+                and hasattr(attrs, "kv_heads"):
+            # stacked decoder blocks: `layers` caches behind one node
+            embed = int(node.outputs[0].dims[-1])
+            head_dim = embed // max(int(attrs.heads), 1)
+            row = 2 * int(attrs.kv_heads) * head_dim * int(attrs.layers)
+        else:
+            continue
+        row *= node.outputs[0].dtype.size_bytes
+        deg = 1
+        if strategy is not None and axis_sizes:
+            view = strategy.get(node.name, node.sharding)
+            if view is not None:
+                deg = max(spec_degree(view.weight_specs.get("wk"),
+                                      axis_sizes), 1)
+        total += -(-row // deg)
+    return total

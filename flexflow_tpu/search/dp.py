@@ -259,12 +259,13 @@ def greedy_polish(graph: Graph, strategy: Dict[str, ShardingView],
     single-flip climber stalls at the resharding barrier between them.
     Runs on a StrategyTable, so each move is a cheap table sum instead of a
     full graph_cost walk (the reference polishes inside the annealing loop
-    against its cached measurements, model.cc:3317). Callers that already
-    priced a table over the same candidate set (mcmc_optimize) pass it in
-    via `table`/`start` to avoid re-pricing every (node, view) pair;
-    `memory_limit`/`objective` keep the polish honoring the same constraint
-    the search enforced."""
-    from flexflow_tpu.search.table import build_table
+    against its cached measurements, model.cc:3317) — the sweep itself is
+    search.table.coordinate_descent, shared with the serving-strategy
+    search's knob polish. Callers that already priced a table over the
+    same candidate set (mcmc_optimize) pass it in via `table`/`start` to
+    avoid re-pricing every (node, view) pair; `memory_limit`/`objective`
+    keep the polish honoring the same constraint the search enforced."""
+    from flexflow_tpu.search.table import build_table, coordinate_descent
 
     if table is None:
         candidates = {}
@@ -286,39 +287,7 @@ def greedy_polish(graph: Graph, strategy: Dict[str, ShardingView],
             t += 1e3 * (m / memory_limit)
         return t
 
-    cur = ev(assign)
-    searchable = set(table.searchable())
-    for _ in range(sweeps):
-        improved = False
-        for i in sorted(searchable):
-            best_k, best_c = assign[i], cur
-            for k in range(len(table.views[i])):
-                if k == assign[i]:
-                    continue
-                assign[i] = k
-                c = ev(assign)
-                if c < best_c - 1e-15:
-                    best_k, best_c = k, c
-            assign[i] = best_k
-            if best_c < cur - 1e-15:
-                cur, improved = best_c, True
-        for src, dst, _ in table.edges:
-            if src not in searchable or dst not in searchable:
-                continue
-            best_pair, best_c = (assign[src], assign[dst]), cur
-            for ks in range(len(table.views[src])):
-                for kd in range(len(table.views[dst])):
-                    if (ks, kd) == (assign[src], assign[dst]):
-                        continue
-                    assign[src], assign[dst] = ks, kd
-                    c = ev(assign)
-                    if c < best_c - 1e-15:
-                        best_pair, best_c = (ks, kd), c
-            assign[src], assign[dst] = best_pair
-            if best_c < cur - 1e-15:
-                cur, improved = best_c, True
-        if not improved:
-            break
+    coordinate_descent(table, assign, ev, sweeps=sweeps)
     s = dict(strategy)
     s.update(table.to_strategy(assign))
     return s, graph_cost(graph, s, cost, training).time

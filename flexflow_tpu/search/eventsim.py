@@ -53,6 +53,7 @@ from flexflow_tpu.ffconst import OpType
 from flexflow_tpu.pcg.graph import Graph
 from flexflow_tpu.search.cost_model import (
     CostModel,
+    graph_cost,
     is_pipe_sharded,
     pipeline_compute_factor,
     spec_degree,
@@ -349,6 +350,26 @@ def simulate_graph(graph: Graph, strategy: Dict, cost: CostModel,
         info["tasks"] = len(b.channels)
         info["channels"] = b.n_channels
     return out
+
+
+def step_seconds(graph: Graph, strategy: Dict, cost: CostModel,
+                 training: bool = False,
+                 info: Optional[Dict] = None) -> tuple:
+    """Priced seconds of one step under `strategy`: the per-device event
+    simulator when the native engine is available, the serial graph_cost
+    sum otherwise. Returns (seconds, mode) so callers — the tick
+    calibrator (obs/calibrate.py) and the serving-strategy search
+    (search/servesearch.py) — can stamp which pricing path produced the
+    number they are about to scale."""
+    inf: Dict = {} if info is None else info
+    t = simulate_graph(graph, strategy, cost, training=training, info=inf)
+    mode = inf.get("mode", "eventsim")
+    if t is None:
+        t = graph_cost(graph, strategy, cost, training=training).time
+        mode = f"graph_cost (eventsim: {mode})"
+    if info is not None:
+        info["mode_resolved"] = mode
+    return float(t), mode
 
 
 def _seq_degree(node, view, cost: CostModel) -> int:

@@ -23,6 +23,46 @@ from flexflow_tpu.search.cost_model import CostModel
 from flexflow_tpu.search.table import build_table
 
 
+def anneal_assignment(table, start, evaluate, *, budget: int = 200,
+                      alpha: float = 0.05, seed: int = 0,
+                      verbose: bool = False):
+    """The annealing loop itself, over any StrategyTable-shaped search
+    space (anything with `views` and `searchable()`) and any `evaluate`
+    callable over assignments — the reference's accept rule
+    (model.cc:3285-3356) verbatim: improving moves always, worsening
+    moves with prob exp(-alpha * relative diff * 100). Returns
+    (best_assignment, best_cost). Shared by the sharding search fallback
+    below and the serving-strategy search (search/servesearch.py), whose
+    knob table evaluates an SLO objective instead of the summed cost
+    tables — one driver, two objectives."""
+    rng = random.Random(seed)
+    searchable = table.searchable()
+    cur = list(start)
+    cur_cost = evaluate(cur)
+    best, best_cost = list(cur), cur_cost
+    if not searchable:
+        return best, best_cost
+    for it in range(budget):
+        i = rng.choice(searchable)
+        k = rng.randrange(len(table.views[i]))
+        if k == cur[i]:
+            continue
+        prev = cur[i]
+        cur[i] = k
+        nxt_cost = evaluate(cur)
+        diff = nxt_cost - cur_cost
+        if diff < 0 or rng.random() < math.exp(
+                -alpha * diff / max(cur_cost, 1e-12) * 100):
+            cur_cost = nxt_cost
+            if cur_cost < best_cost:
+                best, best_cost = list(cur), cur_cost
+                if verbose:
+                    print(f"mcmc iter {it}: best {best_cost * 1e3:.3f} ms")
+        else:
+            cur[i] = prev
+    return best, best_cost
+
+
 def mcmc_optimize(
     graph: Graph,
     cost: CostModel,
@@ -80,9 +120,6 @@ def mcmc_optimize(
         return strategy
 
     # ---- pure-Python fallback over the same tables --------------------
-    rng = random.Random(seed)
-    searchable = table.searchable()
-
     if use_simulate:
         raise NotImplementedError(
             "use_simulate requires the native engine (libffsim failed to "
@@ -96,26 +133,8 @@ def mcmc_optimize(
             t += 1e3 * (mem / memory_limit)
         return t
 
-    cur = list(start)
-    cur_cost = evaluate(cur)
-    best, best_cost = list(cur), cur_cost
-    for it in range(budget):
-        i = rng.choice(searchable)
-        k = rng.randrange(len(table.views[i]))
-        if k == cur[i]:
-            continue
-        prev = cur[i]
-        cur[i] = k
-        nxt_cost = evaluate(cur)
-        diff = nxt_cost - cur_cost
-        if diff < 0 or rng.random() < math.exp(-alpha * diff / max(cur_cost, 1e-12) * 100):
-            cur_cost = nxt_cost
-            if cur_cost < best_cost:
-                best, best_cost = list(cur), cur_cost
-                if verbose:
-                    print(f"mcmc iter {it}: best {best_cost * 1e3:.3f} ms")
-        else:
-            cur[i] = prev
+    best, _ = anneal_assignment(table, start, evaluate, budget=budget,
+                                alpha=alpha, seed=seed, verbose=verbose)
     strategy = table.to_strategy(best)
     if polish:
         from flexflow_tpu.search.dp import greedy_polish

@@ -67,6 +67,52 @@ class StrategyTable:
         return g
 
 
+def coordinate_descent(table, assign, ev, *, sweeps: int = 4,
+                       pairs: bool = True) -> float:
+    """Greedy hill-climb over `assign` IN PLACE: per-sweep, try every
+    alternative view at every searchable index (plus joint flips of edge
+    endpoints when `pairs`), keep strict improvements, stop when a sweep
+    finds none. `ev(assignment) -> float` is whatever objective the
+    caller optimizes — the summed cost tables for the sharding polish
+    (search/dp.py greedy_polish), the SLO objective for the serving knob
+    table (search/servesearch.py). Returns the final cost."""
+    cur = ev(assign)
+    searchable = set(table.searchable())
+    for _ in range(sweeps):
+        improved = False
+        for i in sorted(searchable):
+            best_k, best_c = assign[i], cur
+            for k in range(len(table.views[i])):
+                if k == assign[i]:
+                    continue
+                assign[i] = k
+                c = ev(assign)
+                if c < best_c - 1e-15:
+                    best_k, best_c = k, c
+            assign[i] = best_k
+            if best_c < cur - 1e-15:
+                cur, improved = best_c, True
+        if pairs:
+            for src, dst, _ in table.edges:
+                if src not in searchable or dst not in searchable:
+                    continue
+                best_pair, best_c = (assign[src], assign[dst]), cur
+                for ks in range(len(table.views[src])):
+                    for kd in range(len(table.views[dst])):
+                        if (ks, kd) == (assign[src], assign[dst]):
+                            continue
+                        assign[src], assign[dst] = ks, kd
+                        c = ev(assign)
+                        if c < best_c - 1e-15:
+                            best_pair, best_c = (ks, kd), c
+                assign[src], assign[dst] = best_pair
+                if best_c < cur - 1e-15:
+                    cur, improved = best_c, True
+        if not improved:
+            break
+    return cur
+
+
 def simulated_strategy_cost(graph: Graph, cost: CostModel,
                             strategy: Dict[str, ShardingView],
                             training: bool = True) -> Optional[float]:

@@ -1,0 +1,189 @@
+"""Named traffic profiles: the prompt-length / prefix-share / arrival
+shapes serving strategies are judged against.
+
+A serving strategy is only better or worse *for a workload*: chunked
+prefill pays off on long prompts, the prefix cache on shared system
+prompts, megasteps on decode-heavy streams. This module gives those
+workloads names, so the serving-strategy search (search/servesearch.py)
+and the decode bench (`bench.py --decode`) score strategies against the
+SAME fixtures — the bench's shared-system-prompt and mixed-length
+fixtures live here as `shared-system-prompt` and `mixed-length` instead
+of inline ad-hoc draws.
+
+Each profile is both ANALYTIC and SAMPLEABLE: `prompt_stats()` feeds
+the search's closed-form tick pricing (mean/p95 prompt length, steady-
+state prefix-share rate), `sample(rs, vocab)` draws the concrete
+prompts a real server serves, deterministic in the caller's
+RandomState.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficSample:
+    """One concrete draw of a profile: ready-to-submit prompts plus the
+    shared prefix they open with (None when the profile has none)."""
+
+    prompts: List[np.ndarray]
+    shared_prefix: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficProfile:
+    """One named workload.
+
+    suffix_lens: per-request suffix-length ranges, `[lo, hi)` for
+      np.random.randint, CYCLED by request index — ((4, 10), (25, 29))
+      alternates short and long prompts, the mixed-length fixture shape.
+    shared_prefix_tokens: length of the system prompt every request
+      opens with (0 = none); drawn once per sample, prepended to every
+      suffix — the prefix cache serves it for the 2nd+ request.
+    new_tokens: decode tokens requested per request.
+    requests: fixture size — how many prompts one sample draws.
+    offered_concurrency: requests in flight at once in steady state (the
+      arrival intensity the analytic pricing fills decode launches
+      with); the realized bench submits all `requests` and lets slot
+      admission impose it.
+    """
+
+    name: str
+    description: str
+    suffix_lens: Tuple[Tuple[int, int], ...] = ((4, 17),)
+    shared_prefix_tokens: int = 0
+    new_tokens: int = 16
+    requests: int = 6
+    offered_concurrency: int = 4
+
+    def __post_init__(self):
+        if not self.suffix_lens:
+            raise ValueError("suffix_lens must have at least one range")
+        for lo, hi in self.suffix_lens:
+            if not (0 < lo < hi):
+                raise ValueError(f"bad suffix range [{lo}, {hi})")
+
+    # -- sampling (the bench / CI path) ---------------------------------
+
+    def sample(self, rs: np.random.RandomState, vocab: int,
+               requests: Optional[int] = None) -> TrafficSample:
+        """Draw the fixture: the shared prefix first (when any), then per
+        request its suffix length, then its tokens — the draw order the
+        decode bench has always used, so seeded fixtures stay stable."""
+        n = self.requests if requests is None else int(requests)
+        prefix = None
+        if self.shared_prefix_tokens:
+            prefix = rs.randint(0, vocab, (self.shared_prefix_tokens,)) \
+                .astype(np.int32)
+        prompts = []
+        for i in range(n):
+            lo, hi = self.suffix_lens[i % len(self.suffix_lens)]
+            suffix = rs.randint(0, vocab, (rs.randint(lo, hi),)) \
+                .astype(np.int32)
+            prompts.append(suffix if prefix is None
+                           else np.concatenate([prefix, suffix]))
+        return TrafficSample(prompts=prompts, shared_prefix=prefix)
+
+    # -- closed form (the search path) ----------------------------------
+
+    def prompt_stats(self) -> Dict[str, float]:
+        """Analytic moments of the prompt distribution:
+        mean/p95 total prompt tokens, and the steady-state
+        prefix_share_rate — the fraction of prompt tokens the prefix
+        cache serves once the shared prefix is resident (the first
+        request computes it, the other n-1 share it)."""
+        seg_means = [(lo + hi - 1) / 2.0 for lo, hi in self.suffix_lens]
+        mean_suffix = sum(seg_means) / len(seg_means)
+        p95_suffix = float(max(hi - 1 for _, hi in self.suffix_lens))
+        pre = float(self.shared_prefix_tokens)
+        n = max(self.requests, 1)
+        share = 0.0
+        if pre > 0:
+            share = pre / (pre + mean_suffix) * (n - 1) / n
+        return {
+            "mean_prompt_tokens": pre + mean_suffix,
+            "p95_prompt_tokens": pre + p95_suffix,
+            "prefix_share_rate": share,
+            "new_tokens": float(self.new_tokens),
+            "offered_concurrency": float(self.offered_concurrency),
+        }
+
+
+# ---------------------------------------------------------------------------
+# The named profiles. Factories (not constants) because the interesting
+# lengths scale with serving config — the system prompt spans two pages,
+# the long mixed prompts need >= 2 prefill chunks — exactly as the bench
+# fixtures always computed them.
+
+
+def smoke_profile(requests: int = 6, new_tokens: int = 16,
+                  offered_concurrency: int = 4) -> TrafficProfile:
+    """Uniform short prompts — the plain decode fixture."""
+    return TrafficProfile(
+        name="smoke",
+        description="uniform short prompts (4..16 tokens), decode-heavy",
+        suffix_lens=((4, 17),),
+        new_tokens=new_tokens, requests=requests,
+        offered_concurrency=offered_concurrency)
+
+
+def shared_system_prompt_profile(page_size: int = 8, requests: int = 6,
+                                 new_tokens: int = 16,
+                                 offered_concurrency: int = 4
+                                 ) -> TrafficProfile:
+    """Every request opens with the same two-page system prompt; short
+    user turns follow. The prefix cache serves the bulk of 2nd+ prefill
+    (the bench's ISSUE-5 fixture)."""
+    sys_len = 2 * int(page_size)
+    return TrafficProfile(
+        name="shared-system-prompt",
+        description=(f"{sys_len}-token shared system prompt + "
+                     "4..16-token user turns"),
+        suffix_lens=((4, 17),),
+        shared_prefix_tokens=sys_len,
+        new_tokens=new_tokens, requests=requests,
+        offered_concurrency=offered_concurrency)
+
+
+def mixed_length_profile(page_size: int = 8,
+                         prefill_chunk: Optional[int] = None,
+                         requests: int = 6, new_tokens: int = 16,
+                         offered_concurrency: int = 4) -> TrafficProfile:
+    """Alternating short prompts (decode almost immediately) and long
+    prompts needing >= 2 prefill chunks — the ragged-packing A/B fixture
+    (ISSUE 10). `prefill_chunk` defaults to 3 pages, the bench's
+    chunking."""
+    chunk = 3 * int(page_size) if prefill_chunk is None else int(prefill_chunk)
+    return TrafficProfile(
+        name="mixed-length",
+        description=(f"alternating 4..9-token and {chunk}+1..{chunk}+4-"
+                     f"token prompts, chunked at {chunk}"),
+        suffix_lens=((4, 10), (chunk + 1, chunk + 5)),
+        new_tokens=new_tokens, requests=requests,
+        offered_concurrency=offered_concurrency)
+
+
+PROFILES = {
+    "smoke": smoke_profile,
+    "shared-system-prompt": shared_system_prompt_profile,
+    "mixed-length": mixed_length_profile,
+}
+
+
+def get_profile(name, **overrides) -> TrafficProfile:
+    """Resolve a profile by name (with factory kwargs), or pass a
+    TrafficProfile through (optionally re-parameterized via
+    dataclasses.replace on field names)."""
+    if isinstance(name, TrafficProfile):
+        return dataclasses.replace(name, **overrides) if overrides else name
+    try:
+        factory = PROFILES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown traffic profile {name!r} (have {sorted(PROFILES)})"
+        ) from None
+    return factory(**overrides)

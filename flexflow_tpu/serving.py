@@ -869,8 +869,10 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                      speculate=None,
                      ragged_pack: bool = True,
                      megastep_ticks: int = 1,
-                     request_record_limit: Optional[int] = None
-                     ) -> "_GenerationServerBase":
+                     request_record_limit: Optional[int] = None,
+                     serve_strategy=None,
+                     search_budget: Optional[int] = None,
+                     traffic="smoke") -> "_GenerationServerBase":
     """Continuous-batching generation endpoint over a compiled causal-LM
     FFModel (KV-cache decode path required — see FFModel.generate).
 
@@ -920,7 +922,42 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
     `request_record_limit` bounds how many completed requests keep their
     per-request metric record (default _GenerationServerBase
     .MAX_REQUEST_RECORDS); cumulative counters and histograms are
-    unaffected."""
+    unaffected.
+
+    `search_budget=N` runs the serving-strategy search
+    (flexflow_tpu.search.servesearch, docs/search.md) for N anneal
+    iterations against the `traffic` profile (a name from
+    search/traffic.py or a TrafficProfile) and serves the winning
+    strategy; `serve_strategy` applies a known ServeStrategy (or its
+    to_json() dict, e.g. from `tools/servesearch.py search`) directly.
+    Either overrides the paged/page_size/prefill_chunk/ragged_pack/
+    megastep_ticks/num_pages/speculate knobs wholesale — passing an
+    explicit `speculate` alongside is an error, the strategy already
+    decides speculation."""
+    if search_budget is not None and serve_strategy is None:
+        from flexflow_tpu.search.servesearch import search_serve_strategy
+
+        serve_strategy = search_serve_strategy(
+            ff, traffic=traffic, budget=int(search_budget), slots=slots,
+            max_len=max_len).best
+    if serve_strategy is not None:
+        from flexflow_tpu.search.servesearch import ServeStrategy
+
+        if isinstance(serve_strategy, dict):
+            serve_strategy = ServeStrategy.from_json(serve_strategy)
+        if speculate is not None:
+            raise ValueError(
+                "serve_strategy already decides speculation — drop the "
+                "explicit speculate= argument")
+        kw = serve_strategy.to_server_kwargs(slots, max_len)
+        paged = True
+        page_size = kw["page_size"]
+        prefill_chunk = kw["prefill_chunk"]
+        ragged_pack = kw["ragged_pack"]
+        megastep_ticks = kw["megastep_ticks"]
+        speculate = kw["speculate"]
+        if kw["num_pages"] is not None:
+            num_pages = kw["num_pages"]
     megastep_ticks = int(megastep_ticks)
     if megastep_ticks < 1:
         raise ValueError(

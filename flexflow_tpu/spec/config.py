@@ -54,6 +54,25 @@ class SpecConfig:
         width x depth drafted nodes."""
         return 1 + self.width * self.depth
 
+    def expected_tokens_per_step(self, accept_rate: float) -> float:
+        """Expected tokens COMMITTED per verify step when each drafted
+        token independently matches the verifier with prob `accept_rate`.
+        Depth level i survives iff some branch covers it (prob
+        a_w = 1 - (1-a)^width) and its i-1 ancestors matched, so
+
+            E = 1 + sum_{i=1..depth} a_w * a^(i-1)
+
+        (the leading 1 is the verifier's bonus token — every step emits at
+        least one). Monotone in width and depth, saturating at
+        1 + a_w/(1-a): the marginal drafted node buys less the deeper the
+        tree, which is exactly the trade the serving-strategy search
+        (search/servesearch.py) prices against verify-launch cost."""
+        a = min(max(float(accept_rate), 0.0), 1.0)
+        if a >= 1.0:
+            return 1.0 + float(self.depth)
+        a_w = 1.0 - (1.0 - a) ** self.width
+        return 1.0 + a_w * sum(a ** (i - 1) for i in range(1, self.depth + 1))
+
     def build_drafter(self):
         from flexflow_tpu.spec.drafter import (
             DraftModelDrafter,

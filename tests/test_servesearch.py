@@ -1,0 +1,413 @@
+"""Serving-strategy search (search/servesearch.py + search/traffic.py +
+the tick pricing in search/cost_model.py).
+
+Contracts under test: the tick pricer is monotone in the things that
+cost real time (launch rows, padding, spec tree size, prefill chunk) and
+amortizes the host exactly once per megastep dispatch; the search REUSES
+the existing anneal/DP drivers, is deterministic under a fixed seed, and
+strictly beats the hand default on the named traffic profiles; fftrace
+calibration reports are consumed when fresh (changing the priced
+metrics) and refused when stale or unstamped; and a searched strategy is
+SERVABLE — serve_generation(serve_strategy=...) emits tokens identical
+to dense generate.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+from flexflow_tpu.ffconst import DataType
+from flexflow_tpu.models.llama import LlamaConfig, build_llama
+from flexflow_tpu.search import traffic as traffic_mod
+from flexflow_tpu.search.cost_model import (
+    HOST_DISPATCH_SECONDS,
+    CostModel,
+    TickPricer,
+    kv_cache_token_bytes,
+)
+from flexflow_tpu.search.machine_model import TPUMachineModel
+from flexflow_tpu.search.servesearch import (
+    ServeObjective,
+    ServeSearchResult,
+    ServeStrategy,
+    load_calibration,
+    search_serve_strategy,
+)
+from flexflow_tpu.spec import SpecConfig
+
+
+# ---------------------------------------------------------------------------
+# tick pricing
+
+
+def _pricer(**kw):
+    return TickPricer(base_step_s=1e-3, base_tokens=256, **kw)
+
+
+def test_decode_dispatch_monotone_in_live_rows():
+    p = _pricer()
+    costs = [p.decode_dispatch(r) for r in (1, 2, 4, 8, 16)]
+    assert costs == sorted(costs)
+    assert costs[0] < costs[-1]
+
+
+def test_decode_dispatch_padding_costs_less_than_live():
+    p = _pricer()
+    base = p.decode_dispatch(4)
+    padded = p.decode_dispatch(4, padded_rows=4)
+    live = p.decode_dispatch(8)
+    assert base < padded < live  # padded rows cost, but under full price
+
+
+def test_verify_dispatch_monotone_in_tree_nodes():
+    p = _pricer()
+    costs = [p.verify_dispatch(4, nodes) for nodes in (1, 3, 9, 15)]
+    assert costs == sorted(costs)
+    assert costs[0] < costs[-1]
+
+
+def test_prefill_tick_monotone_in_chunk():
+    p = _pricer()
+    costs = [p.prefill_tick(c) for c in (16, 32, 64, 128)]
+    assert costs == sorted(costs)
+    assert costs[0] < costs[-1]
+
+
+def test_megastep_amortizes_host_dispatch():
+    """N fused ticks pay the host ONCE: price(N=8) must beat 8 separate
+    one-tick dispatches by exactly the 7 saved host roundtrips."""
+    p = _pricer()
+    one = p.decode_dispatch(4, megastep=1)
+    fused = p.decode_dispatch(4, megastep=8)
+    assert fused < 8 * one
+    assert 8 * one - fused == pytest.approx(7 * p.host_dispatch_s)
+
+
+def test_tick_scale_multiplies_compute_only():
+    plain = _pricer()
+    seen = []
+
+    def scale(phase, batch, chunk, width):
+        seen.append((phase, batch, chunk, width))
+        return 2.0
+
+    scaled = _pricer(tick_scale=scale)
+    for kind in ("decode", "verify", "prefill"):
+        if kind == "decode":
+            a, b = plain.decode_dispatch(4), scaled.decode_dispatch(4)
+        elif kind == "verify":
+            a, b = plain.verify_dispatch(4, 7), scaled.verify_dispatch(4, 7)
+        else:
+            a, b = plain.prefill_tick(32), scaled.prefill_tick(32)
+        assert b - HOST_DISPATCH_SECONDS == pytest.approx(
+            2.0 * (a - HOST_DISPATCH_SECONDS))
+    assert {s[0] for s in seen} == {"decode", "verify", "prefill"}
+
+
+def test_expected_tokens_per_step_bounds():
+    spec = SpecConfig(width=2, depth=4)
+    assert spec.expected_tokens_per_step(0.0) == pytest.approx(1.0)
+    assert spec.expected_tokens_per_step(1.0) == pytest.approx(5.0)
+    mid = spec.expected_tokens_per_step(0.6)
+    assert 1.0 < mid < 5.0
+    # monotone in acceptance
+    vals = [spec.expected_tokens_per_step(a)
+            for a in (0.1, 0.3, 0.5, 0.7, 0.9)]
+    assert vals == sorted(vals)
+
+
+# ---------------------------------------------------------------------------
+# graph-level pieces (no compile: shape-inferred graph + cost model)
+
+
+def _graph():
+    ff = FFModel(FFConfig(batch_size=4, num_devices=1))
+    build_llama(ff, LlamaConfig.tiny(vocab=512), batch_size=4, seq_len=64,
+                dtype=DataType.FLOAT)
+    ff.graph.infer_shapes()
+    return ff.graph
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return _graph()
+
+
+def _cost(axes=None):
+    return CostModel(TPUMachineModel.make("v5e", 8),
+                     axes or {"data": 2, "model": 4})
+
+
+def test_kv_cache_token_bytes_positive(graph):
+    b = kv_cache_token_bytes(graph)
+    assert isinstance(b, int) and b > 0
+    # K and V, float32, at least one layer's worth of kv heads
+    assert b % 2 == 0
+
+
+# ---------------------------------------------------------------------------
+# ServeStrategy surface
+
+
+def test_strategy_validate_rejects_spec_plus_megastep():
+    s = ServeStrategy(spec_width=2, spec_depth=2, megastep_ticks=8)
+    with pytest.raises(ValueError):
+        s.validate()
+
+
+def test_strategy_validate_rejects_page_over_max_len():
+    with pytest.raises(ValueError):
+        ServeStrategy(page_size=128).validate(max_len=64)
+
+
+def test_strategy_json_roundtrip():
+    s = ServeStrategy(page_size=16, prefill_chunk=32, spec_width=2,
+                      spec_depth=3, ragged_pack=False, pool_fraction=0.5,
+                      mesh=(("data", 2), ("model", 4)))
+    assert ServeStrategy.from_json(s.to_json()) == s
+    assert ServeStrategy.from_json(json.loads(json.dumps(s.to_json()))) == s
+
+
+# ---------------------------------------------------------------------------
+# traffic profiles
+
+
+def test_profiles_registry():
+    assert set(traffic_mod.PROFILES) == {
+        "smoke", "shared-system-prompt", "mixed-length"}
+    with pytest.raises(KeyError):
+        traffic_mod.get_profile("nope")
+
+
+def test_sample_deterministic_and_prefixed():
+    prof = traffic_mod.get_profile("shared-system-prompt", page_size=8,
+                                   requests=5)
+    a = prof.sample(np.random.RandomState(0), vocab=128)
+    b = prof.sample(np.random.RandomState(0), vocab=128)
+    assert len(a.prompts) == 5
+    assert a.shared_prefix is not None and len(a.shared_prefix) == 16
+    for pa, pb in zip(a.prompts, b.prompts):
+        np.testing.assert_array_equal(pa, pb)
+        np.testing.assert_array_equal(pa[:16], a.shared_prefix)
+        assert pa.dtype == np.int32
+
+
+def test_prompt_stats_prefix_share():
+    prof = traffic_mod.get_profile("shared-system-prompt", page_size=8,
+                                   requests=6)
+    st = prof.prompt_stats()
+    assert st["mean_prompt_tokens"] == pytest.approx(16 + 10.0)
+    assert st["p95_prompt_tokens"] == 16 + 16
+    assert 0.0 < st["prefix_share_rate"] < 1.0
+    assert traffic_mod.get_profile("smoke").prompt_stats()[
+        "prefix_share_rate"] == 0.0
+
+
+def test_mixed_profile_alternates_ranges():
+    prof = traffic_mod.get_profile("mixed-length", page_size=8, requests=6)
+    s = prof.sample(np.random.RandomState(0), vocab=128)
+    for i, p in enumerate(s.prompts):
+        if i % 2 == 0:
+            assert 4 <= len(p) <= 9
+        else:
+            assert 25 <= len(p) <= 28  # chunk=24, +1..+4
+
+
+def test_get_profile_passthrough_and_replace():
+    prof = traffic_mod.smoke_profile(requests=3)
+    assert traffic_mod.get_profile(prof) is prof
+    assert traffic_mod.get_profile(prof, requests=9).requests == 9
+
+
+# ---------------------------------------------------------------------------
+# calibration freshness
+
+
+def _report(age_s=0.0, stamped=True):
+    now = 1_700_000_000.0
+    rep = {"version": 2, "tick_scales": {}, "phases": {"decode": 1.5}}
+    if stamped:
+        rep["created_at_unix"] = now - age_s
+        rep["created_at"] = "stamped"
+    return rep, now
+
+
+def test_load_calibration_fresh_accepted():
+    rep, now = _report(age_s=3600.0)
+    assert load_calibration(rep, now=now) is rep
+
+
+def test_load_calibration_stale_refused():
+    rep, now = _report(age_s=8 * 86400.0)
+    assert load_calibration(rep, now=now) is None
+
+
+def test_load_calibration_unstamped_refused():
+    rep, now = _report(stamped=False)
+    assert load_calibration(rep, now=now) is None
+
+
+def test_load_calibration_max_age_override():
+    rep, now = _report(age_s=8 * 86400.0)
+    assert load_calibration(rep, max_age_s=30 * 86400.0, now=now) is rep
+
+
+def test_calibration_report_schema_stamp():
+    from flexflow_tpu.obs.calibrate import CALIBRATION_SCHEMA_VERSION
+
+    assert CALIBRATION_SCHEMA_VERSION == 2
+
+
+# ---------------------------------------------------------------------------
+# the search itself (graph + cost — no compile, so it is fast)
+
+
+@pytest.mark.parametrize("profile", ["smoke", "shared-system-prompt",
+                                     "mixed-length"])
+def test_search_beats_default(graph, profile):
+    """The ISSUE-12 acceptance bar: on every named traffic profile the
+    searched strategy must be STRICTLY better than the hand default on
+    the simulated SLO objective."""
+    res = search_serve_strategy(graph=graph, cost=_cost(), traffic=profile,
+                                budget=120, seed=0, slots=4, max_len=128)
+    assert res.best_objective < res.default_objective
+    assert res.improvement > 0.0
+    res.best.validate(max_len=128)
+
+
+def test_search_deterministic_under_fixed_seed(graph):
+    a = search_serve_strategy(graph=graph, cost=_cost(), traffic="smoke",
+                              budget=80, seed=3, slots=4, max_len=128)
+    b = search_serve_strategy(graph=graph, cost=_cost(), traffic="smoke",
+                              budget=80, seed=3, slots=4, max_len=128)
+    assert a.best == b.best
+    assert a.best_objective == b.best_objective
+    assert a.trials == b.trials
+
+
+def test_search_consumes_calibration(graph):
+    """A fresh report's scale factors must actually move the priced
+    metrics: with decode 50x slower than analytic, the same default
+    strategy prices at a worse objective and the result records the
+    provenance."""
+    rep = {"version": 2, "created_at_unix": time.time(),
+           "created_at": "now", "tick_scales": {},
+           "phases": {"decode": 50.0}}
+    plain = search_serve_strategy(graph=graph, cost=_cost(),
+                                  traffic="smoke", budget=40, seed=0,
+                                  slots=4, max_len=128)
+    cal = search_serve_strategy(graph=graph, cost=_cost(), traffic="smoke",
+                                budget=40, seed=0, slots=4, max_len=128,
+                                calibration=rep)
+    assert cal.calibration == {"used": True, "version": 2,
+                               "created_at": "now", "shapes": 0}
+    assert cal.default_objective > plain.default_objective
+
+
+def test_search_refuses_stale_calibration(graph):
+    rep = {"version": 2, "created_at_unix": time.time() - 30 * 86400,
+           "created_at": "a month ago", "tick_scales": {},
+           "phases": {"decode": 50.0}}
+    res = search_serve_strategy(graph=graph, cost=_cost(), traffic="smoke",
+                                budget=40, seed=0, slots=4, max_len=128,
+                                calibration=rep)
+    assert res.calibration == {"used": False,
+                               "reason": "stale-or-unstamped"}
+    plain = search_serve_strategy(graph=graph, cost=_cost(),
+                                  traffic="smoke", budget=40, seed=0,
+                                  slots=4, max_len=128)
+    assert res.default_objective == plain.default_objective
+
+
+def test_hbm_budget_steers_search(graph):
+    """With a tight HBM budget the penalty term must push the winner's
+    resident bytes to no more than the default's."""
+    loose = search_serve_strategy(graph=graph, cost=_cost(),
+                                  traffic="smoke", budget=120, seed=0,
+                                  slots=4, max_len=128)
+    tight_budget = loose.default_metrics["hbm_bytes"] * 0.9
+    tight = search_serve_strategy(
+        graph=graph, cost=_cost(), traffic="smoke", budget=120, seed=0,
+        slots=4, max_len=128,
+        objective=ServeObjective(hbm_budget_bytes=tight_budget))
+    assert tight.best_metrics["hbm_bytes"] <= \
+        tight.default_metrics["hbm_bytes"]
+    assert tight.best_objective < tight.default_objective
+
+
+def test_mesh_layouts_ride_existing_mcmc(graph):
+    """layouts= + inner_budget>0 nests the EXISTING sharding search: the
+    result carries one priced layout per candidate and the winner's mesh
+    is one of them."""
+    res = search_serve_strategy(
+        graph=graph, cost=_cost(), traffic="smoke", budget=60, seed=0,
+        slots=4, max_len=128,
+        layouts=[{"data": 8}, {"data": 2, "model": 4}], inner_budget=10)
+    assert len(res.layouts) == 2
+    meshes = {tuple(sorted(lay["mesh"].items())) for lay in res.layouts}
+    assert meshes == {(("data", 8),), (("data", 2), ("model", 4))}
+    assert res.best.mesh in meshes
+    for lay in res.layouts:
+        assert lay["step_s"] > 0.0
+        assert lay["kv_token_bytes"] > 0
+
+
+def test_result_json_roundtrip(graph):
+    res = search_serve_strategy(graph=graph, cost=_cost(), traffic="smoke",
+                                budget=40, seed=0, slots=4, max_len=128)
+    back = ServeSearchResult.from_json(
+        json.loads(json.dumps(res.to_json())))
+    assert back.best == res.best
+    assert back.best_objective == res.best_objective
+    assert back.objective == res.objective
+
+
+# ---------------------------------------------------------------------------
+# servability: a searched strategy drives a real server, token-identical
+
+
+def _causal_lm():
+    lcfg = LlamaConfig(vocab_size=256, dim=64, layers=2, heads=4,
+                       kv_heads=2, hidden=128, rope_theta=10000.0)
+    ff = FFModel(FFConfig(batch_size=1, seed=11))
+    build_llama(ff, lcfg, batch_size=1, seq_len=8, dtype=DataType.FLOAT)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff, lcfg
+
+
+def test_searched_strategy_serves_token_identical():
+    """End to end: search on the compiled model (small budget), then
+    serve the winner — greedy output must equal dense FFModel.generate,
+    and the dict form (the tools/servesearch.py apply artifact) must
+    load the same way."""
+    ff, lcfg = _causal_lm()
+    res = search_serve_strategy(ff, traffic="smoke", budget=40, seed=0,
+                                slots=2, max_len=32)
+    assert res.best_objective < res.default_objective
+    res.best.validate(max_len=32)
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 6, 5)]
+    want = [ff.generate(p[None, :], max_new_tokens=8)[0] for p in prompts]
+    for strategy in (res.best, res.best.to_json()):
+        server = ff.serve_generation(slots=2, max_len=32,
+                                     serve_strategy=strategy)
+        try:
+            futs = [server.submit(p, max_new_tokens=8) for p in prompts]
+            got = [f.result(timeout=600) for f in futs]
+        finally:
+            server.stop()
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+
+
+def test_serve_strategy_rejects_explicit_speculate():
+    ff, _ = _causal_lm()
+    with pytest.raises(ValueError, match="speculation"):
+        ff.serve_generation(slots=2, max_len=32,
+                            serve_strategy=ServeStrategy(page_size=8),
+                            speculate=SpecConfig(width=2, depth=2))

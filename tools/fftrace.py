@@ -18,6 +18,9 @@ Subcommands:
       tick-shape measured-vs-predicted ratios (the scale factors
       MeasuredCostModel.set_tick_calibration consumes) plus per-phase
       medians. Runs from the artifact alone — no model, no accelerator.
+      Reports carry a schema version + created-at stamp (schema v2);
+      consumers with a freshness window (the serving-strategy search,
+      tools/servesearch.py) refuse reports older than 7 days.
 
   summarize TRACE
       Per-span-name counts and total/mean durations of a trace written
@@ -111,6 +114,8 @@ def cmd_smoke(args) -> int:
         "trace": trace_path,
         "ledger": ledger_path,
         "calibration": calib_path,
+        "schema_version": report["version"],
+        "created_at": report["created_at"],
         "events": len(rec.events),
         "requests": len(rec.requests),
         "shapes": sorted(report["tick_scales"]),

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""servesearch — search / explain / apply serving strategies
+(flexflow_tpu.search.servesearch, docs/search.md "Serving strategy
+search").
+
+Subcommands:
+
+  search [--profile NAME] [--budget N] [--seed S] [--slots K]
+         [--max-len L] [--calibration REPORT.json] [--hbm-budget BYTES]
+         [--acceptance-rate A] [--mesh-layouts SPEC] [--inner-budget M]
+         [--out FILE]
+      Build the tiny smoke model on CPU, run the serving-strategy
+      search against the named traffic profile
+      (flexflow_tpu.search.traffic: smoke, shared-system-prompt,
+      mixed-length) and write the full result JSON — winning
+      ServeStrategy, simulated SLO metrics for it and the hand default,
+      per-layout step prices, calibration provenance. A fresh `fftrace
+      calibrate` report sharpens the tick prices; stale reports are
+      refused with a warning. --mesh-layouts takes
+      "data=8;data=2,model=4" — candidate serving meshes each
+      shard-searched by the existing MCMC driver for --inner-budget
+      iterations. The last stdout line is a one-line JSON summary.
+
+  explain RESULT.json
+      Human-readable breakdown of a search result: the winning knobs,
+      each objective term (TTFT / throughput / HBM penalty) for the
+      searched and default strategies, and the priced tick metrics
+      behind them.
+
+  apply RESULT.json [--out FILE] [--serve-smoke]
+      Emit the winning strategy as the JSON `serve_generation(
+      serve_strategy=...)` loads (also accepted by FFModel
+      .serve_generation). --serve-smoke builds the tiny model, serves a
+      few prompts under the strategy and asserts token identity with
+      dense generate() — proof the searched config is servable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_tiny_ff():
+    from flexflow_tpu.parallel.compat import ensure_cpu_devices
+
+    ensure_cpu_devices(8)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from flexflow_tpu import FFConfig, FFModel, LossType
+    from flexflow_tpu.ffconst import DataType
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+
+    ff = FFModel(FFConfig(batch_size=1, seed=0))
+    build_llama(ff, LlamaConfig.tiny(vocab=128), batch_size=1, seq_len=8,
+                dtype=DataType.FLOAT)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def _parse_layouts(spec):
+    """'data=8;data=2,model=4' -> [{'data': 8}, {'data': 2, 'model': 4}]"""
+    if not spec:
+        return None
+    layouts = []
+    for part in spec.split(";"):
+        axes = {}
+        for kv in part.split(","):
+            k, v = kv.split("=")
+            axes[k.strip()] = int(v)
+        layouts.append(axes)
+    return layouts
+
+
+def cmd_search(args) -> int:
+    from flexflow_tpu.search.servesearch import (
+        ServeObjective,
+        search_serve_strategy,
+    )
+
+    ff = _build_tiny_ff()
+    objective = None
+    if args.hbm_budget is not None:
+        objective = ServeObjective(hbm_budget_bytes=float(args.hbm_budget))
+    res = search_serve_strategy(
+        ff, traffic=args.profile, budget=args.budget, seed=args.seed,
+        slots=args.slots, max_len=args.max_len, objective=objective,
+        calibration=args.calibration, acceptance_rate=args.acceptance_rate,
+        layouts=_parse_layouts(args.mesh_layouts),
+        inner_budget=args.inner_budget)
+    doc = res.to_json()
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+    print(json.dumps({
+        "profile": res.traffic,
+        "best": res.best.describe(),
+        "best_objective": res.best_objective,
+        "default_objective": res.default_objective,
+        "improvement": round(res.improvement, 4),
+        "trials": res.trials,
+        "calibration": res.calibration,
+        "out": args.out,
+    }))
+    return 0
+
+
+def _fmt_metrics(m) -> str:
+    return (f"    TTFT p95         {m['ttft_p95_s'] * 1e3:10.4f} ms\n"
+            f"    tokens/sec       {m['tokens_per_s']:10.1f}\n"
+            f"    HBM resident     {m['hbm_bytes'] / 1e6:10.2f} MB "
+            f"({m['pool_pages']:.0f} pool pages, "
+            f"occupancy {m['pool_occupancy']:.2f})\n"
+            f"    padding waste    {m['padding_waste_ratio']:10.3f}\n"
+            f"    roundtrips/token {m['host_roundtrips_per_token']:10.3f}\n"
+            f"    accepted/step    {m['expected_accepted_per_step']:10.2f}, "
+            f"fused ticks {m['expected_fused_ticks']:.2f}")
+
+
+def cmd_explain(args) -> int:
+    from flexflow_tpu.search.servesearch import ServeSearchResult
+
+    with open(args.result) as f:
+        res = ServeSearchResult.from_json(json.load(f))
+    print(f"profile: {res.traffic}  (slots={res.slots}, "
+          f"max_len={res.max_len}, budget={res.budget}, seed={res.seed}, "
+          f"{res.trials} strategies priced)")
+    cal = res.calibration
+    if cal and cal.get("used"):
+        print(f"calibration: fftrace report v{cal.get('version')} from "
+              f"{cal.get('created_at')} ({cal.get('shapes')} tick shapes)")
+    elif cal:
+        print(f"calibration: NOT used ({cal.get('reason')})")
+    else:
+        print("calibration: none supplied (analytic tick prices)")
+    for lay in res.layouts:
+        print(f"layout {lay['mesh']}: step {lay['step_s'] * 1e3:.4f} ms "
+              f"({lay['pricing_mode']}), kv {lay['kv_token_bytes']} B/token")
+    for label, strat, obj, m in (
+            ("searched", res.best, res.best_objective, res.best_metrics),
+            ("default ", res.default, res.default_objective,
+             res.default_metrics)):
+        terms = res.objective.breakdown(m)
+        print(f"\n{label}: {strat.describe()}")
+        print(f"  objective {obj:.6f}  =  ttft {terms['ttft_term']:.6f} "
+              f"+ throughput {terms['throughput_term']:.6f} "
+              f"+ hbm penalty {terms['hbm_penalty']:.6f}")
+        print(_fmt_metrics(m))
+    print(f"\nimprovement over default: {res.improvement * 100:.1f}%")
+    return 0
+
+
+def cmd_apply(args) -> int:
+    from flexflow_tpu.search.servesearch import ServeSearchResult
+
+    with open(args.result) as f:
+        res = ServeSearchResult.from_json(json.load(f))
+    strategy = res.best.to_json()
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(strategy, f, indent=1, sort_keys=True)
+    if args.serve_smoke:
+        import numpy as np
+
+        ff = _build_tiny_ff()
+        rs = np.random.RandomState(0)
+        prompts = [rs.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (3, 11, 5)]
+        want = [ff.generate(p[None, :], max_new_tokens=4)[0]
+                for p in prompts]
+        server = ff.serve_generation(slots=res.slots, max_len=res.max_len,
+                                     serve_strategy=strategy)
+        try:
+            futs = [server.submit(p, max_new_tokens=4) for p in prompts]
+            got = [f.result(timeout=600) for f in futs]
+        finally:
+            server.stop()
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+    print(json.dumps({
+        "serve_strategy": strategy,
+        "describe": res.best.describe(),
+        "out": args.out,
+        "serve_smoke": "token-identical" if args.serve_smoke else None,
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="servesearch", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    se = sub.add_parser("search", help="search the serving-strategy space")
+    se.add_argument("--profile", default="smoke")
+    se.add_argument("--budget", type=int, default=200)
+    se.add_argument("--seed", type=int, default=0)
+    se.add_argument("--slots", type=int, default=4)
+    se.add_argument("--max-len", type=int, default=64)
+    se.add_argument("--calibration", default=None,
+                    help="fftrace calibrate report (<= 7 days old)")
+    se.add_argument("--hbm-budget", type=float, default=None,
+                    help="HBM budget in bytes (default: the machine model)")
+    se.add_argument("--acceptance-rate", type=float, default=0.6)
+    se.add_argument("--mesh-layouts", default=None,
+                    help='candidate meshes, e.g. "data=8;data=2,model=4"')
+    se.add_argument("--inner-budget", type=int, default=0,
+                    help="mcmc budget per candidate mesh layout")
+    se.add_argument("--out", default=None)
+    se.set_defaults(func=cmd_search)
+
+    ex = sub.add_parser("explain", help="break down a search result")
+    ex.add_argument("result")
+    ex.set_defaults(func=cmd_explain)
+
+    apl = sub.add_parser("apply", help="emit the winning strategy JSON")
+    apl.add_argument("result")
+    apl.add_argument("--out", default=None)
+    apl.add_argument("--serve-smoke", action="store_true",
+                     help="serve the strategy on the tiny model and "
+                          "assert token identity with dense generate()")
+    apl.set_defaults(func=cmd_apply)
+
+    args = ap.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
