@@ -596,6 +596,75 @@ def bench_decode() -> dict:
             "describe": strat.describe(),
         }
 
+    # quantized-KV A/B (ISSUE 13): the SAME shared-prefix fixture served
+    # from the model-dtype pool and from an int8+scale-sidecar pool,
+    # each sized to the SAME HBM budget (a pool two sequences wide at fp
+    # bytes — tight enough that capacity binds). The int8 arm buys ~4x
+    # the pages, so it admits more concurrent requests and keeps more
+    # prefix pages cached; reported per arm: pool pages, concurrent-
+    # request capacity, peak concurrency, preemptions, prefix hit rate,
+    # decode tokens/sec, and the kv_cache_dtype / kv_quant_error gauges.
+    # Greedy outputs are compared stream-for-stream across the arms
+    # (token flips are the documented logit-tolerance story, not bugs).
+    # Must run before make_token_cyclic below (it rewrites the weights).
+    _log("decode bench: quantized KV A/B (fixed HBM budget)")
+    from flexflow_tpu.search.cost_model import kv_cache_token_bytes
+
+    pages_per_seq = -(-max_len // page)
+    kv_fp_b = kv_cache_token_bytes(ff.graph)
+    kv_q_b = kv_cache_token_bytes(ff.graph, kv_dtype="int8",
+                                  page_size=page)
+    hbm_budget = (2 * pages_per_seq + 1) * page * kv_fp_b
+    quant_ab = {
+        "hbm_budget_bytes": int(hbm_budget),
+        "kv_token_bytes": {"fp": int(kv_fp_b), "int8": int(kv_q_b)},
+    }
+    arm_outs = {}
+    for arm, kv_dt in (("fp", "auto"), ("int8", "int8")):
+        kv_b = kv_fp_b if kv_dt == "auto" else kv_q_b
+        pool_pages = max(int(hbm_budget // (page * kv_b)),
+                         pages_per_seq + 1)
+        server = ff.serve_generation(slots=4, max_len=max_len, paged=True,
+                                     page_size=page, num_pages=pool_pages,
+                                     kv_dtype=kv_dt)
+        try:
+            # warm the chunk buckets + decode step off the clock
+            server.generate(shared[0][:3], max_new_tokens=2)
+            server.generate(shared[0], max_new_tokens=2)
+            n_warm = 2
+            t0 = time.perf_counter()
+            futs = [server.submit(p, max_new_tokens=max_new)
+                    for p in shared]
+            outs = [f.result(timeout=1200) for f in futs]
+            dt = time.perf_counter() - t0
+            m = server.metrics()
+        finally:
+            server.stop()
+        arm_outs[arm] = outs
+        later = m["requests"][n_warm:]
+        hit = sum(r["cached_prefill_tokens"] for r in later)
+        computed = sum(r["prefill_tokens"] for r in later)
+        quant_ab[arm] = {
+            "pool_pages": pool_pages,
+            "request_capacity": (pool_pages - 1) // pages_per_seq,
+            "decode_tokens_per_sec": round(
+                sum(len(o) for o in outs) / dt, 2),
+            "peak_active": int(m["peak_active"]),
+            "preemptions": int(m["preemptions"]),
+            "prefix_cache_hit_rate": round(
+                hit / (hit + computed) if hit + computed else 0.0, 4),
+            "kv_cache_dtype": m["kv_cache_dtype"],
+            "kv_quant_error": m["kv_quant_error"],
+        }
+    quant_ab["capacity_ratio"] = round(
+        quant_ab["int8"]["pool_pages"] / quant_ab["fp"]["pool_pages"], 2)
+    quant_ab["greedy_streams_matched"] = sum(
+        int(np.array_equal(a, b))
+        for a, b in zip(arm_outs["fp"], arm_outs["int8"]))
+    quant_ab["fixture"] = (
+        f"{len(shared)} shared-prefix requests, both pools sized to "
+        f"{hbm_budget} KV bytes")
+
     # repetitive fixture: token-cyclic model (shared with tests/test_spec)
     from flexflow_tpu.spec.fixtures import make_token_cyclic
 
@@ -659,6 +728,7 @@ def bench_decode() -> dict:
         "ragged_packing": ragged_ab,
         "megastep": mega_ab,
         "servesearch": searched_ab,
+        "quantized_kv": quant_ab,
         "speculative": {
             "tokens_per_sec": round(spec_tps, 2),
             "acceptance_rate": round(sm["acceptance_rate"], 4),

@@ -37,6 +37,8 @@ class Invariant:
                 scheduler/harness holds (check(pool, owners));
       rows    — needs the per-page committed-row counts only the model
                 checker tracks (check(pool, committed));
+      scales  — needs the quantized-pool scale-sidecar mirror the model
+                checker tracks (check(pool, scale_of, content_tag));
       op      — only observable at the mutating operation itself; the
                 model checker enforces it inline (check is None).
     """
@@ -154,6 +156,27 @@ def _spec_scratch(pool, committed: Dict[int, int]) -> List[str]:
     return [f"spec-scratch: {m}" for m in v]
 
 
+def _scale_sidecar(pool, scale_of: Dict[int, int],
+                   content_tag: Dict[int, int]) -> List[str]:
+    """A quantized pool's scale sidecar must follow pages through every
+    pool op. `content_tag` is the spec's ground truth — what the scale
+    entry OUGHT to describe given the page's content history (stamped at
+    every row write, copied by the COW clone, permuted by defrag, reset
+    at allocation); `scale_of` mirrors what the implementation's sidecar
+    actually holds. They must agree on every page whose content is
+    reachable (live or dead-cached) — a page whose int8 payload is
+    dequantized under another page's scale is silent corruption."""
+    v = []
+    for p in sorted(set(pool._refs) | set(pool._lru)):
+        s, c = scale_of.get(p, 0), content_tag.get(p, 0)
+        if s != c:
+            v.append(f"page {p}: sidecar scale state {s} does not match "
+                     f"its content state {c} (the scale entry was "
+                     "dropped, leaked across a realloc, or left behind "
+                     "by a page move)")
+    return [f"scale-sidecar: {m}" for m in v]
+
+
 CATALOG: Tuple[Invariant, ...] = (
     Invariant(
         "free-accounting", "pool",
@@ -183,6 +206,15 @@ CATALOG: Tuple[Invariant, ...] = (
         "pages named by the hash index hold only committed K/V rows — "
         "speculative tree scratch is never registered before its commit",
         _spec_scratch),
+    Invariant(
+        "scale-sidecar", "scales",
+        "every reachable page's quantization-scale sidecar entry "
+        "describes that page's current content: scales are reset with "
+        "the page at allocation, copied by the COW clone, remapped by "
+        "the defrag permutation, and kept by LRU revival — never "
+        "dropped, leaked across a realloc, or left at a moved page's "
+        "old slot",
+        _scale_sidecar),
     Invariant(
         "cow-write", "op",
         "no row write lands in a page the writer does not own, a page "
@@ -223,4 +255,16 @@ def check_committed(pool, committed: Dict[int, int]) -> List[str]:
     for entry in CATALOG:
         if entry.scope == "rows":
             v += entry.check(pool, committed)
+    return v
+
+
+def check_scales(pool, scale_of: Dict[int, int],
+                 content_tag: Dict[int, int]) -> List[str]:
+    """Run the quantized-pool scale-sidecar invariants (model checker
+    only — the live scheduler keeps the sidecar inside the caches dict,
+    where the checker's mirror tracks it at op granularity)."""
+    v: List[str] = []
+    for entry in CATALOG:
+        if entry.scope == "scales":
+            v += entry.check(pool, scale_of, content_tag)
     return v

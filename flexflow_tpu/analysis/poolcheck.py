@@ -111,7 +111,22 @@ class PoolModel:
       cow_bypass          — admission maps a shared donor tail page in
                             place instead of COW-cloning it;
       scratch_preregister — speculative verify registers its tree
-                            scratch page before the commit.
+                            scratch page before the commit;
+      scale_cow_drop      — the COW clone copies the page payload but
+                            not its scale-sidecar entry;
+      scale_realloc_leak  — allocation hands out a page without
+                            resetting its previous tenant's scale;
+      scale_defrag_drop   — defrag permutes page payloads but leaves
+                            the scale sidecar at the old slots.
+
+    The quantized-pool scale sidecar is modeled as a pair of per-page
+    tags: `content_tag` is the spec truth — a bounded
+    writes-since-alloc counter (capped at page_size, so the state space
+    stays finite) stamped at every row write, copied by COW, permuted
+    by defrag, reset at alloc, kept by LRU revival; `scale_of` mirrors
+    the ops the implementation's sidecar actually performs (the seeded
+    mutations above each skip exactly one of them). The scale-sidecar
+    invariant is scale_of == content_tag on every reachable page.
     """
 
     def __init__(self, pool_factory=None, *, num_pages: int,
@@ -128,6 +143,8 @@ class PoolModel:
         self.pool = factory(num_pages, page_size, self.max_pages)
         self.reqs = [_Req(p, m) for p, m in zip(prompts, max_new)]
         self.committed: Dict[int, int] = {}  # page -> committed K/V rows
+        self.scale_of: Dict[int, int] = {}     # impl's sidecar mirror
+        self.content_tag: Dict[int, int] = {}  # spec's content truth
         self.violations: List[str] = []
 
     # -- bookkeeping helpers ----------------------------------------------
@@ -154,6 +171,14 @@ class PoolModel:
         if pages is not None:
             for p in pages:
                 self.committed[p] = 0  # fresh/recycled content is garbage
+                self.content_tag[p] = 0
+                if "scale_realloc_leak" not in self.mutations:
+                    # mirrors scheduler._reset_page_scales at every
+                    # allocation site; the mutation keeps the previous
+                    # tenant's scale on the recycled page
+                    self.scale_of[p] = 0
+                else:
+                    self.scale_of.setdefault(p, 0)
         return pages
 
     def _write_row(self, req: _Req, row: int, scratch: bool = False):
@@ -187,6 +212,13 @@ class PoolModel:
         if not scratch:
             c = self.committed.get(page, 0)
             self.committed[page] = max(c, row % self.P + 1)
+        # every row write (scratch included — verify rewrites draft K/V)
+        # changes the page's content AND grows its quantization scale
+        # atomically (quantized_append); the bounded counter keeps BFS
+        # finite while still distinguishing stale from current scales
+        self.content_tag[page] = min(self.P,
+                                     self.content_tag.get(page, 0) + 1)
+        self.scale_of[page] = min(self.P, self.scale_of.get(page, 0) + 1)
 
     # -- publication (mirrors _publish_prefix/_publish_tail) --------------
 
@@ -287,8 +319,14 @@ class PoolModel:
                 pool.free([pages[b0]])
                 pages[b0] = cow_src
             else:
-                # COW clone: rows below `start` carry over as committed
+                # COW clone: rows below `start` carry over as committed;
+                # copy_page tree-maps over EVERY cache leaf, so the
+                # clone inherits the donor's content AND scale entry
                 self.committed[pages[b0]] = max(0, start - b0 * P)
+                self.content_tag[pages[b0]] = \
+                    self.content_tag.get(cow_src, 0)
+                if "scale_cow_drop" not in self.mutations:
+                    self.scale_of[pages[b0]] = self.scale_of.get(cow_src, 0)
                 pool.free([cow_src])
         req.prefill_pos = start
         req.prefill_target = n
@@ -434,6 +472,17 @@ class PoolModel:
             r.pages = [m(p) for p in r.pages]
         self.committed = {m(p): c for p, c in self.committed.items()
                           if p in allocated}
+        self.content_tag = {m(p): t for p, t in self.content_tag.items()
+                            if p in allocated}
+        if "scale_defrag_drop" in self.mutations:
+            # SEEDED DEFECT: the payload permutation ran but the scale
+            # sidecar was left behind — page m(p)'s int8 rows now
+            # dequantize under whatever scale sat at slot m(p) before
+            self.scale_of = {p: t for p, t in self.scale_of.items()
+                             if p in allocated}
+        else:
+            self.scale_of = {m(p): t for p, t in self.scale_of.items()
+                             if p in allocated}
 
     # -- canonical state -------------------------------------------------
 
@@ -458,6 +507,14 @@ class PoolModel:
                 tuple(sorted(pool._partial.items())),
                 keys_of,
                 tuple(sorted((p, c) for p, c in self.committed.items()
+                             if p in live)),
+                # stale entries on FREE pages are excluded: a correct
+                # model resets them at the next alloc, so they never
+                # influence a transition (the realloc-leak mutation is
+                # caught at the alloc itself, before any dedup)
+                tuple(sorted((p, t) for p, t in self.scale_of.items()
+                             if p in live)),
+                tuple(sorted((p, t) for p, t in self.content_tag.items()
                              if p in live)))
 
 
@@ -477,7 +534,9 @@ class CheckResult:
 def _state_violations(state: PoolModel) -> List[str]:
     return (list(state.violations)
             + inv.check_pool(state.pool, state.owners())
-            + inv.check_committed(state.pool, state.committed))
+            + inv.check_committed(state.pool, state.committed)
+            + inv.check_scales(state.pool, state.scale_of,
+                               state.content_tag))
 
 
 def model_check(config: str = "base", pool_factory=None,
@@ -539,7 +598,12 @@ LINT_ROOTS = ("serving.py", "paged", "spec")
 # the host-side state-machine files the page/table write checks cover
 # (kernel files write K/V rows THROUGH the table by design)
 _STATE_FILE_BASENAMES = {"scheduler.py", "pool.py", "server.py"}
-_COW_FNS = {"copy_page"}
+_COW_FNS = {"copy_page",
+            # alloc-time scale-sidecar zeroing: runs only on pages just
+            # handed out by the allocator (exclusively owned, nothing
+            # published), part of the allocation lifecycle like the
+            # table writes in _admit/_ensure_pages
+            "reset_page_scales"}
 _TABLE_FNS = {"__init__", "_admit", "_apply_defrag", "_release_slot",
               "_evict", "_ensure_pages"}
 _DIRECTIVES = ("lock-ok", "cow-ok", "table-ok", "pool-ok")

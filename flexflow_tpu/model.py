@@ -1218,6 +1218,7 @@ class FFModel:
                          preemption: bool = True, prefix_cache: bool = True,
                          prefill_chunk: int = 64, speculate=None,
                          ragged_pack: bool = True, megastep_ticks: int = 1,
+                         kv_dtype: str = "auto",
                          request_record_limit=None, serve_strategy=None,
                          search_budget=None, traffic="smoke"):
         """Continuous-batching autoregressive generation endpoint (KV-cache
@@ -1240,7 +1241,10 @@ class FFModel:
         the serving-strategy search against the `traffic` profile before
         serving; `serve_strategy` applies a previously searched
         ServeStrategy (or its JSON dict) directly (docs/search.md,
-        "Serving strategy search")."""
+        "Serving strategy search"). `kv_dtype="int8"` (paged only)
+        stores KV pages quantized with per-page per-head scales —
+        ~4x more tokens per byte of pool HBM at a bounded logit
+        tolerance (docs/paged.md "Quantized KV pages")."""
         from flexflow_tpu.serving import serve_generation as _sg
 
         return _sg(self, slots=slots, max_len=max_len, eos_id=eos_id,
@@ -1248,7 +1252,7 @@ class FFModel:
                    num_pages=num_pages, preemption=preemption,
                    prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
                    speculate=speculate, ragged_pack=ragged_pack,
-                   megastep_ticks=megastep_ticks,
+                   megastep_ticks=megastep_ticks, kv_dtype=kv_dtype,
                    request_record_limit=request_record_limit,
                    serve_strategy=serve_strategy,
                    search_budget=search_budget, traffic=traffic)

@@ -338,13 +338,30 @@ def _mha(attrs, inputs, params, ctx):
             # or the gather fallback behind one gate)
             from flexflow_tpu.paged.attention import ragged_paged_attention
 
-            out, kc, vc = ragged_paged_attention(
-                q, k, v, ctx.kv_cache["k"], ctx.kv_cache["v"],
-                ctx.page_tables, ctx.cache_position, ctx.ragged_q_lens,
-                ctx.ragged_depths, ctx.ragged_anc,
-                scale=1.0 / (hd**0.5),
-                rope_theta=attrs.rope_theta if attrs.rope else None,
-            )
+            if "k_scale" in ctx.kv_cache:
+                # quantized pool: the scale sidecar rides the same
+                # per-node caches dict (paged/quant.py), so append
+                # quantizes under grow-only scales and both attention
+                # paths dequantize on load
+                out, kc, vc, ks, vs = ragged_paged_attention(
+                    q, k, v, ctx.kv_cache["k"], ctx.kv_cache["v"],
+                    ctx.page_tables, ctx.cache_position,
+                    ctx.ragged_q_lens, ctx.ragged_depths, ctx.ragged_anc,
+                    scale=1.0 / (hd**0.5),
+                    rope_theta=attrs.rope_theta if attrs.rope else None,
+                    k_scales=ctx.kv_cache["k_scale"],
+                    v_scales=ctx.kv_cache["v_scale"],
+                )
+                ctx.cache_updates["k_scale"] = ks
+                ctx.cache_updates["v_scale"] = vs
+            else:
+                out, kc, vc = ragged_paged_attention(
+                    q, k, v, ctx.kv_cache["k"], ctx.kv_cache["v"],
+                    ctx.page_tables, ctx.cache_position,
+                    ctx.ragged_q_lens, ctx.ragged_depths, ctx.ragged_anc,
+                    scale=1.0 / (hd**0.5),
+                    rope_theta=attrs.rope_theta if attrs.rope else None,
+                )
         else:
             out, kc, vc = cached_attention(
                 q, k, v, ctx.kv_cache["k"], ctx.kv_cache["v"],

@@ -94,7 +94,9 @@ def paged_attention_available(head_dim: int, page_size: int,
     blocks; smaller head dims take the gather fallback, mirroring the
     flash bshd gate) and pages must tile the sublane dim AT THE POOL'S
     DTYPE — (8, 128) tiles for fp32 but (16, 128) for bf16/fp16 and
-    (32, 128) for int8/fp8, so a bf16 pool needs page_size % 16 == 0.
+    (32, 128) for int8/fp8, so a bf16 pool needs page_size % 16 == 0
+    and a QUANTIZED int8 pool (kv_dtype="int8", paged/quant.py) needs
+    page_size % 32 == 0 for the kernel's dequant-on-load path.
     Rejections log their concrete reason once per (reason, config)."""
     dt = jnp.dtype(dtype)
     cfg = (head_dim, page_size, dt.name, jax.default_backend())
@@ -157,13 +159,17 @@ def tree_visibility_mask(page_tables, pos, anc_mask, page_size: int):
 
 
 def ragged_gather_attention(q, kc_pages, vc_pages, page_tables, pos,
-                            q_lens, anc_mask, *, scale: float):
+                            q_lens, anc_mask, *, scale: float,
+                            k_scales=None, v_scales=None):
     """Pure-JAX fallback AND numerical reference for the ragged kernel:
     gather every table-mapped page (`pool[page_table]`) and run dense
     masked dot-product attention under ragged_visibility_mask. q:
     (B, S, H, D); kc/vc_pages: (N, P, Hkv, D); page_tables:
     (B, max_pages) int32; pos/q_lens: (B,) int32; anc_mask: (B, S, S)
-    bool. Rows with no visible keys (padded entries) come out of the
+    bool. For a quantized pool, k_scales/v_scales are the (N, Hkv)
+    per-page sidecar (paged/quant.py) and the gathered int8 pages are
+    dequantized by the SAME table gather before the dense attention.
+    Rows with no visible keys (padded entries) come out of the
     all-masked softmax as a uniform average — garbage a caller's
     q_len bookkeeping already discards, exactly like the kernel's
     zero rows."""
@@ -171,8 +177,18 @@ def ragged_gather_attention(q, kc_pages, vc_pages, page_tables, pos,
     Hkv = kc_pages.shape[2]
     P = kc_pages.shape[1]
     dt = q.dtype
-    kg = kc_pages[page_tables].reshape(B, -1, Hkv, D)
-    vg = vc_pages[page_tables].reshape(B, -1, Hkv, D)
+    if k_scales is not None:
+        from flexflow_tpu.paged.quant import dequantize_pages
+
+        kg = dequantize_pages(kc_pages[page_tables],
+                              k_scales[page_tables])
+        vg = dequantize_pages(vc_pages[page_tables],
+                              v_scales[page_tables])
+    else:
+        kg = kc_pages[page_tables]
+        vg = vc_pages[page_tables]
+    kg = kg.reshape(B, -1, Hkv, D)
+    vg = vg.reshape(B, -1, Hkv, D)
     mask = ragged_visibility_mask(page_tables, pos, q_lens, anc_mask, P)
     from flexflow_tpu.ops.jax_ops import _dot_product_attention
 
@@ -187,7 +203,8 @@ def ragged_gather_attention(q, kc_pages, vc_pages, page_tables, pos,
 
 def _ragged_kernel(pt_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
                    anc_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
-                   page_size, n_pages, window):
+                   page_size, n_pages, window, ks_ref=None,
+                   vs_ref=None):
     b, j = pl.program_id(0), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -206,6 +223,20 @@ def _ragged_kernel(pt_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
         q = q_ref[...]                       # (rep, S, D)
         k = k_ref[...]                       # (P, D)
         v = v_ref[...]
+        if ks_ref is not None:
+            # quantized pool: this grid step's page/head scale rode in
+            # as a (1, 1) block addressed by the SAME prefetched-table
+            # index map as the page itself, so dequant-on-load is one
+            # broadcast multiply in VMEM — the int8 page is what DMA'd
+            # from HBM, the fp K/V never round-trips
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32) * ks_ref[0, 0]
+            v = v.astype(jnp.float32) * vs_ref[0, 0]
+        elif k.dtype != q.dtype:
+            # mixed-precision pool (e.g. bf16 kv_dtype under an fp32
+            # model): dot_general needs matching operand dtypes
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
         s = lax.dot_general(q, k, (((2,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         # window visibility without a gather and without an HBM mask:
@@ -250,9 +281,22 @@ def _ragged_kernel(pt_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
                                0.0).astype(o_ref.dtype)
 
 
+def _ragged_kernel_quant(pt_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
+                         ks_ref, vs_ref, anc_ref, o_ref, m_scr, l_scr,
+                         acc_scr, *, scale, page_size, n_pages, window):
+    """Positional-arity shim for the quantized launch: same body, two
+    extra (1, 1) scale blocks between the pool inputs and the anc
+    relation (matching the in_specs order below)."""
+    _ragged_kernel(pt_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
+                   anc_ref, o_ref, m_scr, l_scr, acc_scr, scale=scale,
+                   page_size=page_size, n_pages=n_pages, window=window,
+                   ks_ref=ks_ref, vs_ref=vs_ref)
+
+
 def ragged_flash_attention(q, kc_pages, vc_pages, page_tables, pos,
                            q_lens, anc_mask, *, scale: float,
-                           interpret: bool = False):
+                           interpret: bool = False, k_scales=None,
+                           v_scales=None):
     """The ragged Pallas launch. q: (B, S, H, D) — S is the launch's
     window width, per-entry real work is q_lens[b] <= S rows;
     kc/vc_pages: (N, P, Hkv, D); page_tables: (B, max_pages); pos,
@@ -262,7 +306,11 @@ def ragged_flash_attention(q, kc_pages, vc_pages, page_tables, pos,
     and the horizon/padding skip predicates on prefetched scalars. The
     anc relation is one (S, S) VMEM block per batch entry — the only
     mask state, O(B*S^2) instead of the old (B, S, L) HBM add_mask.
-    Rows at or past q_lens[b] output zeros."""
+    For a quantized pool, k_scales/v_scales are the (N, Hkv) sidecar;
+    each grid step's (page, head) scale arrives as a (1, 1) block
+    through the SAME pt[b, j] index map as its page, and the kernel
+    dequantizes in VMEM (paged/quant.py has the layout story). Rows at
+    or past q_lens[b] output zeros."""
     B, S, H, D = q.shape
     N, P, Hkv, _ = kc_pages.shape
     rep = H // Hkv
@@ -270,19 +318,33 @@ def ragged_flash_attention(q, kc_pages, vc_pages, page_tables, pos,
     qr = q.transpose(0, 2, 1, 3).reshape(B, Hkv, rep, S, D)
     anc_f = anc_mask.astype(jnp.float32)
 
+    in_specs = [
+        pl.BlockSpec((None, None, rep, S, D),
+                     lambda b, g, j, pt, ps, ql: (b, g, 0, 0, 0)),
+        pl.BlockSpec((None, P, None, D),
+                     lambda b, g, j, pt, ps, ql: (pt[b, j], 0, g, 0)),
+        pl.BlockSpec((None, P, None, D),
+                     lambda b, g, j, pt, ps, ql: (pt[b, j], 0, g, 0)),
+    ]
+    operands = [qr, kc_pages, vc_pages]
+    kernel = _ragged_kernel
+    if k_scales is not None:
+        in_specs += [
+            pl.BlockSpec((1, 1),
+                         lambda b, g, j, pt, ps, ql: (pt[b, j], g)),
+            pl.BlockSpec((1, 1),
+                         lambda b, g, j, pt, ps, ql: (pt[b, j], g)),
+        ]
+        operands += [k_scales, v_scales]
+        kernel = _ragged_kernel_quant
+    in_specs.append(pl.BlockSpec((None, S, S),
+                                 lambda b, g, j, pt, ps, ql: (b, 0, 0)))
+    operands.append(anc_f)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, Hkv, n_pages),
-        in_specs=[
-            pl.BlockSpec((None, None, rep, S, D),
-                         lambda b, g, j, pt, ps, ql: (b, g, 0, 0, 0)),
-            pl.BlockSpec((None, P, None, D),
-                         lambda b, g, j, pt, ps, ql: (pt[b, j], 0, g, 0)),
-            pl.BlockSpec((None, P, None, D),
-                         lambda b, g, j, pt, ps, ql: (pt[b, j], 0, g, 0)),
-            pl.BlockSpec((None, S, S),
-                         lambda b, g, j, pt, ps, ql: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, None, rep, S, D),
                                lambda b, g, j, pt, ps, ql: (b, g, 0, 0, 0)),
         scratch_shapes=[
@@ -292,13 +354,13 @@ def ragged_flash_attention(q, kc_pages, vc_pages, page_tables, pos,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_ragged_kernel, scale=scale, page_size=P,
+        functools.partial(kernel, scale=scale, page_size=P,
                           n_pages=n_pages, window=S),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, rep, S, D), q.dtype),
         interpret=interpret,
     )(page_tables.astype(jnp.int32), pos.astype(jnp.int32),
-      q_lens.astype(jnp.int32), qr, kc_pages, vc_pages, anc_f)
+      q_lens.astype(jnp.int32), *operands)
     return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, D)
 
 
@@ -308,7 +370,8 @@ def ragged_flash_attention(q, kc_pages, vc_pages, page_tables, pos,
 
 def ragged_paged_attention(q, k, v, cache_k, cache_v, page_tables, pos,
                            q_lens, depths, anc_mask, *, scale: float,
-                           rope_theta: Optional[float] = None):
+                           rope_theta: Optional[float] = None,
+                           k_scales=None, v_scales=None):
     """The single paged-attention step every caller lowers to — decode,
     chunked prefill and tree verify are the same call with different
     descriptors (module docstring). Ropes q/k at pos + depths, scatters
@@ -318,11 +381,18 @@ def ragged_paged_attention(q, k, v, cache_k, cache_v, page_tables, pos,
     then attends via the ragged kernel or the gather fallback behind
     the one availability gate.
 
-    Returns (attention output, new k pool, new v pool). Output rows at
+    When k_scales/v_scales are passed, the pools are int8 and the write
+    becomes quantize-on-append under grow-only per-(page, head) scales
+    (paged/quant.py): the roped fp rows never reach HBM, and BOTH
+    attention paths dequantize on load.
+
+    Returns (attention output, new k pool, new v pool) — plus
+    (new k_scales, new v_scales) in the quantized case. Output rows at
     or past q_lens[b] are garbage by contract (kernel: zeros; gather:
     an unmasked-softmax average) — callers index by their own q_len
     bookkeeping."""
     from flexflow_tpu.ops.jax_ops import apply_rope
+    from flexflow_tpu.paged.quant import quantized_append
 
     B, S = q.shape[0], q.shape[1]
     P = cache_k.shape[1]
@@ -340,18 +410,27 @@ def ragged_paged_attention(q, k, v, cache_k, cache_v, page_tables, pos,
     live = (rows < L) & (jnp.arange(S)[None, :] < qlen_v[:, None])
     page = jnp.where(live, page, 0)
     off = safe % P
-    kc = cache_k.at[page, off].set(k.astype(cache_k.dtype))
-    vc = cache_v.at[page, off].set(v.astype(cache_v.dtype))
+    if k_scales is not None:
+        kc, ks = quantized_append(cache_k, k_scales, k, page, off, live)
+        vc, vs = quantized_append(cache_v, v_scales, v, page, off, live)
+    else:
+        kc = cache_k.at[page, off].set(k.astype(cache_k.dtype))
+        vc = cache_v.at[page, off].set(v.astype(cache_v.dtype))
+        ks = vs = None
 
     force_interp = os.environ.get("FF_TPU_FLASH_INTERPRET") == "1"
     if paged_attention_available(q.shape[-1], P, interpret=force_interp,
                                  dtype=kc.dtype):
         out = ragged_flash_attention(q, kc, vc, page_tables, pos_v,
                                      qlen_v, anc_mask, scale=scale,
-                                     interpret=force_interp)
+                                     interpret=force_interp,
+                                     k_scales=ks, v_scales=vs)
     else:
         out = ragged_gather_attention(q, kc, vc, page_tables, pos_v,
-                                      qlen_v, anc_mask, scale=scale)
+                                      qlen_v, anc_mask, scale=scale,
+                                      k_scales=ks, v_scales=vs)
+    if k_scales is not None:
+        return out, kc, vc, ks, vs
     return out, kc, vc
 
 

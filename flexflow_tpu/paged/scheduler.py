@@ -86,7 +86,8 @@ class PagedGenerationServer(_GenerationServerBase):
                  preemption: bool = True, table_slack_tokens: int = 0,
                  prefix_cache: bool = True, prefill_chunk: int = 64,
                  ragged_pack: bool = True, megastep_ticks: int = 1,
-                 request_record_limit: Optional[int] = None):
+                 request_record_limit: Optional[int] = None,
+                 kv_dtype: str = "auto"):
         import jax
 
         super().__init__(ff, slots, max_len, eos_id, seed,
@@ -128,9 +129,45 @@ class PagedGenerationServer(_GenerationServerBase):
         if self.megastep_ticks < 1:
             raise ValueError(
                 f"megastep_ticks must be >= 1, got {megastep_ticks}")
+        # kv_dtype: "auto" pools at the model's dtype; "int8" stores
+        # quantized pages with the per-(page, head) scale sidecar inside
+        # the same caches dict (paged/quant.py), so copy_page/defrag/
+        # megastep carry all move scales with pages by construction;
+        # "bf16"/"fp16"/"fp32" are plain storage casts without scales
+        from flexflow_tpu.paged.quant import (
+            is_quantized_dtype,
+            resolve_kv_dtype,
+        )
+
+        self.kv_dtype = str(kv_dtype)
+        pool_dt = resolve_kv_dtype(self.kv_dtype)  # validates the name
+        self._quantized = (pool_dt is not None
+                           and is_quantized_dtype(pool_dt))
+        # FF_TPU_KV_QUANT_DEBUG=1 keeps a shadow fp32 cache and runs
+        # every launch twice, exporting the running max abs output delta
+        # as the kv_quant_error gauge (docs/observability.md). The
+        # shadow must observe every tick, so megasteps fall back to the
+        # one-tick loop under the flag.
+        import os as _os
+
+        self._kv_quant_debug = (
+            self._quantized
+            and _os.environ.get("FF_TPU_KV_QUANT_DEBUG") == "1")
+        if self._kv_quant_debug and self.megastep_ticks > 1:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "FF_TPU_KV_QUANT_DEBUG=1: forcing megastep_ticks=1 so "
+                "the fp32 shadow cache observes every tick")
+            self.megastep_ticks = 1
         self._megastep = (ex.paged_megastep_fn(self.megastep_ticks, eos_id)
                           if self.megastep_ticks > 1 else None)
-        self._caches = ex.init_paged_kv_cache(num_pages, self.page_size)
+        self._caches = ex.init_paged_kv_cache(num_pages, self.page_size,
+                                              dtype=pool_dt)
+        self._caches_ref = (ex.init_paged_kv_cache(
+            num_pages, self.page_size, dtype=jax.numpy.float32)
+            if self._kv_quant_debug else None)
+        self._quant_err_dev = jax.numpy.float32(0.0)
         self._tables = np.zeros((self.slots, self.max_pages_per_seq),
                                 np.int32)
         # device-resident descriptor mirrors (dirty-flagged, not re-
@@ -192,14 +229,41 @@ class PagedGenerationServer(_GenerationServerBase):
         self._g_kernel = self.registry.gauge("ragged_kernel_active")
         self._g_kernel.set(1.0 if self.kernel_variant == "ragged_pallas"
                            else 0.0)
+        # kv_cache_dtype holds the pool's bits per K/V element (the
+        # dtype NAME rides the metrics() dict); kv_quant_error the
+        # running max abs output delta vs the fp32 shadow, 0 until the
+        # debug flag samples it
+        self._g_kv_dtype = self.registry.gauge("kv_cache_dtype")
+        self._g_kv_dtype.set(kbuf.dtype.itemsize * 8)
+        self._g_qerr = self.registry.gauge("kv_quant_error")
+        self._g_qerr.set(0.0)
 
         @jax.jit
         def copy_page(caches, src, dst):
             # copy-on-write: clone one pool page (every cache buffer) so
-            # a new owner can write past a shared partial prefix
+            # a new owner can write past a shared partial prefix — the
+            # scale-sidecar entries of a quantized pool are leaves of
+            # the same dict, so the clone carries the donor's scales
             return jax.tree.map(lambda b: b.at[dst].set(b[src]), caches)
 
         self._copy_page = copy_page
+
+        @jax.jit
+        def reset_page_scales(caches, pages):
+            # page lifecycle, not a row write: pages coming OFF the free
+            # list get zero scales (grow-only within a lifetime starts
+            # from zero; an empty page dequantizes to exact zeros).
+            # LRU-revived pages never come through here — they keep
+            # content, so they keep scales. `pages` is padded with the
+            # null page 0, whose scale only ever covers garbage rows.
+            return {
+                nk: {n: (b.at[pages].set(0.0) if n.endswith("_scale")
+                         else b)
+                     for n, b in bufs.items()}
+                for nk, bufs in caches.items()
+            }
+
+        self._scale_reset = reset_page_scales
         self._start()
 
     # -- capacity ---------------------------------------------------------
@@ -239,6 +303,8 @@ class PagedGenerationServer(_GenerationServerBase):
             "fragmentation": pool.fragmentation(),
             "prefill_ticks": self.prefill_ticks,
             "kernel_variant": self.kernel_variant,
+            "kv_cache_dtype": self._kv_pool_dtype_name(),
+            "kv_quant_error": self._kv_quant_error(),
             "launch_rows": int(self._c_rows.value),
             "padded_rows": int(self._c_pad.value),
             "padding_waste_ratio": (
@@ -265,6 +331,20 @@ class PagedGenerationServer(_GenerationServerBase):
             },
         })
         return m
+
+    def _kv_pool_dtype_name(self) -> str:
+        """The pool's actual storage dtype name ("int8" for a quantized
+        pool) — what the kv_cache_dtype gauge reports in bits."""
+        return str(next(iter(self._caches.values()))["k"].dtype)
+
+    def _kv_quant_error(self) -> float:
+        """Running max abs output delta vs the fp32 shadow cache (0.0
+        unless FF_TPU_KV_QUANT_DEBUG=1 is sampling). Materialized from
+        the device-resident running max only here, at scrape time, so
+        the serving loop never pays a host sync for it."""
+        err = float(self._quant_err_dev)
+        self._g_qerr.set(err)
+        return err
 
     def request_defrag(self):
         """Ask the loop to compact the page pool between ticks (host
@@ -354,6 +434,23 @@ class PagedGenerationServer(_GenerationServerBase):
         self.preemptions += 1
         self._requeue.insert(0, req)
 
+    def _reset_page_scales(self, pages: List[int]):
+        """Zero the scale-sidecar entries of freshly ALLOCATED pages
+        (no-op on unquantized pools). Called wherever pages come off the
+        free list — admission's private pages and per-tick growth — so a
+        page's grow-only scale lifetime starts at zero and a stale scale
+        can never leak across owners. LRU revivals deliberately skip
+        this: a revived page keeps its content, so it keeps its scale.
+        The index vector pads with the null page to a fixed length so
+        the jitted reset compiles once."""
+        if not self._quantized or not pages:
+            return
+        import jax.numpy as jnp
+
+        buf = np.zeros((self.max_pages_per_seq,), np.int32)
+        buf[:len(pages)] = pages
+        self._caches = self._scale_reset(self._caches, jnp.asarray(buf))
+
     def _admit(self, req: _GenRequest, slot: int) -> bool:
         """Map the longest cached prefix (shared full pages by refcount,
         copy-on-write clone of a matched partial tail), allocate private
@@ -411,6 +508,9 @@ class PagedGenerationServer(_GenerationServerBase):
         pages = keep + fresh
         req.pages = pages
         req.peak_pages = max(req.peak_pages, len(pages))
+        # fresh pages start a new scale lifetime BEFORE any COW clone,
+        # so the clone's copied scale is not wiped
+        self._reset_page_scales(fresh)
         self._tables[slot] = 0
         self._tables[slot, :len(pages)] = pages
         self._mark_tables_dirty()
@@ -419,6 +519,10 @@ class PagedGenerationServer(_GenerationServerBase):
             self._caches = self._copy_page(
                 self._caches, jnp.asarray(cow_src, jnp.int32),
                 jnp.asarray(pages[b0], jnp.int32))
+            if self._caches_ref is not None:
+                self._caches_ref = self._copy_page(
+                    self._caches_ref, jnp.asarray(cow_src, jnp.int32),
+                    jnp.asarray(pages[b0], jnp.int32))
             self.pool.free([cow_src])
         req.prefill_seq = seq
         req.prefill_pos = start
@@ -465,6 +569,7 @@ class PagedGenerationServer(_GenerationServerBase):
             while req is self._active[slot] and len(req.pages) < target:
                 got = self.pool.alloc(1)
                 if got is not None:
+                    self._reset_page_scales(got)
                     req.pages.append(got[0])
                     req.peak_pages = max(req.peak_pages, len(req.pages))
                     self._tables[slot, len(req.pages) - 1] = got[0]
@@ -481,10 +586,18 @@ class PagedGenerationServer(_GenerationServerBase):
         import jax
 
         perm, old_to_new = self.pool.defrag()
+        # the gather covers every leaf of each node's dict — a quantized
+        # pool's (num_pages, Hkv) scale sidecar permutes on the same
+        # axis 0 as its pages, so scales follow pages through compaction
         self._caches = {
             key: jax.tree.map(lambda b: b[perm], bufs)
             for key, bufs in self._caches.items()
         }
+        if self._caches_ref is not None:
+            self._caches_ref = {
+                key: jax.tree.map(lambda b: b[perm], bufs)
+                for key, bufs in self._caches_ref.items()
+            }
         # EVERY owner's table: the (slots, max_pages) matrix rewrite
         # covers every live slot (decoding and mid-prefill alike); shared
         # pages get the same new id in every owner's row because
@@ -651,6 +764,21 @@ class PagedGenerationServer(_GenerationServerBase):
             jnp.asarray(pos), jnp.asarray(qls), deps_d, anc_d,
             jnp.asarray(ids))
         self._caches = upd
+        if self._caches_ref is not None:
+            # quant-error sampling (FF_TPU_KV_QUANT_DEBUG=1): the same
+            # launch against the fp32 shadow cache; the running max abs
+            # output delta over LIVE rows stays on device — metrics()
+            # materializes it into the kv_quant_error gauge on scrape
+            probs_ref, upd_ref = self._step(
+                tr, ntr, self._caches_ref, tbl,
+                jnp.asarray(pos), jnp.asarray(qls), deps_d, anc_d,
+                jnp.asarray(ids))
+            self._caches_ref = upd_ref
+            live_rows = jnp.asarray(
+                np.arange(window)[None, :] < qls[:, None])
+            delta = jnp.max(jnp.abs(probs - probs_ref)
+                            * live_rows[:, :, None])
+            self._quant_err_dev = jnp.maximum(self._quant_err_dev, delta)
         total = B * window
         padded = total - int(qls.sum())
         self._c_rows.inc(total)

@@ -47,6 +47,32 @@ def node_key(node: Node) -> str:
     return node.stable_key()
 
 
+_WEIGHT_DTYPE_NAMES = {
+    "fp32": "float32", "float32": "float32",
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "fp16": "float16", "float16": "float16",
+    "fp8": "float8_e4m3fn", "float8_e4m3fn": "float8_e4m3fn",
+}
+
+
+def _cast_weight_leaf(arr, weight_dtype: str):
+    """Storage cast for one initialized weight leaf
+    (init_params(weight_dtype=...)): float names are a plain astype;
+    "int8" snaps values to a symmetric per-leaf int8 grid and stores
+    the result bf16 (paged.quant.quantize_leaf) because no executor
+    matmul consumes raw int8 operands."""
+    if weight_dtype == "int8":
+        from flexflow_tpu.paged.quant import quantize_leaf
+
+        return quantize_leaf(arr)
+    name = _WEIGHT_DTYPE_NAMES.get(weight_dtype)
+    if name is None:
+        raise ValueError(
+            f"unknown weight_dtype {weight_dtype!r}; expected one of "
+            f"{sorted(set(_WEIGHT_DTYPE_NAMES))} or 'int8'")
+    return arr.astype(jnp.dtype(name))
+
+
 class _TracedStep:
     """Jitted step function wrapped in an fftrace span (obs.span) so
     train/eval steps land on the host trace next to the serving ticks.
@@ -311,7 +337,8 @@ class Executor:
                 (tr if spec_decl.trainable else ntr).setdefault(key, {})[name] = sh
         return tr, ntr
 
-    def init_params(self, rng, overrides: Optional[Dict] = None):
+    def init_params(self, rng, overrides: Optional[Dict] = None,
+                    weight_dtype: Optional[str] = None):
         """Initialize (trainable, nontrainable) param pytrees, resharding
         each weight to its strategy NamedSharding as it is drawn. The
         draws run UNPARTITIONED on purpose: under GSPMD a sharded
@@ -323,7 +350,15 @@ class Executor:
         identity). Values first, layout second — leaf by leaf, so the
         whole model never resides unsharded on one device.
         `overrides` maps node_key -> weight name -> Initializer (the layer
-        methods' kernel_initializer arguments)."""
+        methods' kernel_initializer arguments).
+
+        `weight_dtype` optionally casts every leaf AFTER the draw, for
+        serving-memory streaming: a float name ("bf16"/"fp16"/"fp8")
+        stores the leaf at that dtype (use sites re-cast to compute
+        dtype), while "int8" applies per-leaf symmetric fake
+        quantization (paged.quant.quantize_leaf — values snap to the
+        int8 grid, stored bf16, since no executor matmul consumes raw
+        int8). Leave None for the fp32-master training default."""
         specs = self.weight_specs()
         overrides = overrides or {}
 
@@ -350,6 +385,8 @@ class Executor:
                 if dtype == jnp.bfloat16 or dtype == jnp.float16:
                     dtype = jnp.float32
                 arr = ini(sub, spec.shape.dims, dtype)
+                if weight_dtype is not None:
+                    arr = _cast_weight_leaf(arr, weight_dtype)
                 sh = (tr_sh if spec.trainable else ntr_sh)[nk][wn]
                 d = tr if spec.trainable else ntr
                 d.setdefault(nk, {})[wn] = jax.device_put(arr, sh)
@@ -737,7 +774,15 @@ class Executor:
         """Shape/dtype specs (jax.ShapeDtypeStruct) of the paged K/V
         pools init_paged_kv_cache materializes — also the abstract
         arguments lowered_modules() feeds the paged entry points, so the
-        audit lowering and the real server always agree on shapes."""
+        audit lowering and the real server always agree on shapes. A
+        QUANTIZED pool dtype (int8) adds the per-(page, head) scale
+        sidecar entries "k_scale"/"v_scale" — (num_pages, num_kv)
+        float32 — to every node's dict (paged/quant.py has the layout
+        story); putting them inside the same dict is what lets the COW
+        clone, the defrag permutation, the megastep carry and the spec
+        commit move scales with their pages by construction."""
+        from flexflow_tpu.paged.quant import is_quantized_dtype
+
         specs = {}
         for n in self.topo:
             if n.op_type == OpType.PIPELINE:
@@ -758,6 +803,12 @@ class Executor:
                 "k": jax.ShapeDtypeStruct(shape, dt),
                 "v": jax.ShapeDtypeStruct(shape, dt),
             }
+            if is_quantized_dtype(dt):
+                sshape = (num_pages, n.attrs.num_kv)
+                specs[node_key(n)]["k_scale"] = jax.ShapeDtypeStruct(
+                    sshape, jnp.float32)
+                specs[node_key(n)]["v_scale"] = jax.ShapeDtypeStruct(
+                    sshape, jnp.float32)
         if not specs:
             raise ValueError(
                 "paged decode needs attention nodes (MULTIHEAD_ATTENTION "
@@ -974,9 +1025,32 @@ class Executor:
         page table; unused entries point a row at itself (a no-op copy),
         so one fixed-shape program serves every acceptance outcome.
         Rejected rows are NOT touched — they sit past the advanced write
-        head and are masked like any stale page content."""
+        head and are masked like any stale page content.
+
+        On a QUANTIZED pool (scale sidecar present, paged/quant.py) the
+        copy is scale-aware: destination pages first GROW their scales
+        to cover the incoming source rows (re-quantizing their existing
+        rows in place, the same grow-only discipline as append), then
+        each copied row dequantizes at its source page's scale and
+        re-quantizes at the destination's. Unused self-copy entries stay
+        exact — the scale ratio is 1 and the int grid round-trips."""
         if self._paged_commit_fn is not None:
             return self._paged_commit_fn
+
+        def _copy_rows_quant(buf, sc, sp, so, dp, do):
+            f32 = jnp.float32
+            sc2 = sc.at[dp].max(sc[sp])
+            old_d, new_d = sc[dp], sc2[dp]            # (slots, C, Hkv)
+            ratio = jnp.where(new_d > 0,
+                              old_d / jnp.maximum(new_d, 1e-30), 0.0)
+            blk = buf[dp].astype(f32) * ratio[:, :, None, :, None]
+            buf = buf.at[dp].set(
+                jnp.clip(jnp.round(blk), -127, 127).astype(buf.dtype))
+            den = jnp.where(new_d > 0, new_d, 1.0)[..., None]
+            row = buf[sp, so].astype(f32) * sc2[sp][..., None] / den
+            buf = buf.at[dp, do].set(
+                jnp.clip(jnp.round(row), -127, 127).astype(buf.dtype))
+            return buf, sc2
 
         def commit(caches, page_tables, src, dst):
             bidx = jnp.arange(src.shape[0])[:, None]
@@ -985,10 +1059,17 @@ class Executor:
                 P = bufs["k"].shape[1]
                 sp, so = page_tables[bidx, src // P], src % P
                 dp, do = page_tables[bidx, dst // P], dst % P
-                out[key] = {
-                    n: bufs[n].at[dp, do].set(bufs[n][sp, so])
-                    for n in ("k", "v")
-                }
+                if "k_scale" in bufs:
+                    ent = {}
+                    for n in ("k", "v"):
+                        ent[n], ent[n + "_scale"] = _copy_rows_quant(
+                            bufs[n], bufs[n + "_scale"], sp, so, dp, do)
+                    out[key] = ent
+                else:
+                    out[key] = {
+                        n: bufs[n].at[dp, do].set(bufs[n][sp, so])
+                        for n in ("k", "v")
+                    }
             return out
 
         self._paged_commit_fn = jax.jit(commit)
@@ -1102,7 +1183,8 @@ class Executor:
     def lowered_modules(self, entries: Optional[Sequence[str]] = None, *,
                         slots: int = 2, page_size: int = 16,
                         num_pages: Optional[int] = None,
-                        max_nodes: int = 8):
+                        max_nodes: int = 8,
+                        kv_dtype: Optional[str] = None):
         """Named AOT lowerings of the real jitted entry points, traced on
         abstract arguments — nothing is allocated or executed. Returns
         {entry_name: jax.stages.Lowered}; callers .compile() each one to
@@ -1112,7 +1194,10 @@ class Executor:
         `entries` defaults to train_step + eval_step, plus
         paged_decode_fn + verify_fn when can_paged_decode(). The paged
         shapes (slots / page_size / pool size / tree width) only scale
-        the audit's byte counts, not which collectives appear."""
+        the audit's byte counts, not which collectives appear.
+        `kv_dtype` lowers the paged entries against a quantized pool
+        ("int8" adds the scale sidecar to the cache avals, paged/quant)
+        so the audit prices the int8 payload bytes, not the fp ones."""
         known = ("train_step", "eval_step", "paged_decode", "verify")
         if entries is None:
             entries = ["train_step", "eval_step"]
@@ -1141,7 +1226,10 @@ class Executor:
             max_pages = -(-(seq + max_nodes) // page_size)
             pages = (num_pages if num_pages is not None
                      else slots * max_pages + 1)
-            caches = self.paged_kv_cache_specs(pages, page_size)
+            from flexflow_tpu.paged.quant import resolve_kv_dtype
+
+            caches = self.paged_kv_cache_specs(
+                pages, page_size, dtype=resolve_kv_dtype(kv_dtype))
             tables = jax.ShapeDtypeStruct((slots, max_pages), jnp.int32)
             pos = jax.ShapeDtypeStruct((slots,), jnp.int32)
             if "paged_decode" in entries:

@@ -905,36 +905,87 @@ class TickPricer:
         return comp + self.host_dispatch_s
 
 
-def kv_cache_token_bytes(graph: Graph,
-                         strategy: Optional[Dict[str, ShardingView]] = None,
-                         axis_sizes: Optional[Dict[str, int]] = None) -> int:
-    """Per-chip K/V-cache bytes ONE token row occupies across all
-    attention layers: 2 (K and V) x num_kv x head_dim x dtype bytes per
-    layer, divided by the head-parallel degree the strategy shards wk/wv
-    over. This is what prices the paged pool against the HBM budget in
-    the serving-strategy search: pool_pages x page_size x this = resident
-    cache bytes (the hlo-hbm-budget counterpart for serving state)."""
-    total = 0
+def _kv_cache_node_rows(graph: Graph,
+                        strategy: Optional[Dict[str, ShardingView]],
+                        axis_sizes: Optional[Dict[str, int]]):
+    """Yield (elems_per_token, kv_rows, model_dtype_bytes, head_degree)
+    per cached-attention node: elems_per_token = 2 * num_kv * head_dim
+    (x layers for stacked blocks), kv_rows = 2 * num_kv (x layers) — the
+    per-page scale-sidecar entry count for a quantized pool."""
     for node in graph.nodes:
         attrs = node.attrs
         if node.op_type in (OpType.MULTIHEAD_ATTENTION,
                             OpType.RING_ATTENTION) \
                 and attrs is not None and hasattr(attrs, "num_kv"):
-            row = 2 * int(attrs.num_kv) * int(attrs.kdim)
+            kv_rows = 2 * int(attrs.num_kv)
+            elems = kv_rows * int(attrs.kdim)
         elif node.op_type == OpType.PIPELINE and attrs is not None \
                 and hasattr(attrs, "kv_heads"):
             # stacked decoder blocks: `layers` caches behind one node
             embed = int(node.outputs[0].dims[-1])
             head_dim = embed // max(int(attrs.heads), 1)
-            row = 2 * int(attrs.kv_heads) * head_dim * int(attrs.layers)
+            kv_rows = 2 * int(attrs.kv_heads) * int(attrs.layers)
+            elems = kv_rows * head_dim
         else:
             continue
-        row *= node.outputs[0].dtype.size_bytes
         deg = 1
         if strategy is not None and axis_sizes:
             view = strategy.get(node.name, node.sharding)
             if view is not None:
                 deg = max(spec_degree(view.weight_specs.get("wk"),
                                       axis_sizes), 1)
+        yield elems, kv_rows, node.outputs[0].dtype.size_bytes, deg
+
+
+def kv_cache_elem_counts(graph: Graph,
+                         strategy: Optional[Dict[str, ShardingView]] = None,
+                         axis_sizes: Optional[Dict[str, int]] = None
+                         ) -> Tuple[int, int]:
+    """Per-chip (K/V elements one token row occupies, scale-sidecar
+    entries one PAGE carries) across all attention layers — the
+    dtype-independent counts the serving pricer multiplies by a
+    kv_dtype's itemsize (paged.quant.KV_DTYPES) to price a quantized
+    pool without re-walking the graph per candidate strategy."""
+    elems_total = 0
+    scale_total = 0
+    for elems, kv_rows, _, deg in _kv_cache_node_rows(graph, strategy,
+                                                      axis_sizes):
+        elems_total += -(-elems // deg)
+        scale_total += -(-kv_rows // deg)
+    return elems_total, scale_total
+
+
+def kv_cache_token_bytes(graph: Graph,
+                         strategy: Optional[Dict[str, ShardingView]] = None,
+                         axis_sizes: Optional[Dict[str, int]] = None,
+                         kv_dtype: Optional[str] = None,
+                         page_size: Optional[int] = None) -> int:
+    """Per-chip K/V-cache bytes ONE token row occupies across all
+    attention layers: 2 (K and V) x num_kv x head_dim x dtype bytes per
+    layer, divided by the head-parallel degree the strategy shards wk/wv
+    over. This is what prices the paged pool against the HBM budget in
+    the serving-strategy search: pool_pages x page_size x this = resident
+    cache bytes (the hlo-hbm-budget counterpart for serving state).
+
+    `kv_dtype` (a ServeStrategy knob value, paged.quant.KV_DTYPES)
+    overrides the model dtype the pool stores K/V at; a quantized dtype
+    additionally bills the per-page scale sidecar amortized over
+    `page_size` tokens (2 x num_kv float32 entries per page per layer) —
+    mispricing int8 pages at fp32 would make every quantized strategy
+    look 4x more expensive than the pool it actually allocates."""
+    from flexflow_tpu.paged.quant import SCALE_BYTES, kv_dtype_info
+
+    info = kv_dtype_info(kv_dtype)
+    total = 0
+    for elems, kv_rows, dtype_bytes, deg in _kv_cache_node_rows(
+            graph, strategy, axis_sizes):
+        row = elems * (dtype_bytes if info is None else info[1])
         total += -(-row // deg)
+        if info is not None and info[2]:
+            if not page_size or page_size < 1:
+                raise ValueError(
+                    "kv_cache_token_bytes needs page_size to amortize the "
+                    f"scale sidecar of quantized kv_dtype {kv_dtype!r}")
+            scale_row = -(-(kv_rows * SCALE_BYTES) // deg)
+            total += -(-scale_row // int(page_size))
     return total

@@ -49,6 +49,7 @@ from flexflow_tpu.search.cost_model import (
     HOST_DISPATCH_SECONDS,
     TickPricer,
     graph_cost,
+    kv_cache_elem_counts,
     kv_cache_token_bytes,
 )
 from flexflow_tpu.search.table import StrategyTable, coordinate_descent
@@ -87,7 +88,11 @@ class ServeStrategy:
     mesh layout as sorted (axis, size) pairs, () = the compiled mesh.
     pool_fraction scales the page pool against the dense capacity
     (slots x pages-per-seq) — the HBM knob; 1.0 keeps the server
-    default."""
+    default. kv_dtype picks the pool's storage dtype
+    (paged.quant.KV_DTYPES; "auto" = the model's own dtype, "int8" =
+    quantized pages with the per-page scale sidecar) — the OTHER HBM
+    knob, trading bytes per cached token against a bounded logit
+    error instead of trading pages away."""
 
     page_size: int = 64
     prefill_chunk: int = 64
@@ -96,6 +101,7 @@ class ServeStrategy:
     megastep_ticks: int = 1
     ragged_pack: bool = True
     pool_fraction: float = 1.0
+    kv_dtype: str = "auto"
     mesh: Tuple[Tuple[str, int], ...] = ()
 
     def validate(self, max_len: Optional[int] = None) -> None:
@@ -120,6 +126,10 @@ class ServeStrategy:
             raise ValueError(
                 "speculative decoding and megastep_ticks > 1 are mutually "
                 "exclusive (the fused decode loop cannot host verify ticks)")
+        # typo'd dtypes fail HERE, not as a silently-fp32 served pool
+        from flexflow_tpu.paged.quant import kv_dtype_info
+
+        kv_dtype_info(self.kv_dtype)
         if max_len is not None and self.page_size > max_len:
             raise ValueError(
                 f"page_size {self.page_size} exceeds max_len {max_len}")
@@ -149,6 +159,7 @@ class ServeStrategy:
             "megastep_ticks": self.megastep_ticks,
             "num_pages": num_pages,
             "speculate": self.spec_config(),
+            "kv_dtype": self.kv_dtype,
         }
 
     def describe(self) -> str:
@@ -158,7 +169,7 @@ class ServeStrategy:
         return (f"page {self.page_size} + chunk {self.prefill_chunk} + "
                 f"megastep {self.megastep_ticks} + {spec} + "
                 f"{'packed' if self.ragged_pack else 'legacy'} + "
-                f"pool {self.pool_fraction:g} + {mesh}")
+                f"pool {self.pool_fraction:g} + kv {self.kv_dtype} + {mesh}")
 
     def to_json(self) -> Dict:
         d = dataclasses.asdict(self)
@@ -266,6 +277,12 @@ class PricedLayout:
     mem_bytes: float
     kv_token_bytes: int
     mode: str
+    # dtype-independent counts (cost_model.kv_cache_elem_counts) so the
+    # pricer can re-bill the pool per candidate kv_dtype without
+    # re-walking the graph: K/V elements per token row, and scale-
+    # sidecar entries per PAGE when the dtype is quantized
+    kv_token_elems: int = 0
+    kv_scale_elems: int = 0
 
     @property
     def mesh_key(self) -> Tuple[Tuple[str, int], ...]:
@@ -306,13 +323,15 @@ def price_layouts(graph, cost, layouts: Sequence[Dict[str, int]], *,
             strategy = space_mod.default_dp_strategy(graph, cm.axis_sizes)
         step_s, mode = step_seconds(graph, strategy, cm, training=False)
         gc = graph_cost(graph, strategy, cm, training=False)
+        elems, scale_elems = kv_cache_elem_counts(graph, strategy,
+                                                  cm.axis_sizes)
         priced.append(PricedLayout(
             axis_sizes=dict(axis_sizes), strategy=strategy,
             step_s=step_s, base_tokens=graph_tokens(graph),
             mem_bytes=gc.memory_per_chip,
             kv_token_bytes=kv_cache_token_bytes(graph, strategy,
                                                 cm.axis_sizes),
-            mode=mode))
+            mode=mode, kv_token_elems=elems, kv_scale_elems=scale_elems))
     return priced
 
 
@@ -436,6 +455,17 @@ class ServePricer:
         chunks_p95 = max(math.ceil(uncached_p95 / chunk), 1)
         ttft = chunks_p95 * t_mixed + self.host_dispatch_s
 
+        # -- the KV pool's HBM bill, at the strategy's storage dtype ----
+        from flexflow_tpu.paged.quant import SCALE_BYTES, kv_dtype_info
+
+        info = kv_dtype_info(s.kv_dtype)
+        if info is None:
+            kv_token_b = lay.kv_token_bytes
+        else:
+            kv_token_b = lay.kv_token_elems * info[1]
+            if info[2]:  # quantized: scale sidecar amortized per page
+                kv_token_b += -(-lay.kv_scale_elems * SCALE_BYTES // page)
+
         # -- request lifetime + throughput ------------------------------
         t_request = (chunks_mean * t_mixed
                      + (new_t / tokens_per_dispatch) * t_disp)
@@ -452,7 +482,8 @@ class ServePricer:
         return {
             "ttft_p95_s": ttft,
             "tokens_per_s": tokens_per_s,
-            "hbm_bytes": lay.mem_bytes + pool_tokens * lay.kv_token_bytes,
+            "hbm_bytes": lay.mem_bytes + pool_tokens * kv_token_b,
+            "kv_token_bytes": float(kv_token_b),
             "pool_pages": float(pages),
             "pool_occupancy": occupancy,
             "live_rows": live,
@@ -494,6 +525,7 @@ def default_space(*, max_len: int) -> Dict[str, List]:
         "megastep_ticks": [1, 2, 4, 8, 16],
         "ragged_pack": [True, False],
         "pool_fraction": [1.0, 0.75, 0.5, 0.25],
+        "kv_dtype": ["auto", "int8"],
     }
 
 
@@ -667,6 +699,7 @@ def search_serve_strategy(
         "megastep_ticks": default.megastep_ticks,
         "ragged_pack": default.ragged_pack,
         "pool_fraction": default.pool_fraction,
+        "kv_dtype": default.kv_dtype,
     }
     for name, dval in defaults.items():
         vals = values.setdefault(name, [dval])
@@ -674,7 +707,7 @@ def search_serve_strategy(
             vals.insert(0, dval)
     knobs = [(name, values[name]) for name in
              ("page_size", "prefill_chunk", "spec", "megastep_ticks",
-              "ragged_pack", "pool_fraction")]
+              "ragged_pack", "pool_fraction", "kv_dtype")]
     if len(priced) > 1:
         knobs.append(("mesh", [lay.mesh_key for lay in priced]))
     table = _knob_table(knobs)

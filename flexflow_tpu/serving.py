@@ -870,6 +870,7 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                      ragged_pack: bool = True,
                      megastep_ticks: int = 1,
                      request_record_limit: Optional[int] = None,
+                     kv_dtype: str = "auto",
                      serve_strategy=None,
                      search_budget: Optional[int] = None,
                      traffic="smoke") -> "_GenerationServerBase":
@@ -924,6 +925,13 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
     .MAX_REQUEST_RECORDS); cumulative counters and histograms are
     unaffected.
 
+    `kv_dtype` (paged only) sets the KV pool's storage dtype: "auto"
+    (default) pools at the model dtype; "int8" stores QUANTIZED pages
+    with per-(page, head) scales and dequant-on-load in both attention
+    paths (docs/paged.md "Quantized KV pages") — the same HBM budget
+    holds ~4x the fp32 pages, at a bounded greedy logit tolerance;
+    "bf16"/"fp16"/"fp32" are plain storage casts.
+
     `search_budget=N` runs the serving-strategy search
     (flexflow_tpu.search.servesearch, docs/search.md) for N anneal
     iterations against the `traffic` profile (a name from
@@ -956,6 +964,7 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
         ragged_pack = kw["ragged_pack"]
         megastep_ticks = kw["megastep_ticks"]
         speculate = kw["speculate"]
+        kv_dtype = kw["kv_dtype"]
         if kw["num_pages"] is not None:
             num_pages = kw["num_pages"]
     megastep_ticks = int(megastep_ticks)
@@ -979,7 +988,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             seed=seed, page_size=page_size, num_pages=num_pages,
             preemption=preemption, prefix_cache=prefix_cache,
             prefill_chunk=prefill_chunk, ragged_pack=ragged_pack,
-            request_record_limit=request_record_limit)
+            request_record_limit=request_record_limit,
+            kv_dtype=kv_dtype)
     if paged:
         from flexflow_tpu.paged.scheduler import PagedGenerationServer
 
@@ -988,7 +998,11 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             page_size=page_size, num_pages=num_pages, preemption=preemption,
             prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
             ragged_pack=ragged_pack, megastep_ticks=megastep_ticks,
-            request_record_limit=request_record_limit)
+            request_record_limit=request_record_limit,
+            kv_dtype=kv_dtype)
+    if kv_dtype != "auto":
+        raise ValueError(
+            "kv_dtype rides the paged KV pool; pass paged=True")
     return GenerationServer(ff, slots=slots, max_len=max_len, eos_id=eos_id,
                             seed=seed,
                             request_record_limit=request_record_limit)

@@ -55,7 +55,8 @@ class SpeculativePagedServer(PagedGenerationServer):
                  num_pages: Optional[int] = None, preemption: bool = True,
                  prefix_cache: bool = True, prefill_chunk: int = 64,
                  ragged_pack: bool = True,
-                 request_record_limit: Optional[int] = None):
+                 request_record_limit: Optional[int] = None,
+                 kv_dtype: str = "auto"):
         if not isinstance(spec, SpecConfig):
             raise TypeError(
                 f"speculate must be a SpecConfig, got {type(spec).__name__}")
@@ -78,7 +79,8 @@ class SpeculativePagedServer(PagedGenerationServer):
                          prefix_cache=prefix_cache,
                          prefill_chunk=prefill_chunk,
                          ragged_pack=ragged_pack,
-                         request_record_limit=request_record_limit)
+                         request_record_limit=request_record_limit,
+                         kv_dtype=kv_dtype)
         # per-tick draft acceptance rate (accepted / drafted this tick)
         self._h_accept = self.registry.histogram("spec_acceptance",
                                                  obs.RATIO_BUCKETS)
@@ -298,6 +300,13 @@ class SpeculativePagedServer(PagedGenerationServer):
                                         self._tables_device(),
                                         jnp.asarray(src),  # fflint: host-ok (per-tick batch transfer)
                                         jnp.asarray(dst))  # fflint: host-ok (per-tick batch transfer)
+            if self._caches_ref is not None:
+                # quant-debug shadow (scheduler._launch) must see the
+                # same accepted-row commit; the fp pool takes the plain
+                # copy path inside the same jitted program
+                self._caches_ref = self._commit(
+                    self._caches_ref, self._tables_device(),
+                    jnp.asarray(src), jnp.asarray(dst))  # fflint: host-ok (per-tick batch transfer)
             for s in live:
                 # publish AFTER the commit: only rows below the advanced
                 # write head are committed K/V — tree scratch rows past

@@ -1141,6 +1141,69 @@ def test_poolcheck_flags_spec_scratch_registered_before_commit():
     assert any(v.split(":")[0] == "spec-scratch" for v in replayed)
 
 
+@pytest.mark.parametrize("mutation", ["scale_cow_drop",
+                                      "scale_realloc_leak",
+                                      "scale_defrag_drop"])
+def test_poolcheck_flags_dropped_scale_sidecar_rewrite(mutation):
+    """Seeded defects 4-6: each way the quantized pool's scale sidecar
+    can stop following its pages — the COW clone copying payload but
+    not scale, an allocation leaking the previous tenant's scale, and a
+    defrag that permutes payloads but leaves scales at the old slots —
+    must trip the scale-sidecar invariant with a minimal replayable
+    trace."""
+    from flexflow_tpu.analysis import poolcheck
+
+    res = poolcheck.model_check("base", mutations=(mutation,))
+    assert any(h[0] == "scale-sidecar" for h in res.hits), (mutation,
+                                                           res.hits)
+    _n, msg, trace = next(h for h in res.hits
+                          if h[0] == "scale-sidecar")
+    assert "does not match its content state" in msg
+    replayed = poolcheck.replay(trace, "base", mutations=(mutation,))
+    assert any(v.split(":")[0] == "scale-sidecar" for v in replayed), \
+        (trace, replayed)
+
+
+def test_kv_pricing_dtype_misprice_fixture():
+    """Seeded dtype mispricing: an int8 KV pool priced at the model
+    dtype looks ~4x bigger than the buffers the executor actually
+    allocates — the hloaudit priced-vs-lowered philosophy applied to
+    the serving pool. The dtype-aware kv_cache_token_bytes must match
+    the real int8+sidecar allocation EXACTLY (page_size chosen so the
+    per-page scale bytes divide evenly), and the fp32 figure must show
+    the >=3.5x misprice the kv_dtype parameter exists to fix."""
+    from flexflow_tpu import FFConfig, FFModel, LossType
+    from flexflow_tpu.ffconst import DataType
+    from flexflow_tpu.models.llama import LlamaConfig, build_llama
+    from flexflow_tpu.paged.quant import resolve_kv_dtype
+    from flexflow_tpu.search.cost_model import (kv_cache_elem_counts,
+                                                kv_cache_token_bytes)
+
+    ff = FFModel(FFConfig(batch_size=1))
+    build_llama(ff, LlamaConfig.tiny(vocab=256), batch_size=1, seq_len=8,
+                dtype=DataType.FLOAT)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    num_pages, page_size = 6, 8  # 2*kv_heads*4 = 16 scale B % 8 == 0
+    specs = ff.executor.paged_kv_cache_specs(
+        num_pages, page_size, dtype=resolve_kv_dtype("int8"))
+    actual = sum(s.size * s.dtype.itemsize
+                 for bufs in specs.values() for s in bufs.values())
+    actual_per_token = actual // (num_pages * page_size)
+
+    priced_q = kv_cache_token_bytes(ff.graph, kv_dtype="int8",
+                                    page_size=page_size)
+    assert priced_q == actual_per_token, (priced_q, actual_per_token)
+    # the misprice the fixture seeds: same pool billed at the model dtype
+    priced_fp = kv_cache_token_bytes(ff.graph)
+    assert priced_fp >= 3.5 * priced_q, (priced_fp, priced_q)
+    # elem counts feed the servesearch pricer the same split
+    elems, scale_elems = kv_cache_elem_counts(ff.graph)
+    assert priced_q == elems + (scale_elems * 4) // page_size
+    # a quantized dtype cannot be priced without the page amortizer
+    with pytest.raises(ValueError, match="page_size"):
+        kv_cache_token_bytes(ff.graph, kv_dtype="int8")
+
+
 def test_poolcheck_pass_reports_findings_summary_and_traces(tmp_path):
     """Pass-function level: a seeded defect surfaces as an inv-* error
     Finding with the minimal counterexample in the message, the trace

@@ -30,9 +30,12 @@ from flexflow_tpu.search.cost_model import (
 )
 from flexflow_tpu.search.machine_model import TPUMachineModel
 from flexflow_tpu.search.servesearch import (
+    PricedLayout,
     ServeObjective,
+    ServePricer,
     ServeSearchResult,
     ServeStrategy,
+    default_space,
     load_calibration,
     search_serve_strategy,
 )
@@ -169,6 +172,44 @@ def test_strategy_json_roundtrip():
                       mesh=(("data", 2), ("model", 4)))
     assert ServeStrategy.from_json(s.to_json()) == s
     assert ServeStrategy.from_json(json.loads(json.dumps(s.to_json()))) == s
+
+
+def test_strategy_kv_dtype_knob_surface():
+    """The kv_dtype knob: validated at strategy level (a typo fails the
+    search proposal, never a silently-fp32 served pool), threaded into
+    the server kwargs, shown in describe(), searchable, and absent from
+    OLD persisted strategies (which load as "auto")."""
+    s = ServeStrategy(page_size=32, kv_dtype="int8")
+    s.validate(max_len=128)
+    assert s.to_server_kwargs(slots=4, max_len=128)["kv_dtype"] == "int8"
+    assert "kv int8" in s.describe()
+    with pytest.raises(ValueError, match="kv_dtype"):
+        ServeStrategy(kv_dtype="int7").validate(max_len=128)
+    assert ServeStrategy.from_json(s.to_json()) == s
+    old = s.to_json()
+    old.pop("kv_dtype")
+    assert ServeStrategy.from_json(old).kv_dtype == "auto"
+    assert "kv_dtype" in default_space(max_len=128)
+
+
+def test_pricer_rebills_pool_per_kv_dtype():
+    """ServePricer re-prices the pool's HBM bill from the layout's
+    dtype-independent element counts: int8 bills 1 byte/elem plus the
+    per-page scale sidecar, bf16 bills 2 bytes/elem, auto keeps the
+    model-dtype bytes — all without re-walking the graph."""
+    lay = PricedLayout(axis_sizes={}, strategy={}, step_s=1e-3,
+                       base_tokens=256, mem_bytes=1e6, kv_token_bytes=512,
+                       mode="test", kv_token_elems=128, kv_scale_elems=16)
+    stats = traffic_mod.get_profile("smoke").prompt_stats()
+    pr = ServePricer([lay], stats, slots=4, max_len=128)
+    auto = pr.metrics(ServeStrategy(page_size=32))
+    q = pr.metrics(ServeStrategy(page_size=32, kv_dtype="int8"))
+    bf = pr.metrics(ServeStrategy(page_size=32, kv_dtype="bf16"))
+    assert auto["kv_token_bytes"] == 512.0
+    # 128 int8 payload bytes + ceil(16 scales * 4 B / 32-token page)
+    assert q["kv_token_bytes"] == 128.0 + 2.0
+    assert bf["kv_token_bytes"] == 256.0
+    assert q["hbm_bytes"] < bf["hbm_bytes"] < auto["hbm_bytes"]
 
 
 # ---------------------------------------------------------------------------
