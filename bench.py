@@ -396,6 +396,22 @@ def bench_decode() -> dict:
     # (fftrace/obs.metrics) — no tracing needed for these
     tick_h = plain_m["histograms"]["tick_latency_s"]
 
+    # TTFT compile/serve split (shapecheck runtime arm): percentiles
+    # over ALL requests including the warm-ups — those pay the
+    # first-compile cost, so incl-vs-excl is exactly what catalog
+    # warming (Server.warm_launch_shapes) saves a cold first request
+    recs = [r for r in plain_m["requests"] if r["ttft_s"] is not None]
+    ttft_split = {
+        "ttft_p95_incl_compile_s": round(float(np.percentile(
+            [r["ttft_s"] for r in recs], 95)), 6),
+        "ttft_p95_excl_compile_s": round(float(np.percentile(
+            [r.get("ttft_excl_compile_s", r["ttft_s"]) for r in recs],
+            95)), 6),
+        "first_compile_s_max": round(max(
+            (r.get("first_compile_s") or 0.0) for r in recs), 6),
+        "compile": plain_m.get("compile", {}),
+    }
+
     # shared-system-prompt fixture: every request opens with the same
     # system prefix, so the prefix cache serves the bulk of prefill for
     # the second and later requests — report TTFT p50/p95 and the hit
@@ -723,6 +739,7 @@ def bench_decode() -> dict:
         "decode_tokens": toks,
         "tick_latency_p50_s": round(float(tick_h["p50"]), 6),
         "tick_latency_p95_s": round(float(tick_h["p95"]), 6),
+        "ttft_compile_split": ttft_split,
         "calibration": calibration,
         "prefix_cache": prefix_metrics,
         "ragged_packing": ragged_ab,

@@ -5,7 +5,7 @@ Unity-style search is only trustworthy while its invariants hold; round-5
 review enforced them by human advisor (two cost-model/lowering pricing
 divergences shipped, 377/408 corpus rules silently inert with no tool to
 say why). This subsystem turns those recurring review findings into a CI
-gate. Five passes ship (registered like op lowerings, so future PRs add
+gate. Six passes ship (registered like op lowerings, so future PRs add
 passes, not frameworks):
 
   consistency — strategy/sharding algebra per node: degrees divide dims,
@@ -32,6 +32,13 @@ passes, not frameworks):
       minimal counterexample traces; plus an AST lint arm for
       write-after-share, page-table, pool-encapsulation, and
       lock-discipline hazards (pragma-annotatable like hostsync).
+  shapecheck  — the launch-shape-space auditor: a taint arm classifying
+      every symbolic width feeding a jit launch as clamped/unbounded, an
+      enumeration arm computing the closed per-config catalog of
+      reachable launch shapes (the upper bound on XLA compilations,
+      budget-gated), and a soundness arm diffing runtime compile events
+      (obs.compile_tracker) against the catalog — steady-state serving
+      provably never recompiles.
 
 CLI: tools/fflint.py (--json, --strict, per-pass selection, --sarif);
 tier-1 gates on zero strict findings via tests/test_analysis.py. See
@@ -101,6 +108,13 @@ class AnalysisContext:
     # model-check summary (explored/distinct states per config), filled
     # by the pass
     poolcheck_summary: Optional[Dict] = None
+    # shapecheck controls: compile budget per served config (None =
+    # shapecheck.DEFAULT_SHAPE_BUDGET) and config overrides
+    # ({name: enumerate_catalog kwargs}; None = DEFAULT_CONFIGS)
+    shapecheck_budget: Optional[int] = None
+    shapecheck_configs: Optional[Dict] = None
+    # shape catalogs + jit entry-point inventory, filled by the pass
+    shapecheck_summary: Optional[Dict] = None
 
 
 @dataclasses.dataclass
@@ -178,6 +192,7 @@ def _ensure_registered() -> None:
         hostsync,
         poolcheck,
         rulesat,
+        shapecheck,
     )
 
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from flexflow_tpu.obs.compile_tracker import CompileTracker
 from flexflow_tpu.obs.ledger import TickLedger, shape_key
 from flexflow_tpu.obs.metrics import (
     COUNT_BUCKETS,
@@ -87,6 +88,7 @@ def span(name: str):
 
 __all__ = [
     "COUNT_BUCKETS",
+    "CompileTracker",
     "Histogram",
     "MetricsRegistry",
     "NULL_SPAN",

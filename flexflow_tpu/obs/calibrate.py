@@ -88,8 +88,19 @@ def predict_tick_seconds(base_step_s: float, base_tokens: int, phase: str,
 
 def stamp_ledger_meta(ledger: TickLedger, ff, **extra) -> None:
     """Embed the priced base step (and any caller context, e.g. model
-    name) into ledger.meta so the saved ledger is self-contained."""
+    name) into ledger.meta so the saved ledger is self-contained. When
+    the executor's CompileTracker has recorded events, their median
+    per-compile wall time rides along too — `servesearch explain`
+    prices each candidate strategy's warmup as catalog size × this
+    median."""
     ledger.meta.update(predict_step_seconds(ff))
+    tracker = getattr(getattr(ff, "executor", None),
+                      "compile_tracker", None)
+    events = tracker.observed() if tracker is not None else []
+    if events:
+        secs = sorted(ev["seconds"] for ev in events)
+        ledger.meta["compile_seconds_p50"] = secs[len(secs) // 2]
+        ledger.meta["compile_events"] = len(secs)
     ledger.meta.update(extra)
 
 
@@ -105,6 +116,10 @@ def calibration_report(ledger: TickLedger,
       shapes:      {key: {measured p50/p95/mean, predicted_s, ratio}}
       tick_scales: {key: ratio}      — MeasuredCostModel.set_tick_calibration
       phases:      {phase: median ratio across that phase's shapes}
+      compile:     {seconds_p50, events} when the ledger was stamped on
+                   a model whose CompileTracker saw compiles — the
+                   measured per-compile price servesearch explain's
+                   compile_cost line multiplies the shape catalog by
     Ratio > 1 means reality is slower than the model prices (the usual
     direction on host-bound CPU ticks); ratio ≈ 1 means the cost model
     already prices this shape faithfully.
@@ -143,7 +158,7 @@ def calibration_report(ledger: TickLedger,
         rs = sorted(ratios)
         phases[phase] = rs[len(rs) // 2]
     now = time.time()
-    return {
+    report = {
         "version": CALIBRATION_SCHEMA_VERSION,
         "created_at_unix": float(now),
         "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now)),
@@ -151,8 +166,15 @@ def calibration_report(ledger: TickLedger,
                  "pricing_mode": src.get("pricing_mode", "unknown")},
         "meta": {k: v for k, v in ledger.meta.items()
                  if k not in ("predicted_step_s", "graph_tokens",
-                              "pricing_mode")},
+                              "pricing_mode", "compile_seconds_p50",
+                              "compile_events")},
         "shapes": shapes,
         "tick_scales": {k: v["ratio"] for k, v in shapes.items()},
         "phases": phases,
     }
+    if "compile_seconds_p50" in ledger.meta:
+        report["compile"] = {
+            "seconds_p50": float(ledger.meta["compile_seconds_p50"]),
+            "events": int(ledger.meta.get("compile_events", 0)),
+        }
+    return report

@@ -266,6 +266,24 @@ class PagedGenerationServer(_GenerationServerBase):
         self._scale_reset = reset_page_scales
         self._start()
 
+    def shape_config(self) -> dict:
+        """enumerate_catalog kwargs for this server's launch-shape space
+        (analysis.shapecheck): the pool geometry plus every knob that
+        changes which (B, W) ragged launches the scheduler can pack.
+        The speculative subclass extends with its tree dimensions."""
+        return {
+            "slots": self.slots, "max_len": self.max_len, "paged": True,
+            "page_size": self.page_size,
+            "prefill_chunk": self.prefill_chunk,
+            "ragged_pack": self.ragged_pack,
+            "megastep_ticks": self.megastep_ticks,
+            # num_pages is fixed at pool construction; the loop thread
+            # never resizes the pool
+            "num_pages": self.pool.num_pages,  # fflint: lock-ok (immutable)
+            "kv_dtype": self.kv_dtype,
+            "window_rows": self._chunk_rows,
+        }
+
     # -- capacity ---------------------------------------------------------
 
     def _peak_rows(self, prompt_len: int, max_new_tokens: int) -> int:

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from flexflow_tpu.ffconst import LossType, MetricsType, OpType
 from flexflow_tpu.ops.registry import LowerCtx, get_lowering
@@ -105,6 +106,11 @@ class _TracedStep:
 class Executor:
     """Owns the lowered step functions for one compiled PCG."""
 
+    # cap on the per-argument-tuple jit memos (paged_megastep_fn): a
+    # long-lived server churning serve strategies must not leak compiled
+    # executables; the ff_jit_cache_entries gauge watches the live count
+    JIT_CACHE_LIMIT = 8
+
     def __init__(
         self,
         graph: Graph,
@@ -170,6 +176,13 @@ class Executor:
         self._megastep_fns: Dict[Any, Any] = {}
         self._verify_fn = None
         self._paged_commit_fn = None
+        # compile-event tracker (obs/compile_tracker.py): each decode-
+        # path jit factory below hands its callable through wrap(), so
+        # XLA cache misses surface as observable events the shapecheck
+        # soundness gate diffs against the static launch-shape catalog
+        from flexflow_tpu.obs.compile_tracker import CompileTracker
+
+        self.compile_tracker = CompileTracker()
         # remat="hidden": recompute MLP hidden activations in backward
         # instead of saving them (SwiGLU gate/up/silu/mul diamonds and
         # Linear(+activation)->Linear expansion chains). At LLM shapes the
@@ -849,7 +862,8 @@ class Executor:
             )
             return out, cache_out
 
-        self._paged_decode_fn = jax.jit(step)
+        self._paged_decode_fn = self.compile_tracker.wrap(
+            "paged_decode", jax.jit(step), lambda args: args[5].shape)
         return self._paged_decode_fn
 
     def chunked_prefill_fn(self):
@@ -901,7 +915,8 @@ class Executor:
             )
             return out, cache_out
 
-        self._verify_fn = jax.jit(step)
+        self._verify_fn = self.compile_tracker.wrap(
+            "verify", jax.jit(step), lambda args: args[7].shape)
         return self._verify_fn
 
     def ragged_step_fn(self):
@@ -930,7 +945,8 @@ class Executor:
             )
             return out, cache_out
 
-        self._ragged_step_fn = jax.jit(step)
+        self._ragged_step_fn = self.compile_tracker.wrap(
+            "ragged_step", jax.jit(step), lambda args: args[8].shape)
         return self._ragged_step_fn
 
     def paged_megastep_fn(self, max_ticks: int, eos_id=None):
@@ -961,8 +977,9 @@ class Executor:
         Compiled once per (max_ticks, eos_id, slots) — table/positions
         are contents, never shapes."""
         key = (int(max_ticks), eos_id)
-        fn = self._megastep_fns.get(key)
+        fn = self._megastep_fns.pop(key, None)
         if fn is not None:
+            self._megastep_fns[key] = fn  # refresh LRU recency
             return fn
         from flexflow_tpu.serving import pick_tokens  # lazy: no cycle
 
@@ -1013,8 +1030,14 @@ class Executor:
                      jnp.zeros_like(active), rng, out0))
             return caches, out, done, rng, t
 
-        fn = jax.jit(megastep)
+        fn = self.compile_tracker.wrap(
+            "megastep", jax.jit(megastep),
+            lambda args, _n=N: (args[4].shape[0], _n))
         self._megastep_fns[key] = fn
+        while len(self._megastep_fns) > self.JIT_CACHE_LIMIT:
+            # bounded LRU: callers keep their own reference; only the
+            # memo (and, once they drop it, the executable) is let go
+            self._megastep_fns.pop(next(iter(self._megastep_fns)))
         return fn
 
     def paged_commit_fn(self):
@@ -1072,7 +1095,8 @@ class Executor:
                     }
             return out
 
-        self._paged_commit_fn = jax.jit(commit)
+        self._paged_commit_fn = self.compile_tracker.wrap(
+            "paged_commit", jax.jit(commit), lambda args: args[2].shape)
         return self._paged_commit_fn
 
     def decode_fn(self):
@@ -1091,7 +1115,8 @@ class Executor:
             )
             return out, cache_out
 
-        self._decode_fn = jax.jit(step)
+        self._decode_fn = self.compile_tracker.wrap(
+            "decode_step", jax.jit(step), lambda args: args[4].shape)
         return self._decode_fn
 
     def forward_fn(self):
@@ -1107,6 +1132,159 @@ class Executor:
 
         self._forward = jax.jit(fwd)
         return self._forward
+
+    def jit_cache_entries(self) -> int:
+        """Live jitted-callable memos this executor holds (the
+        ff_jit_cache_entries gauge): the single-slot factories plus the
+        LRU-bounded per-(max_ticks, eos_id) megastep memos."""
+        singles = (self._train_step, self._eval_step, self._forward,
+                   self._decode_fn, self._paged_decode_fn,
+                   self._ragged_step_fn, self._verify_fn,
+                   self._paged_commit_fn)
+        return (sum(1 for f in singles if f is not None)
+                + len(self._megastep_fns))
+
+    def warm_launch_shapes(self, catalog, *, params, eos_id=None) -> Dict:
+        """Pre-compile every launch shape in a shapecheck catalog
+        (analysis.shapecheck.enumerate_catalog) so first-request TTFT
+        stops paying compile cost and steady-state serving provably
+        never recompiles.
+
+        Warming is CONCRETE calls, not AOT lowering: only a real call
+        populates the jit dispatch cache the serving tick hits, so every
+        argument here reproduces the server's exact avals — int32
+        ids/pos/q_lens/tables, bool ancestor masks, float32 temps, a
+        typed rng key — against throwaway zero pools built from the
+        catalog's config (zeroed page tables point every row at the null
+        page, so the warm writes touch nothing a request will read; the
+        dummy pools are garbage the moment this returns). The megastep
+        warms with active slots whose page capacity is exhausted, so its
+        while_loop compiles fully but executes zero iterations.
+
+        The jit cache keys on each argument's COMMITTEDNESS as well as
+        its aval (a jit output is committed to its device; a fresh
+        `jnp.asarray` upload is not), so each shape warms once per
+        committedness signature the serving loop produces: pools start
+        uncommitted and become committed (jit outputs) after the first
+        launch, and the rng key turns committed once a megastep's output
+        key re-enters the host split chain. Per-tick descriptor uploads
+        stay uncommitted forever and warm that way. The committed
+        variants are real launch OUTPUTS (the first warm call's new
+        caches, the megastep's output key) so their sharding matches
+        what the serve loop feeds back — a synthetic `device_put` would
+        both miss the cache key and clash with sharded params on a
+        multi-device mesh.
+
+        Returns {"warmed_shapes", "vocab", "probs_dtype", "probs_ref",
+        "rng_ref"} — the serving layer warms its (batch, vocab) sampling
+        program (the one entry the executor does not own) from slices of
+        probs_ref and splits of rng_ref."""
+        cfg = dict(catalog.get("config", {}))
+        entries = catalog.get("entries", {})
+        tr, ntr = params
+        slots = int(cfg["slots"])
+        warmed = 0
+        # committed stand-ins come from REAL launch outputs, never
+        # jax.device_put: under a multi-device mesh a device_put'd array
+        # carries a different sharding than a jit output, which is both
+        # a wrong cache key and an incompatible-devices error when mixed
+        # with sharded params
+        probs = probs_ref = rng_ref = caches_c = None
+        if cfg.get("paged", True):
+            from flexflow_tpu.paged.quant import resolve_kv_dtype
+
+            page_size = int(cfg["page_size"])
+            cols = int(cfg["table_cols"])
+            num_pages = int(cfg["num_pages"] or slots * cols + 1)
+            pool_dt = resolve_kv_dtype(cfg.get("kv_dtype") or "auto")
+            caches_u = self.init_paged_kv_cache(num_pages, page_size,
+                                                dtype=pool_dt)
+            step = self.ragged_step_fn()
+            for B, W in entries.get(  # fflint: host-ok (one-time warmup)
+                    "ragged_step", {}).get("shapes", ()):
+                B, W = int(B), int(W)
+                tbl = (jnp.zeros((slots, cols), jnp.int32) if B == slots
+                       else jnp.take(jnp.zeros((slots, cols), jnp.int32),
+                                     jnp.asarray(
+                                         np.zeros((B,), np.int32)),
+                                     axis=0))
+                deps = jnp.asarray(np.tile(
+                    np.arange(W, dtype=np.int32), (B, 1)))
+                anc = jnp.asarray(np.tile(
+                    np.tril(np.ones((W, W), np.bool_)), (B, 1, 1)))
+                args = (tbl,
+                        jnp.asarray(np.zeros((B,), np.int32)),
+                        jnp.asarray(np.zeros((B,), np.int32)),
+                        deps, anc,
+                        jnp.asarray(np.zeros((B, W), np.int32)))
+                # pools start uncommitted (host init) and are committed
+                # launch outputs from the first tick on — warm both;
+                # the first call's output IS the serve-loop committed
+                # pool state
+                probs, caches_out = step(tr, ntr, caches_u, *args)
+                if caches_c is None:
+                    caches_c = caches_out
+                probs, _ = step(tr, ntr, caches_c, *args)
+                if probs_ref is None or B == slots:
+                    probs_ref = probs
+                warmed += 1
+            for S, N in entries.get(  # fflint: host-ok (one-time warmup)
+                    "megastep", {}).get("shapes", ()):
+                fn = self.paged_megastep_fn(int(N), eos_id)
+                z = jnp.asarray(np.zeros((int(S),), np.int32))
+                args = (jnp.zeros((int(S), cols), jnp.int32), z, z,
+                        jnp.asarray(np.zeros((int(S),), np.float32)),
+                        z, z, jnp.asarray(np.ones((int(S),), np.bool_)))
+                # a megastep always follows launches (pools committed);
+                # its rng is host-chain (uncommitted) on the first
+                # dispatch and its own output key (committed) after
+                out = fn(tr, ntr, caches_c, *args, jax.random.key(0))
+                rng_ref = out[3]
+                fn(tr, ntr, caches_c, *args, rng_ref)
+                warmed += 1
+            commit = (self.paged_commit_fn()
+                      if "paged_commit" in entries else None)
+            for S, C in entries.get(  # fflint: host-ok (one-time warmup)
+                    "paged_commit", {}).get("shapes", ()):
+                z = jnp.asarray(np.zeros((int(S), int(C)), np.int32))
+                commit(caches_c, jnp.zeros((slots, cols), jnp.int32),
+                       z, z)
+                warmed += 1
+        else:
+            max_len = int(cfg["max_len"])
+            caches_u = self.init_kv_cache(slots, max_len)
+            pre = self.init_kv_cache(1, max_len)
+            step = self.decode_fn()
+            for B, L in entries.get(  # fflint: host-ok (one-time warmup)
+                    "decode_step", {}).get("shapes", ()):
+                B, L = int(B), int(L)
+                ids = jnp.asarray(np.zeros((B, L), np.int32))
+                if B == 1 and L > 1:
+                    # admission prefill: one-slot staging cache (never
+                    # reassigned, so never committed), the literal
+                    # python 0 the admit path passes as pos
+                    probs, _ = step(tr, ntr, pre, 0, ids)
+                else:
+                    pos = jnp.asarray(np.zeros((B,), np.int32))
+                    probs, caches_out = step(tr, ntr, caches_u, pos, ids)
+                    if caches_c is None:
+                        caches_c = caches_out
+                    probs, _ = step(tr, ntr, caches_c, pos, ids)
+                    if probs_ref is None or B == slots:
+                        probs_ref = probs
+                warmed += 1
+        return {
+            "warmed_shapes": warmed,
+            "vocab": int(probs.shape[-1]) if probs is not None else None,
+            "probs_dtype": (str(probs.dtype) if probs is not None
+                            else None),
+            # real launch outputs, for the serving layer's pick warm:
+            # slicing probs_ref reproduces the exact committedness (and
+            # sharding) of the serve loop's pick inputs, and splitting
+            # rng_ref reproduces the post-megastep committed key chain
+            "probs_ref": probs_ref,
+            "rng_ref": rng_ref,
+        }
 
     # ------------------------------------------------------------------
     # AOT lowering (analysis.hloaudit ground-truth hook)

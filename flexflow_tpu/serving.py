@@ -417,7 +417,8 @@ class _GenRequest:
                  "peak_pages", "preemptions", "spec_steps", "spec_drafted",
                  "spec_accepted", "spec_emitted", "first_token_t",
                  "cached_prefill_tokens", "prefill_pos", "prefill_target",
-                 "prefill_seq", "hashed_blocks", "decode_overlap_ticks")
+                 "prefill_seq", "hashed_blocks", "decode_overlap_ticks",
+                 "compile_s_at_submit", "first_compile_s")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -431,6 +432,10 @@ class _GenRequest:
         self.submit_t = time.monotonic()
         self.admit_t: Optional[float] = None
         self.first_token_t: Optional[float] = None  # TTFT stamp
+        # jit compile seconds charged between submit and first token
+        # (obs.compile_tracker): splits TTFT into compile vs serve time
+        self.compile_s_at_submit = 0.0
+        self.first_compile_s: Optional[float] = None
         self.prefill_tokens = 0         # prompt rows actually COMPUTED
         self.cached_prefill_tokens = 0  # prompt rows served by the cache
         self.peak_pages = 0
@@ -467,11 +472,17 @@ class _GenRequest:
     def metrics(self) -> dict:
         """Per-request serving metrics (queue time covers submit -> LAST
         admission, so a preempted request's requeue wait counts too)."""
+        ttft = (self.first_token_t - self.submit_t
+                if self.first_token_t is not None else None)
         m = {
             "queue_time_s": (self.admit_t - self.submit_t
                              if self.admit_t is not None else None),
-            "ttft_s": (self.first_token_t - self.submit_t
-                       if self.first_token_t is not None else None),
+            "ttft_s": ttft,
+            "first_compile_s": self.first_compile_s,
+            "ttft_excl_compile_s": (
+                max(0.0, ttft - self.first_compile_s)
+                if ttft is not None and self.first_compile_s is not None
+                else ttft),
             "prefill_tokens": self.prefill_tokens,
             "cached_prefill_tokens": self.cached_prefill_tokens,
             "decode_tokens": len(self.tokens),
@@ -526,9 +537,23 @@ class _GenerationServerBase:
         self._params = ff._params
         self._rng = jax.random.key(seed)
 
+        # compile-event ledger (obs.compile_tracker): shared with the
+        # executor's wrapped decode entry points when present, so one
+        # tracker sees every jit compilation the serving path can cause
+        tracker = getattr(getattr(ff, "executor", None),
+                          "compile_tracker", None)
+        if tracker is None:
+            tracker = obs.CompileTracker()
+        self._compile_tracker = tracker
+        # a shared (executor-owned) tracker outlives servers: this
+        # server's compile story starts here, and its warmup phase
+        # begins regardless of what a previous server marked
+        self._compile_events_base = tracker.compile_events_total
+        tracker.mark_warmup()
         # probs_last: (B, V) — the one sampling program every decode path
         # shares (dense, paged, packed spec roots, megastep inner loop)
-        self._pick = jax.jit(pick_tokens)
+        self._pick = tracker.wrap("pick_tokens", jax.jit(pick_tokens),
+                                  lambda args: (args[0].shape[0],))
         self._queue: "queue.Queue[_GenRequest]" = queue.Queue()
         self._active: List[Optional[_GenRequest]] = [None] * self.slots
         self._tokens = np.zeros((self.slots,), np.int32)
@@ -558,6 +583,12 @@ class _GenerationServerBase:
         self._h_queue = self.registry.histogram("queue_time_s")
         self._h_tokens = self.registry.histogram("tokens_per_tick",
                                                  obs.COUNT_BUCKETS)
+        # TTFT with the request's attributable jit-compile seconds
+        # subtracted — the steady-state latency a warmed server delivers
+        self._h_ttft_excl = self.registry.histogram("ttft_excl_compile_s")
+        self._compile_tracker.set_registry(self.registry)
+        self._g_recompiles = self.registry.gauge("steady_state_recompiles")
+        self._g_jit_entries = self.registry.gauge("jit_cache_entries")
         self._thread: Optional[threading.Thread] = None
 
     def _start(self):
@@ -584,6 +615,9 @@ class _GenerationServerBase:
             raise ValueError("prompt must contain at least one token")
         self._check_capacity(prompt, max_new_tokens)
         req = _GenRequest(prompt, max_new_tokens, temperature)
+        # compile-clock baseline: compile seconds accrued later, before
+        # this request's first token, are ITS attributable compile cost
+        req.compile_s_at_submit = self._compile_tracker.compile_seconds_total
         with self._lock:
             if not self._running:
                 raise RuntimeError(f"{type(self).__name__} is stopped")
@@ -621,12 +655,91 @@ class _GenerationServerBase:
         p50/p95/p99 estimates). This dict is what http_serve's
         /v2/models/<name>/metrics endpoint serves; the same registry
         backs the Prometheus `GET /metrics` endpoint."""
+        entries = self.jit_cache_entries()
+        snap = self._compile_tracker.snapshot(self._compile_events_base)
+        self._g_recompiles.set(snap["steady_state_recompiles"])
+        self._g_jit_entries.set(entries)
+        snap["jit_cache_entries"] = entries
         return {
             "requests_served": self._served,
             "decode_steps": self._steps,
             "requests": list(self._request_metrics),
+            "compile": snap,
             "histograms": self.registry.to_json(),
         }
+
+    def jit_cache_entries(self) -> int:
+        """Jitted-callable memos alive for this server (the
+        ff_jit_cache_entries gauge): the executor's bounded caches plus
+        the server's own sampling program."""
+        ex = getattr(self.ff, "executor", None)
+        n = ex.jit_cache_entries() if hasattr(ex, "jit_cache_entries") else 0
+        return n + 1  # _pick
+
+    def compile_events(self) -> list:
+        """Compile events recorded during THIS server's lifetime —
+        the input analysis.shapecheck.check_soundness diffs against the
+        catalog (a shared executor tracker also carries earlier
+        servers' events; those are not this server's story)."""
+        return self._compile_tracker.observed(self._compile_events_base)
+
+    # -- launch-shape warmup (analysis.shapecheck runtime arm) -----------
+
+    def shape_config(self) -> dict:
+        """enumerate_catalog kwargs describing THIS server's launch-shape
+        space; subclasses override (paged adds pool geometry, spec adds
+        tree width). The dense server's space is the slot-decode shape
+        plus the pow2 admission-prefill buckets."""
+        return {"slots": self.slots, "max_len": self.max_len,
+                "paged": False}
+
+    def warm_launch_shapes(self, catalog: Optional[dict] = None,
+                           mark_steady: bool = True) -> dict:
+        """Pre-compile every launch shape this server can dispatch
+        (executor.warm_launch_shapes against the shapecheck catalog, then
+        the sampling program at its catalog widths), and — by default —
+        mark the compile tracker steady-state: any compilation after this
+        returns counts as a `steady_state_recompiles` event, the number
+        the CI soundness gate pins at zero. Call before taking traffic;
+        returns the catalog served (callers hand it to
+        analysis.shapecheck.check_soundness)."""
+        import jax
+        import jax.numpy as jnp
+
+        if catalog is None:
+            from flexflow_tpu.analysis.shapecheck import enumerate_catalog
+
+            catalog = enumerate_catalog(**self.shape_config())
+        info = self.ff.executor.warm_launch_shapes(
+            catalog, params=self._params, eos_id=self.eos_id)
+        probs_ref = info.get("probs_ref")
+        if probs_ref is not None:
+            # serve-time pick inputs are SLICES of launch outputs —
+            # committed, with the launch's output sharding (part of the
+            # jit cache key) — so warm from slices of the real probs the
+            # executor warm just produced, not from synthetic arrays
+            ref = (probs_ref[:, -1, :] if probs_ref.ndim == 3
+                   else probs_ref)
+            rng_ref = info.get("rng_ref")
+            picks = catalog.get("entries", {}).get(
+                "pick_tokens", {}).get("shapes", ())
+            for (b,) in picks:  # fflint: host-ok (one-time warmup)
+                b = int(b)
+                probs = (ref[:b] if int(ref.shape[0]) >= b
+                         else jnp.concatenate([ref[:1]] * b))
+                temps = jnp.zeros((b,), jnp.float32)
+                # the split key is host-chain (uncommitted) until a
+                # megastep's output key re-enters the chain — warm the
+                # committed variant off rng_ref when megasteps exist.
+                # Throwaway keys: warming must not consume the serving
+                # rng chain (greedy/sampled token identity).
+                self._pick(probs, temps, jax.random.key(0))
+                if rng_ref is not None:
+                    self._pick(probs, temps,
+                               jax.random.split(rng_ref)[1])
+        if mark_steady:
+            self._compile_tracker.mark_steady_state()
+        return catalog
 
     # -- shared scheduler pieces -----------------------------------------
 
@@ -655,6 +768,9 @@ class _GenerationServerBase:
         self._tokens[slot] = tok
         if req.first_token_t is None:
             req.first_token_t = time.monotonic()
+            req.first_compile_s = max(
+                0.0, self._compile_tracker.compile_seconds_total
+                - req.compile_s_at_submit)
 
     def _admit_common(self, req: _GenRequest, slot: int, padded_len: int,
                       scatter_rows):
@@ -692,6 +808,8 @@ class _GenerationServerBase:
             self._request_metrics.append(m)  # deque(maxlen=...) ring
             if m["ttft_s"] is not None:
                 self._h_ttft.observe(m["ttft_s"])
+            if m["ttft_excl_compile_s"] is not None:
+                self._h_ttft_excl.observe(m["ttft_excl_compile_s"])
             if m["queue_time_s"] is not None:
                 self._h_queue.observe(m["queue_time_s"])
             rec = obs.recorder()

@@ -85,6 +85,15 @@ class SpeculativePagedServer(PagedGenerationServer):
         self._h_accept = self.registry.histogram("spec_acceptance",
                                                  obs.RATIO_BUCKETS)
 
+    def shape_config(self) -> dict:
+        """Extend the paged launch-shape space with the verify tree:
+        verify launches are (live, max_nodes) windows and the accepted
+        path commits (slots, depth+1) rows (analysis.shapecheck)."""
+        cfg = super().shape_config()
+        cfg["spec_max_nodes"] = self.spec.max_nodes
+        cfg["spec_depth"] = self.spec.depth
+        return cfg
+
     # -- page accounting: the tree's scratch rows count --------------------
 
     def _table_rows(self) -> int:

@@ -1,16 +1,20 @@
 """fflint static-analysis subsystem (flexflow_tpu.analysis): pass
-registry, the five passes (consistency / rulesat / hostsync / hloaudit /
-poolcheck), the seeded-defect regression fixtures from ISSUE 3 (a
-misdeclared cost-model comm-spec reintroducing the ulysses h_deg bug
-shape, an unsatisfiable corpus rule, a host-sync in a decode loop),
-ISSUE 4 (a zeroed priced comm event the lowered-HLO diff must flag with
-the node named, a config whose priced memory exceeds the machine model's
-HBM budget) and ISSUE 9 (three injected pool defects — a dropped
-refcount decrement in defrag, an in-place write to a shared COW tail, a
-spec scratch page registered pre-commit — each of which the poolcheck
-model checker must catch with a named finding and a replayable minimal
-counterexample trace), strategy-file import validation, and the CLI
-strict gate tier-1 rides on."""
+registry, the six passes (consistency / rulesat / hostsync / hloaudit /
+poolcheck / shapecheck), the seeded-defect regression fixtures from
+ISSUE 3 (a misdeclared cost-model comm-spec reintroducing the ulysses
+h_deg bug shape, an unsatisfiable corpus rule, a host-sync in a decode
+loop), ISSUE 4 (a zeroed priced comm event the lowered-HLO diff must
+flag with the node named, a config whose priced memory exceeds the
+machine model's HBM budget), ISSUE 9 (three injected pool defects — a
+dropped refcount decrement in defrag, an in-place write to a shared COW
+tail, a spec scratch page registered pre-commit — each of which the
+poolcheck model checker must catch with a named finding and a
+replayable minimal counterexample trace) and ISSUE 14 (an unclamped
+launch width that must produce shape-space-unbounded with its taint
+chain, plus a deliberately shrunk catalog check_soundness must fail —
+the live-serving half of that gate runs in
+tests/test_shapecheck_gate.py), strategy-file import validation, and
+the CLI strict gate tier-1 rides on."""
 
 import json
 import os
@@ -1359,3 +1363,191 @@ def test_fflint_since_mode_selects_passes_by_changed_roots():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# shapecheck: static launch-shape-space auditing + catalog soundness
+# (ISSUE 14)
+
+
+def test_shapecheck_registered_and_in_default_gate():
+    assert "shapecheck" in available_passes()
+    with open(os.path.join(REPO, "tools", "fflint.py")) as f:
+        src = f.read()
+    defaults = src.split("DEFAULT_PASSES")[1][:250]
+    assert '"shapecheck"' in defaults
+    # shapecheck joins the default gate WITHOUT displacing poolcheck
+    assert '"poolcheck")' in defaults
+    # --since selection knows shapecheck's source roots
+    assert '"shapecheck":' in src.split("PASS_ROOTS")[1]
+
+
+def test_shapecheck_window_cap_matches_scheduler():
+    """The pass mirrors the scheduler's packed-window cap as a plain int
+    (fflint must run on a bare checkout, so no serving import) — this is
+    the tripwire that keeps the mirror honest when the cap moves."""
+    from flexflow_tpu.analysis import shapecheck
+    from flexflow_tpu.paged import scheduler
+
+    assert shapecheck.PREFILL_WINDOW_ROWS == scheduler.PREFILL_WINDOW_ROWS
+
+
+def test_shapecheck_flags_unclamped_window_with_taint_chain(tmp_path):
+    """Seeded defect 1: a launch width flowing straight from
+    len(prompt) — the compile-storm regression the pass exists to catch.
+    The error names the taint chain line by line; the clamped variants
+    (min cap, pow2 bucket) stay silent."""
+    from flexflow_tpu.analysis import shapecheck
+
+    bad = tmp_path / "scheduler.py"
+    bad.write_text(textwrap.dedent("""\
+        class S:
+            def _tick(self, items, prompt, tr, ntr):
+                take = len(prompt)
+                window = take + 1
+                self._launch(items, window, tr, ntr)
+
+            def _clamped_tick(self, items, prompt, tr, ntr):
+                window = min(len(prompt), self.prefill_chunk)
+                self._launch(items, window, tr, ntr)
+
+            def _bucketed_tick(self, items, prompt, tr, ntr):
+                self._launch(items, self._bucket(len(prompt)), tr, ntr)
+    """))
+    findings = shapecheck.scan_file(str(bad), rel="paged/scheduler.py")
+    errs = [f for f in findings if f.code == "shape-space-unbounded"]
+    assert len(errs) == 1, [(f.code, f.where) for f in findings]
+    err = errs[0]
+    assert err.severity == "error"
+    assert err.where == "paged/scheduler.py:5"
+    # the taint chain walks source -> assignment -> launch, by line
+    assert "line 3" in err.message and "len(prompt)" in err.message
+    assert "line 4" in err.message and "line 5" in err.message
+    # replay: the same scan on the same file reproduces the finding
+    replayed = shapecheck.scan_file(str(bad), rel="paged/scheduler.py")
+    assert [(f.code, f.where) for f in replayed] == \
+        [(f.code, f.where) for f in findings]
+
+
+def test_shapecheck_pragma_suppresses_and_stale_pragma_flagged(tmp_path):
+    from flexflow_tpu.analysis import shapecheck
+
+    src = tmp_path / "scheduler.py"
+    src.write_text(textwrap.dedent("""\
+        class S:
+            def _tick(self, items, prompt, tr, ntr):
+                w = len(prompt)
+                self._launch(items, w, tr, ntr)  # fflint: shape-ok (test)
+
+            def _quiet(self, items, tr, ntr):  # fflint: shape-ok (stale)
+                self._launch(items, 8, tr, ntr)
+    """))
+    findings = shapecheck.scan_file(str(src), rel="paged/scheduler.py")
+    codes = [(f.code, f.where) for f in findings]
+    assert ("shape-space-unbounded", "paged/scheduler.py:4") not in codes
+    assert codes == [("stale-pragma", "paged/scheduler.py:6")], findings
+
+
+def test_shapecheck_repo_hot_paths_clean_and_entry_points_seen():
+    """The shipped serving stack scans clean, and the jit inventory
+    proves the scan actually saw launch machinery (a clean scan of zero
+    entry points would prove nothing)."""
+    from flexflow_tpu.analysis import shapecheck
+
+    paths = shapecheck.default_src_paths()
+    findings = shapecheck.scan_paths(paths)
+    assert findings == [], [(f.code, f.where) for f in findings]
+    execu = [p for p in paths if p.endswith("executor.py")][0]
+    sites = shapecheck.jit_entry_points(execu)
+    scopes = {s["scope"] for s in sites}
+    assert {"ragged_step_fn", "paged_megastep_fn"} <= scopes, scopes
+
+
+def test_shapecheck_catalog_is_the_expected_closed_set():
+    """slots=2 / prefill_chunk=6 paged catalog: the packed-prefill family
+    plus the decode tick is exactly 11 ragged shapes, and the knobs land
+    in the config echo warm_launch_shapes rebuilds launches from."""
+    from flexflow_tpu.analysis.shapecheck import enumerate_catalog
+
+    cat = enumerate_catalog(slots=2, max_len=32, page_size=4,
+                            prefill_chunk=6)
+    ragged = {tuple(s) for s in cat["entries"]["ragged_step"]["shapes"]}
+    want = {(b, w) for w in range(1, 6) for b in (1, 2)} | {(1, 6)}
+    assert ragged == want, ragged
+    assert cat["entries"]["pick_tokens"]["shapes"] == [[1], [2]]
+    assert cat["total_compilations"] == 13
+    assert cat["config"]["table_cols"] == 8      # ceil(32 / 4)
+    assert cat["config"]["num_pages"] == 17      # slots*cols + null page
+
+    # megastep adds exactly one (slots, ticks) program
+    mega = enumerate_catalog(slots=2, max_len=32, page_size=4,
+                             prefill_chunk=6, megastep_ticks=4)
+    assert mega["entries"]["megastep"]["shapes"] == [[2, 4]]
+
+    # a spec tree wider than the prefill chunk adds its verify shapes
+    # and the commit program; table slack covers the tree scratch rows
+    spec = enumerate_catalog(slots=2, max_len=32, page_size=4,
+                             prefill_chunk=6, spec_max_nodes=9,
+                             spec_depth=2)
+    ragged = {tuple(s) for s in spec["entries"]["ragged_step"]["shapes"]}
+    assert ragged == want | {(1, 9), (2, 9)}, ragged
+    assert spec["entries"]["paged_commit"]["shapes"] == [[2, 3]]
+    assert spec["config"]["table_cols"] == 11    # ceil((32+9) / 4)
+
+    # dense admission pads to pow2 buckets capped at max_len
+    dense = enumerate_catalog(slots=2, max_len=32, paged=False)
+    shapes = {tuple(s) for s in dense["entries"]["decode_step"]["shapes"]}
+    assert shapes == {(2, 1), (1, 8), (1, 16), (1, 32)}, shapes
+
+
+def test_shapecheck_pass_budget_and_summary():
+    """The registered pass scans the repo clean, catalogs every default
+    served config under stats, and warns (not errors) when a config's
+    shape space exceeds the budget."""
+    ctx = AnalysisContext(subject="shapes")
+    report = run_passes(["shapecheck"], ctx)
+    assert [f for f in report.findings if f.severity != "info"] == [], \
+        [(f.code, f.where) for f in report.findings]
+    assert ctx.shapecheck_summary is not None
+    cats = ctx.shapecheck_summary["catalogs"]
+    assert set(cats) >= {"paged_base", "paged_megastep", "paged_spec",
+                         "paged_legacy", "dense"}
+    for cat in cats.values():
+        assert cat["total_compilations"] <= \
+            ctx.shapecheck_summary["budget"]
+
+    tight = AnalysisContext(subject="shapes", shapecheck_budget=3)
+    tight_report = run_passes(["shapecheck"], tight)
+    over = [f for f in tight_report.findings
+            if f.code == "shape-space-over-budget"]
+    assert len(over) == len(tight.shapecheck_summary["catalogs"])
+    assert all(f.severity == "warning" for f in over)
+    assert tight_report.gating(strict=True)
+    assert not tight_report.gating(strict=False)
+
+
+def test_shapecheck_shrunk_catalog_fails_soundness():
+    """Seeded defect 2: deleting an enumerated shape from the catalog
+    must turn a matching observed compile event into a
+    shape-catalog-unsound error naming the witness — the gate that
+    keeps the enumeration honest."""
+    from flexflow_tpu.analysis.shapecheck import (
+        check_soundness,
+        enumerate_catalog,
+    )
+
+    cat = enumerate_catalog(slots=2, max_len=32, page_size=4,
+                            prefill_chunk=6)
+    events = [{"entry": "ragged_step", "shape": (2, 1), "seconds": 0.5,
+               "steady_state": False},
+              {"entry": "pick_tokens", "shape": (2,), "seconds": 0.1,
+               "steady_state": False}]
+    assert check_soundness(cat, events) == []
+
+    shrunk = json.loads(json.dumps(cat))  # deep copy
+    shrunk["entries"]["ragged_step"]["shapes"].remove([2, 1])
+    findings = check_soundness(shrunk, events)
+    assert [f.code for f in findings] == ["shape-catalog-unsound"]
+    assert findings[0].severity == "error"
+    assert findings[0].where == "shapecheck:catalog/ragged_step"
+    assert "(2, 1)" in findings[0].message

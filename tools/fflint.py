@@ -7,6 +7,13 @@ Default run (what tier-1 gates on through tests/test_analysis.py):
   - rulesat over the shipped corpus, with reachability against the built
     BASELINE graphs + the committed coverage snapshot;
   - hostsync over runtime/, serving.py, paged/, spec/;
+  - shapecheck: the launch-shape-space auditor — a taint arm that
+    classifies every symbolic shape feeding a jit entry point as
+    clamped-vs-unbounded (unbounded = shape-space-unbounded error with
+    the taint chain), plus closed-form enumeration of each served
+    config's reachable launch shapes (over-budget configs warn; the
+    catalogs land in stats.shapecheck and, with --shape-catalog, in a
+    JSON artifact the warmup driver and the CI soundness gate consume);
   - poolcheck: the AST lint arm (write-after-share / page-table /
     pool-encapsulation / lock-discipline hazards) over serving.py,
     paged/, spec/, plus the explicit-state model checker — BFS over the
@@ -170,7 +177,8 @@ def write_coverage_classification(classification):
 
 # hloaudit XLA-compiles every config (minutes) — selected explicitly,
 # never part of the default invocation tier-1 rides on
-DEFAULT_PASSES = ("consistency", "rulesat", "hostsync", "poolcheck")
+DEFAULT_PASSES = ("consistency", "rulesat", "hostsync", "shapecheck",
+                  "poolcheck")
 
 # source roots per pass, for --since REV changed-files selection: a pass
 # runs only when the diff touches one of its roots (repo-relative file
@@ -191,6 +199,9 @@ PASS_ROOTS = {
     "poolcheck": ("flexflow_tpu/paged", "flexflow_tpu/spec",
                   "flexflow_tpu/serving.py", "flexflow_tpu/analysis",
                   "tools/fflint.py"),
+    "shapecheck": ("flexflow_tpu/paged", "flexflow_tpu/spec",
+                   "flexflow_tpu/serving.py", "flexflow_tpu/runtime",
+                   "flexflow_tpu/analysis", "tools/fflint.py"),
 }
 
 
@@ -265,6 +276,19 @@ def main(argv=None):
                     help="(poolcheck) write counterexample traces as "
                          "replayable JSON files into this directory "
                          "(CI uploads them as artifacts)")
+    ap.add_argument("--shape-budget", default=None, type=int,
+                    dest="shape_budget",
+                    help="(shapecheck) per-config compile budget: a "
+                         "config whose launch-shape space exceeds it is "
+                         "a shape-space-over-budget warning (default: "
+                         "shapecheck.DEFAULT_SHAPE_BUDGET)")
+    ap.add_argument("--shape-catalog", default=None, dest="shape_catalog",
+                    help="(shapecheck) write the machine-readable "
+                         "launch-shape catalogs (per served config) to "
+                         "this JSON file — warmup drivers feed it to "
+                         "Executor.warm_launch_shapes and the CI "
+                         "soundness gate diffs observed compile events "
+                         "against it")
     args = ap.parse_args(argv)
 
     if args.passes == "all":
@@ -333,6 +357,23 @@ def main(argv=None):
         if ctx.poolcheck_summary:
             report.stats.setdefault("poolcheck", {})["model_check"] = \
                 ctx.poolcheck_summary
+    if "shapecheck" in passes:
+        from flexflow_tpu.analysis import AnalysisContext, run_passes
+
+        ctx = AnalysisContext(subject="shapes",
+                              shapecheck_budget=args.shape_budget)
+        run_passes(["shapecheck"], ctx, report)
+        if ctx.shapecheck_summary:
+            report.stats.setdefault("shapecheck", {}).update(
+                ctx.shapecheck_summary)
+            if args.shape_catalog:
+                with open(args.shape_catalog, "w") as f:
+                    json.dump(ctx.shapecheck_summary, f, indent=1,
+                              sort_keys=True)
+                print(f"wrote launch-shape catalogs for "
+                      f"{len(ctx.shapecheck_summary['catalogs'])} "
+                      f"config(s) to {args.shape_catalog}",
+                      file=sys.stderr)
     if "hloaudit" in passes:
         _hloaudit(report, names, hlo_dump=args.hlo_dump)
 

@@ -21,11 +21,16 @@ Subcommands:
       shard-searched by the existing MCMC driver for --inner-budget
       iterations. The last stdout line is a one-line JSON summary.
 
-  explain RESULT.json
+  explain RESULT.json [--calibration REPORT.json]
       Human-readable breakdown of a search result: the winning knobs,
       each objective term (TTFT / throughput / HBM penalty) for the
-      searched and default strategies, and the priced tick metrics
-      behind them.
+      searched and default strategies, the priced tick metrics behind
+      them, and a compile_cost line per strategy — the enumerated
+      launch-shape catalog size (analysis.shapecheck) times the
+      measured per-compile median from the calibration report's
+      compile block (or a rough estimate without one), so a strategy
+      with 40 launch shapes visibly pays warmup a 6-shape strategy
+      doesn't.
 
   apply RESULT.json [--out FILE] [--serve-smoke]
       Emit the winning strategy as the JSON `serve_generation(
@@ -123,11 +128,35 @@ def _fmt_metrics(m) -> str:
             f"fused ticks {m['expected_fused_ticks']:.2f}")
 
 
+# per-compile wall time when no calibration artifact supplies the
+# measured median (rough CPU-smoke figure; real runs should pass
+# --calibration so the warmup price is measured, not guessed)
+UNCALIBRATED_COMPILE_S = 0.5
+
+
+def _compile_seconds_p50(calibration_path):
+    """(seconds_per_compile, 'measured'|'uncalibrated estimate') from an
+    fftrace calibrate report's compile block, when one is supplied and
+    carries one."""
+    if calibration_path:
+        try:
+            with open(calibration_path) as f:
+                comp = json.load(f).get("compile") or {}
+            if comp.get("seconds_p50"):
+                return float(comp["seconds_p50"]), "measured"
+        except (OSError, ValueError):
+            pass
+    return UNCALIBRATED_COMPILE_S, "uncalibrated estimate"
+
+
 def cmd_explain(args) -> int:
+    from flexflow_tpu.analysis.shapecheck import catalog_for_strategy
     from flexflow_tpu.search.servesearch import ServeSearchResult
 
     with open(args.result) as f:
         res = ServeSearchResult.from_json(json.load(f))
+    per_compile_s, compile_src = _compile_seconds_p50(
+        getattr(args, "calibration", None))
     print(f"profile: {res.traffic}  (slots={res.slots}, "
           f"max_len={res.max_len}, budget={res.budget}, seed={res.seed}, "
           f"{res.trials} strategies priced)")
@@ -152,6 +181,16 @@ def cmd_explain(args) -> int:
               f"+ throughput {terms['throughput_term']:.6f} "
               f"+ hbm penalty {terms['hbm_penalty']:.6f}")
         print(_fmt_metrics(m))
+        # warmup price of this strategy's launch-shape space
+        # (analysis.shapecheck): every enumerated shape is one compile
+        # the server pays before its first steady-state token
+        cat = catalog_for_strategy(strat, slots=res.slots,
+                                   max_len=res.max_len)
+        n_shapes = cat["total_compilations"]
+        print(f"    compile_cost     {n_shapes:4d} launch shapes x "
+              f"{per_compile_s:.3f} s/compile = "
+              f"{n_shapes * per_compile_s:8.2f} s warmup "
+              f"({compile_src})")
     print(f"\nimprovement over default: {res.improvement * 100:.1f}%")
     return 0
 
@@ -218,6 +257,10 @@ def main(argv=None) -> int:
 
     ex = sub.add_parser("explain", help="break down a search result")
     ex.add_argument("result")
+    ex.add_argument("--calibration", default=None,
+                    help="fftrace calibrate report: its compile block's "
+                         "measured per-compile median prices the "
+                         "compile_cost line (default: rough estimate)")
     ex.set_defaults(func=cmd_explain)
 
     apl = sub.add_parser("apply", help="emit the winning strategy JSON")
