@@ -348,7 +348,8 @@ def bench_decode() -> dict:
     ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
     rs = np.random.RandomState(0)
 
-    def run_server(prompts, speculate=None):
+    def run_server(prompts, speculate=None, max_new_tokens=None):
+        mn = max_new if max_new_tokens is None else int(max_new_tokens)
         server = ff.serve_generation(slots=4, max_len=max_len, paged=True,
                                      page_size=page, speculate=speculate)
         try:
@@ -358,7 +359,7 @@ def bench_decode() -> dict:
             server.generate(np.tile(prompts[0], 4)[:16], max_new_tokens=2)
             warm = server.metrics().get("speculative", {})
             t0 = time.perf_counter()
-            futs = [server.submit(p, max_new_tokens=max_new)
+            futs = [server.submit(p, max_new_tokens=mn)
                     for p in prompts]
             outs = [f.result(timeout=1200) for f in futs]
             dt = time.perf_counter() - t0
@@ -681,6 +682,32 @@ def bench_decode() -> dict:
         f"{len(shared)} shared-prefix requests, both pools sized to "
         f"{hbm_budget} KV bytes")
 
+    # production-shaped profiles (search/traffic.py): the ROADMAP's two
+    # serving shapes — long-context summarization (prefill-heavy) and
+    # agentic many-turn (deep shared prefix, decode-heavy) — served
+    # through the same harness, so the bench and the serving-strategy
+    # search score the SAME fixtures the search can now also replay
+    production = {}
+    for prof_name in ("long-context-summarization", "agentic-multiturn"):
+        prof = traffic_mod.get_profile(prof_name, page_size=page,
+                                       requests=n_req)
+        _log(f"decode bench: {prof.name} fixture")
+        p_tps, p_toks, pm = run_server(
+            prof.sample(rs, lcfg.vocab_size).prompts,
+            max_new_tokens=prof.new_tokens)
+        p_recs = pm["requests"]
+        p_ttfts = [r["ttft_s"] for r in p_recs if r["ttft_s"] is not None]
+        p_hit = sum(r["cached_prefill_tokens"] for r in p_recs)
+        p_comp = sum(r["prefill_tokens"] for r in p_recs)
+        production[prof.name] = {
+            "tokens_per_sec": round(p_tps, 2),
+            "decode_tokens": p_toks,
+            "ttft_p95_s": round(float(np.percentile(p_ttfts, 95)), 6),
+            "prefix_cache_hit_rate": round(
+                p_hit / (p_hit + p_comp) if p_hit + p_comp else 0.0, 4),
+            "fixture": prof.description,
+        }
+
     # repetitive fixture: token-cyclic model (shared with tests/test_spec)
     from flexflow_tpu.spec.fixtures import make_token_cyclic
 
@@ -746,6 +773,7 @@ def bench_decode() -> dict:
         "megastep": mega_ab,
         "servesearch": searched_ab,
         "quantized_kv": quant_ab,
+        "profiles": production,
         "speculative": {
             "tokens_per_sec": round(spec_tps, 2),
             "acceptance_rate": round(sm["acceptance_rate"], 4),

@@ -1220,7 +1220,9 @@ class FFModel:
                          ragged_pack: bool = True, megastep_ticks: int = 1,
                          kv_dtype: str = "auto",
                          request_record_limit=None, serve_strategy=None,
-                         search_budget=None, traffic="smoke"):
+                         search_budget=None, traffic="smoke",
+                         reqlog_capacity=None, slo=None, slo_dump_dir=None,
+                         kv_quant_canary=None):
         """Continuous-batching autoregressive generation endpoint (KV-cache
         decode with per-slot positions — flexflow_tpu.serving). With
         `paged=True` the KV cache is a block-paged pool shared by all
@@ -1244,7 +1246,12 @@ class FFModel:
         "Serving strategy search"). `kv_dtype="int8"` (paged only)
         stores KV pages quantized with per-page per-head scales —
         ~4x more tokens per byte of pool HBM at a bounded logit
-        tolerance (docs/paged.md "Quantized KV pages")."""
+        tolerance (docs/paged.md "Quantized KV pages").
+        `reqlog_capacity` sizes the always-on request-log flight
+        recorder (0 disables), `slo=SLOTarget(...)` arms the live SLO
+        monitor with breach dumps under `slo_dump_dir`, and
+        `kv_quant_canary=N` samples the fp32 quantization-error shadow
+        onto every Nth request (docs/observability.md)."""
         from flexflow_tpu.serving import serve_generation as _sg
 
         return _sg(self, slots=slots, max_len=max_len, eos_id=eos_id,
@@ -1255,7 +1262,10 @@ class FFModel:
                    megastep_ticks=megastep_ticks, kv_dtype=kv_dtype,
                    request_record_limit=request_record_limit,
                    serve_strategy=serve_strategy,
-                   search_budget=search_budget, traffic=traffic)
+                   search_budget=search_budget, traffic=traffic,
+                   reqlog_capacity=reqlog_capacity, slo=slo,
+                   slo_dump_dir=slo_dump_dir,
+                   kv_quant_canary=kv_quant_canary)
 
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],
                 batch_size: Optional[int] = None) -> np.ndarray:

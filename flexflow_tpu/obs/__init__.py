@@ -10,6 +10,12 @@ Two layers with different overhead budgets:
     `obs.enable()`. When disabled, `obs.span(name)` returns a shared
     falsy singleton — zero allocations on the tick path (the
     disabled-overhead guard in tests/test_obs.py holds this to account).
+  * Request log + SLO monitor (obs.reqlog / obs.slo): a bounded
+    flight recorder of one record per COMPLETED request (cheap enough
+    to leave on in production; `request_log(0)` is the same falsy
+    no-op discipline as span), the replay substrate for `servesearch
+    search --replay` and `fftrace replay`, and the sliding-window SLO
+    judge whose breach events dump the recorder state to disk.
 
 Usage on a hot path:
 
@@ -43,6 +49,15 @@ from flexflow_tpu.obs.metrics import (
     MetricsRegistry,
     flatten_scalars,
 )
+from flexflow_tpu.obs.reqlog import (
+    NULL_REQLOG,
+    BoundedRing,
+    RequestLog,
+    dump_jsonl,
+    load_jsonl,
+    request_log,
+)
+from flexflow_tpu.obs.slo import SLOMonitor, SLOTarget
 from flexflow_tpu.obs.trace import NULL_SPAN, Span, TraceRecorder
 
 _recorder: Optional[TraceRecorder] = None
@@ -88,21 +103,29 @@ def span(name: str):
 
 __all__ = [
     "COUNT_BUCKETS",
+    "BoundedRing",
     "CompileTracker",
     "Histogram",
     "MetricsRegistry",
+    "NULL_REQLOG",
     "NULL_SPAN",
     "RATIO_BUCKETS",
+    "RequestLog",
+    "SLOMonitor",
+    "SLOTarget",
     "Span",
     "TIME_BUCKETS_S",
     "TickLedger",
     "TraceRecorder",
     "disable",
+    "dump_jsonl",
     "enable",
     "enabled",
     "flatten_scalars",
     "ledger",
+    "load_jsonl",
     "recorder",
+    "request_log",
     "shape_key",
     "span",
 ]

@@ -87,11 +87,16 @@ class PagedGenerationServer(_GenerationServerBase):
                  prefix_cache: bool = True, prefill_chunk: int = 64,
                  ragged_pack: bool = True, megastep_ticks: int = 1,
                  request_record_limit: Optional[int] = None,
-                 kv_dtype: str = "auto"):
+                 kv_dtype: str = "auto",
+                 reqlog_capacity: Optional[int] = None,
+                 slo=None, slo_dump_dir: Optional[str] = None,
+                 kv_quant_canary: Optional[int] = None):
         import jax
 
         super().__init__(ff, slots, max_len, eos_id, seed,
-                         request_record_limit=request_record_limit)
+                         request_record_limit=request_record_limit,
+                         reqlog_capacity=reqlog_capacity,
+                         slo=slo, slo_dump_dir=slo_dump_dir)
         self.page_size = int(page_size)
         # table_slack_tokens widens every page table beyond max_len —
         # speculative verify (flexflow_tpu.spec) writes its draft tree's
@@ -168,6 +173,42 @@ class PagedGenerationServer(_GenerationServerBase):
             num_pages, self.page_size, dtype=jax.numpy.float32)
             if self._kv_quant_debug else None)
         self._quant_err_dev = jax.numpy.float32(0.0)
+        # kv_quant_canary=N: every Nth admitted request opens a SAMPLED
+        # shadow window — _caches_ref becomes an fp32 snapshot of the
+        # live pool (dequantized for int8, a cast otherwise) and every
+        # launch replays against it until that request releases, feeding
+        # the same kv_quant_error gauge at 1/N cost. The all-requests
+        # FF_TPU_KV_QUANT_DEBUG=1 mode takes precedence over sampling.
+        if kv_quant_canary is None:
+            kv_quant_canary = int(
+                _os.environ.get("FF_TPU_KV_QUANT_CANARY", "0") or 0)
+        if kv_quant_canary < 0:
+            raise ValueError(
+                f"kv_quant_canary must be >= 0, got {kv_quant_canary}")
+        self.kv_quant_canary = (0 if self._kv_quant_debug
+                                else int(kv_quant_canary))
+        self._canary_admits = 0
+        self._canary_req: Optional[_GenRequest] = None
+        self._c_canary = self.registry.counter(
+            "kv_quant_canary_windows_total")
+        if self._quantized:
+            from flexflow_tpu.paged.quant import dequantize_pages
+
+            @jax.jit
+            def shadow_snapshot(caches):
+                # the shadow starts COHERENT with the pool: what int8
+                # storage says the cache holds, in fp32 — divergence
+                # measured from here forward is pure quantization drift
+                return {nk: {n: dequantize_pages(b, bufs[n + "_scale"])
+                             for n, b in bufs.items()
+                             if not n.endswith("_scale")}
+                        for nk, bufs in caches.items()}
+        else:
+            @jax.jit
+            def shadow_snapshot(caches):
+                return jax.tree.map(
+                    lambda b: b.astype(jax.numpy.float32), caches)
+        self._shadow_snapshot = shadow_snapshot
         self._tables = np.zeros((self.slots, self.max_pages_per_seq),
                                 np.int32)
         # device-resident descriptor mirrors (dirty-flagged, not re-
@@ -323,6 +364,13 @@ class PagedGenerationServer(_GenerationServerBase):
             "kernel_variant": self.kernel_variant,
             "kv_cache_dtype": self._kv_pool_dtype_name(),
             "kv_quant_error": self._kv_quant_error(),
+            "kv_quant_canary": {
+                "every": self.kv_quant_canary,
+                "debug_mode": self._kv_quant_debug,
+                "windows": int(self._c_canary.value),
+                "window_open": (self._canary_req is not None
+                                or self._kv_quant_debug),
+            },
             "launch_rows": int(self._c_rows.value),
             "padded_rows": int(self._c_pad.value),
             "padding_waste_ratio": (
@@ -354,6 +402,24 @@ class PagedGenerationServer(_GenerationServerBase):
         """The pool's actual storage dtype name ("int8" for a quantized
         pool) — what the kv_cache_dtype gauge reports in bits."""
         return str(next(iter(self._caches.values()))["k"].dtype)
+
+    # -- request log (obs.reqlog) ----------------------------------------
+
+    def _prefix_chain(self, req: _GenRequest) -> tuple:
+        """The pool's sha1 chain over the prompt's page-aligned blocks —
+        entry i content-addresses the whole prefix through block i, so
+        two records share a chain prefix iff their prompts shared those
+        pages (the replay determinism tests diff these)."""
+        return tuple(self.pool.chain_hashes(req.prompt))
+
+    def _reqlog_kv_dtype(self) -> str:
+        return self._kv_pool_dtype_name()
+
+    def _reqlog_record(self, req: _GenRequest, m: dict,
+                       done_t: float) -> dict:
+        rec = super()._reqlog_record(req, m, done_t)
+        rec["page_size"] = self.page_size
+        return rec
 
     def _kv_quant_error(self) -> float:
         """Running max abs output delta vs the fp32 shadow cache (0.0
@@ -415,8 +481,34 @@ class PagedGenerationServer(_GenerationServerBase):
         req.prefill_seq = None
         req.hashed_blocks = 0
 
+    def _maybe_open_canary(self, req: _GenRequest):
+        """Every `kv_quant_canary`-th successful admission opens a
+        shadow window on that request: _caches_ref becomes an fp32
+        snapshot of the CURRENT pool, so _launch's replay block measures
+        divergence accrued from this admission forward. One window at a
+        time; megasteps stand down while one is open (_loop_body) so the
+        shadow observes every tick."""
+        if not self.kv_quant_canary or self._kv_quant_debug:
+            return
+        self._canary_admits += 1
+        if (self._canary_admits % self.kv_quant_canary == 0
+                and self._caches_ref is None):
+            self._caches_ref = self._shadow_snapshot(self._caches)
+            self._canary_req = req
+            self._c_canary.inc()
+
+    def _close_canary(self, req: _GenRequest):
+        """Drop the shadow window when its request leaves (finish,
+        cancellation, or preemption — a preempted request's replay
+        would resume against a stale shadow)."""
+        if self._canary_req is req:
+            self._canary_req = None
+            self._caches_ref = None
+
     def _release_slot(self, slot: int, req: _GenRequest,
                       completed: bool = False):
+        if not self._kv_quant_debug:
+            self._close_canary(req)
         self._publish_tail(req)
         # free LEAF-first: a chain lookup stops at its first missing
         # block, so under pressure the LRU must reclaim tail pages before
@@ -438,6 +530,8 @@ class PagedGenerationServer(_GenerationServerBase):
         between (req.seq_tokens() — the prompt itself is never mutated,
         so repeated preemptions cannot double-fold the prefix)."""
         req = self._active[slot]
+        if not self._kv_quant_debug:
+            self._close_canary(req)
         self._publish_tail(req)
         self.pool.free(list(reversed(req.pages)))  # leaf-first (see above)
         req.pages = []
@@ -551,6 +645,7 @@ class PagedGenerationServer(_GenerationServerBase):
         req.admit_t = time.monotonic()
         self._active[slot] = req
         self._admit_order.append(slot)
+        self._maybe_open_canary(req)
         return True
 
     def _pop_next(self) -> Optional[_GenRequest]:
@@ -1096,7 +1191,11 @@ class PagedGenerationServer(_GenerationServerBase):
             if pre:
                 self._prefill_tick(pre, tr, ntr)
             if dec:
-                if self._megastep is not None and not pre:
+                # an open canary window needs the one-tick path: the
+                # fp32 shadow must observe every launch, and a megastep
+                # would run N ticks it never sees
+                if (self._megastep is not None and not pre
+                        and self._caches_ref is None):
                     self._decode_megastep(dec, tr, ntr)
                 else:
                     self._decode_tick(dec, tr, ntr)

@@ -66,6 +66,11 @@ CALIBRATION_MAX_AGE_S = 7 * 24 * 3600
 # rule stays well-defined, large enough that no walk settles there.
 INVALID_OBJECTIVE = 1e9
 
+# The spec-acceptance prior used when neither the caller nor the traffic
+# profile supplies one (a RecordedProfile's measured acceptance wins —
+# see search_serve_strategy's acceptance_rate resolution).
+DEFAULT_ACCEPTANCE_RATE = 0.6
+
 
 def _prefill_window_rows() -> int:
     # lazy: keeps `search/` importable without the serving stack
@@ -347,7 +352,7 @@ class ServePricer:
 
     def __init__(self, layouts: Sequence[PricedLayout],
                  stats: Dict[str, float], *, slots: int, max_len: int,
-                 acceptance_rate: float = 0.6,
+                 acceptance_rate: float = DEFAULT_ACCEPTANCE_RATE,
                  host_dispatch_s: float = HOST_DISPATCH_SECONDS,
                  tick_scale: Optional[Callable] = None):
         self.layouts = list(layouts)
@@ -564,6 +569,13 @@ class ServeSearchResult:
     trials: int
     calibration: Optional[Dict] = None
     layouts: List[Dict] = dataclasses.field(default_factory=list)
+    # the pricer's traffic inputs, for provenance: the prompt moments
+    # it priced with, the recorded arrival process (RecordedProfile
+    # only), and where acceptance_rate came from (measured / default /
+    # explicit) — a --replay search is auditable against its log
+    stats: Optional[Dict] = None
+    arrival: Optional[Dict] = None
+    acceptance: Optional[Dict] = None
 
     @property
     def improvement(self) -> float:
@@ -592,6 +604,9 @@ class ServeSearchResult:
             "trials": self.trials,
             "calibration": self.calibration,
             "layouts": self.layouts,
+            "stats": self.stats,
+            "arrival": self.arrival,
+            "acceptance": self.acceptance,
         }
 
     @classmethod
@@ -607,7 +622,8 @@ class ServeSearchResult:
             default_metrics=d["default_metrics"],
             objective=ServeObjective.from_json(d["objective"]),
             trials=d["trials"], calibration=d.get("calibration"),
-            layouts=d.get("layouts", []))
+            layouts=d.get("layouts", []), stats=d.get("stats"),
+            arrival=d.get("arrival"), acceptance=d.get("acceptance"))
 
 
 def search_serve_strategy(
@@ -617,7 +633,8 @@ def search_serve_strategy(
     max_len: int = 512, default: Optional[ServeStrategy] = None,
     space: Optional[Dict[str, List]] = None,
     layouts: Optional[Sequence[Dict[str, int]]] = None,
-    inner_budget: int = 0, calibration=None, acceptance_rate: float = 0.6,
+    inner_budget: int = 0, calibration=None,
+    acceptance_rate: Optional[float] = None,
     host_dispatch_s: float = HOST_DISPATCH_SECONDS, verbose: bool = False,
 ) -> ServeSearchResult:
     """Search the ServeStrategy space for `traffic`, minimizing
@@ -630,7 +647,12 @@ def search_serve_strategy(
     calibrate` report (path or dict); fresh reports are threaded through
     MeasuredCostModel.set_tick_calibration into every tick price, stale
     ones refused with a warning (load_calibration). Fixed `seed` makes
-    the whole search deterministic."""
+    the whole search deterministic.
+
+    `acceptance_rate=None` (default) resolves automatically: a
+    RecordedProfile's MEASURED spec acceptance when `traffic` carries
+    one (the --replay path), else the 0.6 prior. An explicit value
+    always wins. The result's `acceptance` dict records which."""
     if ff is not None:
         from flexflow_tpu.search.api import _cost_model
 
@@ -643,6 +665,22 @@ def search_serve_strategy(
 
     profile = traffic_mod.get_profile(traffic)
     stats = profile.prompt_stats()
+    arrival = (profile.arrival_stats()
+               if hasattr(profile, "arrival_stats") else None)
+
+    # acceptance_rate=None -> measured from the profile when the log
+    # recorded drafting (RecordedProfile.measured_acceptance), else the
+    # prior; an explicit value always wins
+    if acceptance_rate is None:
+        measured = (profile.measured_acceptance()
+                    if hasattr(profile, "measured_acceptance") else None)
+        if measured is not None:
+            acceptance_rate, acceptance_src = float(measured), "measured"
+        else:
+            acceptance_rate, acceptance_src = (
+                DEFAULT_ACCEPTANCE_RATE, "default")
+    else:
+        acceptance_rate, acceptance_src = float(acceptance_rate), "explicit"
 
     # -- calibration hand-off -------------------------------------------
     tick_scale_fn = None
@@ -765,4 +803,6 @@ def search_serve_strategy(
         best_metrics=best_metrics, default=default_strategy,
         default_objective=default_cost, default_metrics=default_metrics,
         objective=objective, trials=len(cache), calibration=cal_summary,
-        layouts=[lay.summary() for lay in priced])
+        layouts=[lay.summary() for lay in priced], stats=stats,
+        arrival=arrival,
+        acceptance={"rate": acceptance_rate, "source": acceptance_src})

@@ -24,7 +24,6 @@ import json
 import queue
 import threading
 import time
-from collections import deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -518,7 +517,9 @@ class _GenerationServerBase:
 
     def __init__(self, ff, slots: int, max_len: int,
                  eos_id: Optional[int], seed: int,
-                 request_record_limit: Optional[int] = None):
+                 request_record_limit: Optional[int] = None,
+                 reqlog_capacity: Optional[int] = None,
+                 slo=None, slo_dump_dir: Optional[str] = None):
         import jax
 
         self.ff = ff
@@ -572,7 +573,21 @@ class _GenerationServerBase:
             raise ValueError(
                 f"request_record_limit must be >= 1, got {limit}")
         self.request_record_limit = limit
-        self._request_metrics: "deque[dict]" = deque(maxlen=limit)
+        # the ONE bounded-retention code path (obs.reqlog.BoundedRing):
+        # per-request metric records and the reqlog ring share it, and
+        # both drop counts ride the /v2 metrics payload
+        self._request_metrics = obs.BoundedRing(limit)
+        # request-log flight recorder (obs.reqlog): one record per
+        # completed request, on by default; capacity 0 disables it
+        # (falsy NULL_REQLOG — the emit site guards on truthiness)
+        self._reqlog = obs.request_log(reqlog_capacity)
+        # live SLO judge (obs.slo): fed the same reqlog records; a
+        # breach transition dumps the flight-recorder state
+        if slo is not None and not isinstance(slo, obs.SLOMonitor):
+            slo = obs.SLOMonitor(slo, dump_dir=slo_dump_dir)
+        elif slo is not None and slo_dump_dir is not None:
+            slo.dump_dir = slo_dump_dir
+        self._slo = slo
         # always-on histograms (obs.metrics): tick latency, TTFT, queue
         # time, tokens emitted per tick. Backs BOTH the JSON metrics
         # payload and the Prometheus text endpoint.
@@ -589,6 +604,13 @@ class _GenerationServerBase:
         self._compile_tracker.set_registry(self.registry)
         self._g_recompiles = self.registry.gauge("steady_state_recompiles")
         self._g_jit_entries = self.registry.gauge("jit_cache_entries")
+        # SLO surface (ff_slo_breaches_total / ff_goodput_ratio) exists
+        # only when a target is declared — no dead series otherwise
+        if self._slo is not None:
+            self._c_slo_breaches = self.registry.counter(
+                "slo_breaches_total")
+            self._g_goodput = self.registry.gauge("goodput_ratio")
+            self._g_goodput.set(1.0)
         self._thread: Optional[threading.Thread] = None
 
     def _start(self):
@@ -647,6 +669,19 @@ class _GenerationServerBase:
     def decode_steps(self) -> int:  # fflint: lock-ok (monotonic counter; a stale read is fine)
         return self._steps
 
+    @property
+    def request_log(self):
+        """The flight recorder (obs.reqlog.RequestLog, or the falsy
+        NULL_REQLOG when constructed with reqlog_capacity=0). Export
+        with `server.request_log.export_jsonl(path)`."""
+        return self._reqlog
+
+    @property
+    def slo_monitor(self):
+        """The live SLO judge (obs.slo.SLOMonitor), or None when no
+        target was declared."""
+        return self._slo
+
     def metrics(self) -> dict:  # fflint: lock-ok (relaxed metrics snapshot; int reads are atomic, staleness is fine for scraping)
         """Aggregate serving metrics + per-request records of the last
         `request_record_limit` COMPLETED requests (subclasses extend:
@@ -660,13 +695,23 @@ class _GenerationServerBase:
         self._g_recompiles.set(snap["steady_state_recompiles"])
         self._g_jit_entries.set(entries)
         snap["jit_cache_entries"] = entries
-        return {
+        out = {
             "requests_served": self._served,
             "decode_steps": self._steps,
             "requests": list(self._request_metrics),
+            "request_records_dropped": self._request_metrics.dropped,
+            "reqlog": {
+                "enabled": bool(self._reqlog),
+                "records": len(self._reqlog),
+                "capacity": self._reqlog.capacity,
+                "dropped": self._reqlog.dropped,
+            },
             "compile": snap,
             "histograms": self.registry.to_json(),
         }
+        if self._slo is not None:
+            out["slo"] = self._slo.snapshot()
+        return out
 
     def jit_cache_entries(self) -> int:
         """Jitted-callable memos alive for this server (the
@@ -796,6 +841,58 @@ class _GenerationServerBase:
         self._sample_first_token(slot, req, probs[:, n - 1, :])
         self._active[slot] = req
 
+    # -- request log (obs.reqlog) ----------------------------------------
+
+    def _prefix_chain(self, req: _GenRequest) -> tuple:
+        """Content-hash prefix chain for the reqlog record (never the raw
+        tokens). The dense path has no page pool to derive one from; the
+        paged scheduler overrides with the pool's sha1 page-block chain."""
+        return ()
+
+    def _reqlog_kv_dtype(self) -> str:
+        """KV storage dtype for the reqlog record; the paged scheduler
+        overrides with the pool's resolved dtype name."""
+        return "dense"
+
+    def _reqlog_record(self, req: _GenRequest, m: dict,
+                       done_t: float) -> dict:
+        """One flight-recorder record per completed request
+        (obs.reqlog's schema): lifecycle stamps on the span monotonic
+        clock (a missing stamp collapses forward to done, same rule as
+        TraceRecorder.record_request), prompt length + prefix chain,
+        sampling params, kv dtype, spec/preemption/page counters, and
+        the per-phase breakdown the stamps imply."""
+        admit_t = req.admit_t if req.admit_t is not None else done_t
+        first_t = (req.first_token_t if req.first_token_t is not None
+                   else done_t)
+        rec = {
+            "rid": self._served + 1,
+            "label": f"req {self._served + 1}",
+            "submit_ns": int(req.submit_t * 1e9),
+            "admit_ns": int(admit_t * 1e9),
+            "first_token_ns": int(first_t * 1e9),
+            "done_ns": int(done_t * 1e9),
+            "prompt_tokens": int(len(req.prompt)),
+            "prefix_chain": list(self._prefix_chain(req)),
+            "temperature": req.temperature,
+            "max_new_tokens": req.max_new,
+            "kv_dtype": self._reqlog_kv_dtype(),
+            "decode_tokens": m["decode_tokens"],
+            "prefill_tokens": m["prefill_tokens"],
+            "cached_prefill_tokens": m["cached_prefill_tokens"],
+            "pages_held_peak": m["pages_held_peak"],
+            "preemptions": m["preemptions"],
+            "spec_steps": m.get("spec_steps", 0),
+            "spec_draft_tokens": m.get("spec_draft_tokens", 0),
+            "spec_accepted_tokens": m.get("spec_accepted_tokens", 0),
+            "phases": {
+                "queue_s": max(0.0, admit_t - req.submit_t),
+                "prefill_s": max(0.0, first_t - admit_t),
+                "decode_s": max(0.0, done_t - first_t),
+            },
+        }
+        return rec
+
     def _release_slot(self, slot: int, req: _GenRequest,
                       completed: bool = False):
         """Subclass hook: reclaim per-slot resources (paged frees pages).
@@ -804,20 +901,34 @@ class _GenerationServerBase:
         _finish_if_done. Completed requests record their per-request
         metrics (cancellations are not records)."""
         if completed:
+            done_t = time.monotonic()
             m = req.metrics()
-            self._request_metrics.append(m)  # deque(maxlen=...) ring
+            self._request_metrics.append(m)  # BoundedRing: counts drops
             if m["ttft_s"] is not None:
                 self._h_ttft.observe(m["ttft_s"])
             if m["ttft_excl_compile_s"] is not None:
                 self._h_ttft_excl.observe(m["ttft_excl_compile_s"])
             if m["queue_time_s"] is not None:
                 self._h_queue.observe(m["queue_time_s"])
+            # flight recorder + SLO judge share one record build, and
+            # neither allocates when both are off (NULL_REQLOG is falsy)
+            if self._reqlog or self._slo is not None:
+                record = self._reqlog_record(req, m, done_t)
+                self._reqlog.log(record)
+                if self._slo is not None:
+                    tripped = self._slo.observe(record)
+                    self._g_goodput.set(self._slo.goodput)
+                    if tripped:
+                        self._c_slo_breaches.inc()
+                        self._slo.dump(reqlog=self._reqlog,
+                                       recorder=obs.recorder(),
+                                       metrics=self.metrics)
             rec = obs.recorder()
             if rec is not None:
                 # lifecycle track (queued→prefill→decode) from the same
                 # monotonic clock the spans use
                 rec.record_request(req.submit_t, req.admit_t,
-                                   req.first_token_t, time.monotonic(),
+                                   req.first_token_t, done_t,
                                    label=f"req {self._served + 1}", attrs=m)
         self._active[slot] = None
 
@@ -883,11 +994,15 @@ class GenerationServer(_GenerationServerBase):
 
     def __init__(self, ff, slots: int = 4, max_len: int = 512,
                  eos_id: Optional[int] = None, seed: int = 0,
-                 request_record_limit: Optional[int] = None):
+                 request_record_limit: Optional[int] = None,
+                 reqlog_capacity: Optional[int] = None,
+                 slo=None, slo_dump_dir: Optional[str] = None):
         import jax
 
         super().__init__(ff, slots, max_len, eos_id, seed,
-                         request_record_limit=request_record_limit)
+                         request_record_limit=request_record_limit,
+                         reqlog_capacity=reqlog_capacity,
+                         slo=slo, slo_dump_dir=slo_dump_dir)
         ex = ff.executor
         self._step = ex.decode_fn()
         self._prefill_step = self._step  # one fn, two input shapes
@@ -991,7 +1106,12 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                      kv_dtype: str = "auto",
                      serve_strategy=None,
                      search_budget: Optional[int] = None,
-                     traffic="smoke") -> "_GenerationServerBase":
+                     traffic="smoke",
+                     reqlog_capacity: Optional[int] = None,
+                     slo=None,
+                     slo_dump_dir: Optional[str] = None,
+                     kv_quant_canary: Optional[int] = None
+                     ) -> "_GenerationServerBase":
     """Continuous-batching generation endpoint over a compiled causal-LM
     FFModel (KV-cache decode path required — see FFModel.generate).
 
@@ -1059,7 +1179,29 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
     Either overrides the paged/page_size/prefill_chunk/ragged_pack/
     megastep_ticks/num_pages/speculate knobs wholesale — passing an
     explicit `speculate` alongside is an error, the strategy already
-    decides speculation."""
+    decides speculation.
+
+    `reqlog_capacity` sizes the request-log flight recorder
+    (obs.reqlog): one record per completed request — lifecycle stamps,
+    prompt length + prefix-hash chain (never raw tokens), sampling
+    params, spec/preemption counters. On by default (None -> 4096
+    records); 0 disables it with the same no-op discipline as
+    `obs.span`. Export with `server.request_log.export_jsonl(path)`;
+    replay with `servesearch search --replay` / `fftrace replay`.
+
+    `slo=SLOTarget(...)` (or its dict form) arms the live SLO monitor
+    (obs.slo): sliding-window TTFT / seconds-per-token p95 against the
+    declared target, goodput gauge (`ff_goodput_ratio`), and a breach
+    counter (`ff_slo_breaches_total`). On an ok->breach transition the
+    flight-recorder state (reqlog tail, Chrome-trace tail, metrics
+    snapshot) is dumped under `slo_dump_dir` when one is given.
+
+    `kv_quant_canary=N` (paged only) samples the fp32 shadow-cache
+    divergence probe onto every Nth admitted request: the
+    `kv_quant_error` gauge tracks quantization drift in production at
+    1/N cost instead of requiring the all-requests
+    FF_TPU_KV_QUANT_DEBUG mode (docs/paged.md). 0/None disables; env
+    FF_TPU_KV_QUANT_CANARY supplies a default."""
     if search_budget is not None and serve_strategy is None:
         from flexflow_tpu.search.servesearch import search_serve_strategy
 
@@ -1107,7 +1249,9 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             preemption=preemption, prefix_cache=prefix_cache,
             prefill_chunk=prefill_chunk, ragged_pack=ragged_pack,
             request_record_limit=request_record_limit,
-            kv_dtype=kv_dtype)
+            kv_dtype=kv_dtype, reqlog_capacity=reqlog_capacity,
+            slo=slo, slo_dump_dir=slo_dump_dir,
+            kv_quant_canary=kv_quant_canary)
     if paged:
         from flexflow_tpu.paged.scheduler import PagedGenerationServer
 
@@ -1117,10 +1261,18 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
             ragged_pack=ragged_pack, megastep_ticks=megastep_ticks,
             request_record_limit=request_record_limit,
-            kv_dtype=kv_dtype)
+            kv_dtype=kv_dtype, reqlog_capacity=reqlog_capacity,
+            slo=slo, slo_dump_dir=slo_dump_dir,
+            kv_quant_canary=kv_quant_canary)
     if kv_dtype != "auto":
         raise ValueError(
             "kv_dtype rides the paged KV pool; pass paged=True")
+    if kv_quant_canary:
+        raise ValueError(
+            "kv_quant_canary probes the paged KV pool's quantization "
+            "error; pass paged=True")
     return GenerationServer(ff, slots=slots, max_len=max_len, eos_id=eos_id,
                             seed=seed,
-                            request_record_limit=request_record_limit)
+                            request_record_limit=request_record_limit,
+                            reqlog_capacity=reqlog_capacity,
+                            slo=slo, slo_dump_dir=slo_dump_dir)

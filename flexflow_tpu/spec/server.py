@@ -56,7 +56,10 @@ class SpeculativePagedServer(PagedGenerationServer):
                  prefix_cache: bool = True, prefill_chunk: int = 64,
                  ragged_pack: bool = True,
                  request_record_limit: Optional[int] = None,
-                 kv_dtype: str = "auto"):
+                 kv_dtype: str = "auto",
+                 reqlog_capacity: Optional[int] = None,
+                 slo=None, slo_dump_dir: Optional[str] = None,
+                 kv_quant_canary: Optional[int] = None):
         if not isinstance(spec, SpecConfig):
             raise TypeError(
                 f"speculate must be a SpecConfig, got {type(spec).__name__}")
@@ -80,7 +83,10 @@ class SpeculativePagedServer(PagedGenerationServer):
                          prefill_chunk=prefill_chunk,
                          ragged_pack=ragged_pack,
                          request_record_limit=request_record_limit,
-                         kv_dtype=kv_dtype)
+                         kv_dtype=kv_dtype,
+                         reqlog_capacity=reqlog_capacity,
+                         slo=slo, slo_dump_dir=slo_dump_dir,
+                         kv_quant_canary=kv_quant_canary)
         # per-tick draft acceptance rate (accepted / drafted this tick)
         self._h_accept = self.registry.histogram("spec_acceptance",
                                                  obs.RATIO_BUCKETS)

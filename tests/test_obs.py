@@ -383,3 +383,403 @@ def test_fftrace_calibrate_cli(tmp_path, capsys):
     TickLedger().save(p2)
     assert fft.main(["calibrate", p2]) == 2
     capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# request log (obs.reqlog): bounded retention, null discipline, JSONL
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_ring_retention_and_drop_count():
+    ring = obs.BoundedRing(3)
+    assert ring.capacity == 3
+    for i in range(5):
+        ring.append(i)
+    assert ring.snapshot() == [2, 3, 4]    # keep-newest
+    assert ring.dropped == 2               # ...and COUNT what fell off
+    assert len(ring) == 3
+    assert ring.tail(2) == [3, 4]
+    assert ring.tail(0) == []
+    assert ring.tail(99) == [2, 3, 4]
+    assert list(ring) == [2, 3, 4]
+    with pytest.raises(ValueError):
+        obs.BoundedRing(0)
+
+
+def test_request_log_factory_null_discipline():
+    # None -> live log at the default capacity; 0 -> the shared falsy
+    # singleton; N -> live log at N (same contract as obs.span)
+    live = obs.request_log(None)
+    assert live and live.capacity == 4096
+    assert obs.request_log(7).capacity == 7
+    null = obs.request_log(0)
+    assert null is obs.NULL_REQLOG and not null
+    null.log({"x": 1})                     # no-op, never raises
+    assert len(null) == 0 and null.records() == [] and null.tail(5) == []
+    assert null.dropped == 0 and null.capacity == 0
+
+    log = obs.RequestLog(capacity=2)
+    for i in range(3):
+        log.log({"rid": i})
+    assert [r["rid"] for r in log.records()] == [1, 2]
+    assert log.dropped == 1
+
+
+def test_disabled_reqlog_is_free():
+    """The disabled emit-site pattern (`if rl: rl.log(...)`) must not
+    allocate per call inside the obs package — same guard as the null
+    span."""
+    rl = obs.request_log(0)
+    obs_dir = obs.__file__.rsplit("/", 1)[0]
+    iters = 2000
+
+    def emit():
+        if rl:
+            rl.log({"rid": 1})
+
+    for _ in range(16):
+        emit()
+    tracemalloc.start()
+    s1 = tracemalloc.take_snapshot()
+    for _ in range(iters):
+        emit()
+    s2 = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    new_allocs = sum(
+        d.count_diff for d in s2.compare_to(s1, "filename")
+        if d.traceback[0].filename.startswith(obs_dir) and d.count_diff > 0)
+    assert new_allocs < iters // 100
+
+
+def test_reqlog_jsonl_roundtrip(tmp_path):
+    from flexflow_tpu.obs import reqlog as reqlog_mod
+
+    records = [{"submit_ns": 10 * i, "rid": i, "prompt_tokens": 4,
+                "prefix_chain": ["aa", "bb"]} for i in range(3)]
+    for name in ("log.jsonl", "log.jsonl.gz"):
+        p = str(tmp_path / name)
+        assert reqlog_mod.dump_jsonl(p, records) == 3
+        assert reqlog_mod.load_jsonl(p) == records
+    # the plain export leads with the schema header line
+    first = open(str(tmp_path / "log.jsonl")).readline()
+    assert json.loads(first) == {"schema": reqlog_mod.SCHEMA}
+    # headerless hand-built fixtures load too...
+    bare = str(tmp_path / "bare.jsonl")
+    with open(bare, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    assert reqlog_mod.load_jsonl(bare) == records
+    # ...but a FOREIGN schema is refused by name, not priced as garbage
+    alien = str(tmp_path / "alien.jsonl")
+    with open(alien, "w") as f:
+        f.write(json.dumps({"schema": "somebody.else/v9"}) + "\n")
+    with pytest.raises(ValueError, match="somebody.else/v9"):
+        reqlog_mod.load_jsonl(alien)
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor (obs.slo): percentile math, latching, breach dumps
+# ---------------------------------------------------------------------------
+
+
+def _slo_rec(i, ttft_s, decode_s=0.0, decode_tokens=1):
+    sub = i * 10**9
+    first = sub + int(ttft_s * 1e9)
+    return {"submit_ns": sub, "first_token_ns": first,
+            "done_ns": first + int(decode_s * 1e9),
+            "decode_tokens": decode_tokens}
+
+
+def test_slo_percentile_nearest_rank():
+    from flexflow_tpu.obs.slo import percentile
+
+    assert percentile([], 0.95) == 0.0
+    assert percentile([3.0, 1.0, 2.0], 0.5) == 2.0
+    assert percentile(list(range(1, 11)), 0.95) == 10  # ceil(9.5) = 10th
+    assert percentile([1.0, 2.0, 3.0], 0.95) == 3.0    # ceil(2.85) = 3rd
+    assert percentile([5.0], 0.95) == 5.0
+
+
+def test_slo_target_validation_and_roundtrip():
+    with pytest.raises(ValueError, match="declares no target"):
+        obs.SLOTarget()
+    with pytest.raises(ValueError):
+        obs.SLOTarget(ttft_p95_s=0.1, window=0)
+    t = obs.SLOTarget(ttft_p95_s=0.1, s_per_token_p95=0.02, window=16,
+                      min_samples=4)
+    assert obs.SLOTarget.from_json(
+        json.loads(json.dumps(t.to_json()))) == t
+
+
+def test_slo_monitor_latches_per_excursion():
+    """Breach is an EVENT, not a state poll: observe() returns True
+    exactly on the ok -> breached transition (counted once per
+    excursion), stays latched while the window p95 is over, and
+    unlatches on recovery so the NEXT excursion counts again."""
+    mon = obs.SLOMonitor(obs.SLOTarget(ttft_p95_s=0.1, window=4,
+                                       min_samples=2))
+    i = iter(range(100))
+    assert mon.observe(_slo_rec(next(i), 0.01)) is False  # < min_samples
+    assert mon.observe(_slo_rec(next(i), 0.01)) is False  # p95 .01 ok
+    assert mon.observe(_slo_rec(next(i), 1.0)) is True    # trip: p95 1.0
+    assert mon.breaches == 1 and mon.breached
+    assert mon.observe(_slo_rec(next(i), 1.0)) is False   # still breached
+    assert mon.breaches == 1
+    for _ in range(4):                                    # flush the window
+        mon.observe(_slo_rec(next(i), 0.01))
+    assert not mon.breached                               # recovered
+    assert mon.observe(_slo_rec(next(i), 2.0)) is True    # new excursion
+    assert mon.breaches == 2
+    # goodput = per-request pass fraction over the window (3 fast + the
+    # 2.0s straggler in the last 4)
+    assert mon.goodput == pytest.approx(3 / 4)
+    snap = mon.snapshot()
+    assert snap["breaches"] == 2 and snap["breached"]
+    assert snap["ttft_p95_s"] == pytest.approx(2.0)       # nearest-rank
+
+
+def test_slo_monitor_s_per_token_axis():
+    mon = obs.SLOMonitor(obs.SLOTarget(s_per_token_p95=0.01, window=8,
+                                       min_samples=1))
+    # 0.4 s of decode for 80 tokens = 5 ms/token: ok
+    assert mon.observe(_slo_rec(0, 0.0, decode_s=0.4,
+                                decode_tokens=80)) is False
+    # 0.4 s for 10 tokens = 40 ms/token: trips
+    assert mon.observe(_slo_rec(1, 0.0, decode_s=0.4,
+                                decode_tokens=10)) is True
+
+
+def test_slo_breach_dump_bundle(tmp_path):
+    """A breach dump is the complete flight-recorder bundle: reqlog
+    tail, Chrome-trace tail, metrics snapshot, SLO snapshot — and a
+    FAILING metrics callable is captured as an error entry, never
+    raised into the serving loop."""
+    from flexflow_tpu.obs import reqlog as reqlog_mod
+
+    mon = obs.SLOMonitor(obs.SLOTarget(ttft_p95_s=0.1, min_samples=1),
+                         dump_dir=str(tmp_path / "dumps"))
+    log = obs.RequestLog(capacity=8)
+    for i in range(5):
+        rec = _slo_rec(i, 1.0 if i == 4 else 0.01)
+        log.log(rec)
+        mon.observe(rec)
+    assert mon.breaches == 1
+    recorder = obs.enable()
+    with obs.span("decode_tick"):
+        pass
+    bundle = mon.dump(reqlog=log, recorder=recorder,
+                      metrics=lambda: {"requests_served": 5})
+    obs.disable()
+    assert bundle == str(tmp_path / "dumps" / "breach_0001")
+    tail = reqlog_mod.load_jsonl(bundle + "/reqlog_tail.jsonl")
+    assert len(tail) == 5 and tail[-1]["first_token_ns"] > 0
+    trace = json.load(open(bundle + "/trace_tail.json"))
+    assert any(e["ph"] == "X" and e["name"] == "decode_tick"
+               for e in trace["traceEvents"])
+    assert json.load(open(bundle + "/metrics.json")) == {
+        "requests_served": 5}
+    slo_doc = json.load(open(bundle + "/slo.json"))
+    assert slo_doc["breaches"] == 1 and slo_doc["breached"]
+    assert mon.last_dump == bundle
+
+    # a metrics() that explodes becomes an error entry in the bundle
+    def boom():
+        raise RuntimeError("scrape died")
+
+    mon.breaches += 1
+    b2 = mon.dump(reqlog=log, metrics=boom)
+    assert "scrape died" in json.load(open(b2 + "/metrics.json"))["error"]
+    # no dump_dir -> no bundle, no error
+    assert obs.SLOMonitor(obs.SLOTarget(ttft_p95_s=1.0)).dump() is None
+
+
+# ---------------------------------------------------------------------------
+# end to end: record a mixed paged+spec run, replay it deterministically
+# ---------------------------------------------------------------------------
+
+
+def _serve_recorded(ff, lcfg, prompts, speculate=None, max_new=4,
+                    max_len=32, **kw):
+    srv = ff.serve_generation(slots=2, max_len=max_len, paged=True,
+                              page_size=4, speculate=speculate, **kw)
+    try:
+        futs = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+        for f in futs:
+            f.result(timeout=300)
+        return srv.request_log.records(), srv.metrics()
+    finally:
+        srv.stop()
+
+
+def test_reqlog_record_and_deterministic_replay(tmp_path):
+    """ISSUE 15 acceptance: record a tiny mixed paged+spec run, export,
+    re-import, re-serve the same prompts — request count, per-request
+    token counts, and the content-hash prefix chains agree EXACTLY
+    (greedy serving is deterministic, and the chains hash page content,
+    so equality here proves the replay re-served the same pages). The
+    token-cyclic fixture makes the drafter productive, so the records
+    carry REAL accepted/proposed counts for the pricer to measure."""
+    from flexflow_tpu.obs import reqlog as reqlog_mod
+    from flexflow_tpu.spec import SpecConfig
+    from flexflow_tpu.spec.fixtures import make_token_cyclic
+
+    ff, lcfg = _causal_lm()
+    make_token_cyclic(ff)
+    rs = np.random.RandomState(9)
+    shared = rs.randint(0, lcfg.vocab_size, (4,)).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rs.randint(0, lcfg.vocab_size, (n,))
+                               .astype(np.int32)]) for n in (1, 4, 2)]
+
+    plain, m = _serve_recorded(ff, lcfg, prompts)
+    assert len(plain) == len(prompts)
+    assert m["reqlog"] == {"enabled": True, "records": len(prompts),
+                           "capacity": 4096, "dropped": 0}
+    # spec pass: a 40-token budget lets the cyclic stream repeat, so
+    # the n-gram drafter actually drafts and the records carry real
+    # proposed/accepted counts
+    spec, _ = _serve_recorded(ff, lcfg, prompts,
+                              SpecConfig(width=2, depth=3),
+                              max_new=40, max_len=64)
+    records = plain + spec
+
+    # schema: every record carries the full flight-recorder field set
+    for r in records:
+        assert (r["submit_ns"] <= r["admit_ns"] <= r["first_token_ns"]
+                <= r["done_ns"])
+        assert r["kv_dtype"] == "float32" and r["page_size"] == 4
+        assert r["decode_tokens"] == r["max_new_tokens"]
+        assert r["prompt_tokens"] in (5, 8, 6)
+        assert len(r["prefix_chain"]) == r["prompt_tokens"] // 4
+        assert r["phases"]["queue_s"] >= 0.0
+        assert r["temperature"] == 0.0 and r["preemptions"] == 0
+    # the speculative pass recorded real drafting; the plain pass none
+    assert sum(r["spec_draft_tokens"] for r in plain) == 0
+    assert sum(r["spec_draft_tokens"] for r in spec) > 0
+    assert sum(r["spec_accepted_tokens"] for r in spec) > 0
+    # all six prompts open with the same 4-token (one-page) prefix:
+    # the sha1 chains must agree on their first entry across ALL records
+    assert len({r["prefix_chain"][0] for r in records}) == 1
+
+    # export -> import is lossless (the replay substrate)
+    p = str(tmp_path / "run.jsonl")
+    assert reqlog_mod.dump_jsonl(p, records) == 6
+    assert reqlog_mod.load_jsonl(p) == records
+
+    # deterministic replay: a fresh identical server over the same
+    # prompts produces records that agree exactly on everything
+    # content-derived (counts + hash chains; wall-clock stamps differ,
+    # and the cached-vs-computed prefill split is admission-timing
+    # dependent — only its SUM is content-derived)
+    replay, _ = _serve_recorded(ff, lcfg, prompts)
+    keys = ("prompt_tokens", "decode_tokens", "prefix_chain")
+    assert ([{k: r[k] for k in keys} for r in replay]
+            == [{k: r[k] for k in keys} for r in plain])
+    for r in replay + records:
+        assert (r["prefill_tokens"] + r["cached_prefill_tokens"]
+                == r["prompt_tokens"])
+
+
+def test_reqlog_disabled_and_bounded_on_server():
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(10)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 5, 4)]
+    # capacity 0 disables: the server holds the falsy NULL_REQLOG
+    recs, m = _serve_recorded(ff, lcfg, prompts, reqlog_capacity=0)
+    assert recs == [] and m["reqlog"]["enabled"] is False
+    # capacity 2 keeps the newest 2 and counts the drop in /v2 metrics
+    recs, m = _serve_recorded(ff, lcfg, prompts, reqlog_capacity=2)
+    assert len(recs) == 2
+    assert m["reqlog"] == {"enabled": True, "records": 2, "capacity": 2,
+                           "dropped": 1}
+
+
+def test_slo_breach_capture_end_to_end(tmp_path):
+    """A served run with an unmeetable declared SLO trips the monitor:
+    ff_slo_breaches_total counts the excursion, goodput drops, the
+    metrics payload carries the SLO snapshot, and the dump bundle lands
+    complete (reqlog tail + trace tail + metrics + slo) — captured from
+    INSIDE the serving loop, proving breach capture never deadlocks the
+    loop that triggers it."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(11)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 5, 4)]
+    dump_dir = str(tmp_path / "dumps")
+    rec = obs.enable()
+    try:
+        recs, m = _serve_recorded(
+            ff, lcfg, prompts,
+            slo={"ttft_p95_s": 1e-9, "window": 8, "min_samples": 1},
+            slo_dump_dir=dump_dir)
+    finally:
+        obs.disable()
+    assert rec.events  # the trace tail had spans to capture
+    slo = m["slo"]
+    assert slo["breaches"] == 1 and slo["breached"]
+    assert slo["goodput_ratio"] == 0.0       # nobody met 1 ns TTFT
+    assert slo["target"]["ttft_p95_s"] == 1e-9
+    bundle = slo["last_dump"]
+    assert bundle == dump_dir + "/breach_0001"
+    for name in ("reqlog_tail.jsonl", "trace_tail.json", "metrics.json",
+                 "slo.json"):
+        assert (tmp_path / "dumps" / "breach_0001" / name).exists(), name
+    # the dump ran mid-loop: its metrics snapshot already carries the
+    # tripping request's reqlog record and the breach count
+    dumped = json.load(open(bundle + "/metrics.json"))
+    assert dumped["reqlog"]["records"] >= 1
+    assert dumped["slo"]["breaches"] == 1
+
+
+def test_slo_prometheus_series_gated_on_target():
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(12)
+    p = rs.randint(0, lcfg.vocab_size, (4,)).astype(np.int32)
+    # with a target: breach counter + goodput gauge in the registry text
+    srv = ff.serve_generation(slots=1, max_len=32, paged=True, page_size=4,
+                              slo=obs.SLOTarget(ttft_p95_s=1e-9,
+                                                min_samples=1))
+    try:
+        srv.generate(p, max_new_tokens=2)
+        text = srv.registry.prometheus_text()
+    finally:
+        srv.stop()
+    assert "# TYPE ff_slo_breaches_total counter" in text
+    assert "ff_slo_breaches_total 1" in text
+    assert "# TYPE ff_goodput_ratio gauge" in text
+    assert "ff_goodput_ratio 0" in text
+    # without one: no dead series
+    srv = ff.serve_generation(slots=1, max_len=32)
+    try:
+        text = srv.registry.prometheus_text()
+    finally:
+        srv.stop()
+    assert "slo_breaches" not in text and "goodput" not in text
+
+
+def test_fftrace_replay_cli(tmp_path, capsys):
+    """`fftrace replay log.jsonl` re-serves a recorded log and reports
+    recorded-vs-replayed TTFT/throughput deltas (ISSUE 15 satellite)."""
+    import tools.fftrace as fft
+
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(13)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 6)]
+    recs, _ = _serve_recorded(ff, lcfg, prompts)
+    log = str(tmp_path / "run.jsonl")
+    from flexflow_tpu.obs import reqlog as reqlog_mod
+
+    reqlog_mod.dump_jsonl(log, recs)
+    assert fft.main(["replay", log, "--out", str(tmp_path)]) == 0
+    capsys.readouterr()
+    rep = json.load(open(str(tmp_path / "replay_report.json")))
+    assert rep["profile"] == f"replay:{log.rsplit('/', 1)[-1]}"
+    assert rep["speculate"] is False          # the log never drafted
+    assert rep["recorded"]["requests"] == 2
+    assert rep["replayed"]["requests"] == 2
+    assert rep["replayed"]["decode_tokens"] == rep["recorded"][
+        "decode_tokens"]
+    for k in ("ttft_p50_s", "ttft_p95_s", "tokens_per_s"):
+        assert k in rep["delta"]

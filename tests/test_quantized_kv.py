@@ -390,6 +390,76 @@ def test_defrag_with_shared_quantized_pages(lm):
 
 
 # ---------------------------------------------------------------------------
+# kv_quant_error canary: sampled production shadow windows (ISSUE 15)
+
+
+def test_kv_quant_canary_samples_windows(lm, monkeypatch):
+    """kv_quant_canary=1 opens a shadow window on every admission: the
+    kv_quant_error gauge populates in PRODUCTION (no debug env) at
+    sampled cost, the window counter ticks, windows close on release,
+    and the canary is observe-only — tokens identical to the
+    canary-less int8 run."""
+    monkeypatch.delenv("FF_TPU_KV_QUANT_DEBUG", raising=False)
+    ff, lcfg = lm
+    prompts = _prompts(lcfg)
+    plain, _ = _serve(ff, prompts, 6, kv_dtype="int8")
+    got, m = _serve(ff, prompts, 6, kv_dtype="int8", kv_quant_canary=1)
+    for a, b in zip(plain, got):
+        np.testing.assert_array_equal(a, b)
+    can = m["kv_quant_canary"]
+    assert can["every"] == 1 and can["debug_mode"] is False
+    assert can["windows"] >= 1
+    assert can["window_open"] is False           # all requests released
+    assert 0.0 < m["kv_quant_error"] < 1e-2, m["kv_quant_error"]
+
+    with pytest.raises(ValueError, match="kv_quant_canary"):
+        ff.serve_generation(slots=1, max_len=16, paged=True, page_size=4,
+                            kv_dtype="int8", kv_quant_canary=-1)
+    # the dense path has no pool to probe
+    with pytest.raises(ValueError, match="paged"):
+        ff.serve_generation(slots=1, max_len=16, kv_quant_canary=1)
+
+
+def test_kv_quant_canary_env_and_debug_precedence(lm, monkeypatch):
+    """FF_TPU_KV_QUANT_CANARY configures the rate without code changes;
+    FF_TPU_KV_QUANT_DEBUG=1 (the all-requests shadow) takes precedence
+    and disables sampling."""
+    ff, lcfg = lm
+    monkeypatch.setenv("FF_TPU_KV_QUANT_CANARY", "2")
+    srv = ff.serve_generation(slots=1, max_len=16, paged=True, page_size=4,
+                              kv_dtype="int8")
+    try:
+        assert srv.metrics()["kv_quant_canary"]["every"] == 2
+    finally:
+        srv.stop()
+    monkeypatch.setenv("FF_TPU_KV_QUANT_DEBUG", "1")
+    srv = ff.serve_generation(slots=1, max_len=16, paged=True, page_size=4,
+                              kv_dtype="int8", kv_quant_canary=3)
+    try:
+        can = srv.metrics()["kv_quant_canary"]
+        assert can["every"] == 0 and can["debug_mode"] is True
+        assert can["window_open"] is True        # the debug shadow is on
+    finally:
+        srv.stop()
+
+
+def test_kv_quant_canary_with_megastep(lm, monkeypatch):
+    """An open canary window forces the one-tick path (the shadow must
+    observe every tick); between windows the megastep fuses as always —
+    and the emitted tokens match the canary-less megastep run."""
+    monkeypatch.delenv("FF_TPU_KV_QUANT_DEBUG", raising=False)
+    ff, lcfg = lm
+    prompts = _prompts(lcfg)
+    plain, _ = _serve(ff, prompts, 8, kv_dtype="int8", megastep_ticks=8)
+    got, m = _serve(ff, prompts, 8, kv_dtype="int8", megastep_ticks=8,
+                    kv_quant_canary=2)
+    for a, b in zip(plain, got):
+        np.testing.assert_array_equal(a, b)
+    assert m["kv_quant_canary"]["windows"] >= 1
+    assert m["kv_quant_error"] > 0.0
+
+
+# ---------------------------------------------------------------------------
 # weight storage casts (init_params(weight_dtype=...))
 
 

@@ -218,9 +218,37 @@ def test_pricer_rebills_pool_per_kv_dtype():
 
 def test_profiles_registry():
     assert set(traffic_mod.PROFILES) == {
-        "smoke", "shared-system-prompt", "mixed-length"}
+        "smoke", "shared-system-prompt", "mixed-length",
+        "long-context-summarization", "agentic-multiturn"}
     with pytest.raises(KeyError):
         traffic_mod.get_profile("nope")
+
+
+def test_production_profile_shapes():
+    """The two production-shaped profiles (ISSUE 15 satellite): long-
+    context summarization is prefill-heavy with no shared prefix;
+    agentic multi-turn opens every request with a deep (4-page) shared
+    prefix and short per-turn suffixes."""
+    lc = traffic_mod.get_profile("long-context-summarization", page_size=8,
+                                 requests=5)
+    s = lc.sample(np.random.RandomState(0), vocab=128)
+    assert s.shared_prefix is None
+    for p in s.prompts:
+        assert 24 <= len(p) <= 40          # 3..5 pages of prompt
+    st = lc.prompt_stats()
+    assert st["prefix_share_rate"] == 0.0
+    assert st["new_tokens"] == 8.0         # short summary decode
+    assert st["mean_prompt_tokens"] > 3 * 8
+
+    ag = traffic_mod.get_profile("agentic-multiturn", page_size=8,
+                                 requests=5)
+    s = ag.sample(np.random.RandomState(0), vocab=128)
+    assert s.shared_prefix is not None and len(s.shared_prefix) == 32
+    for p in s.prompts:
+        np.testing.assert_array_equal(p[:32], s.shared_prefix)
+        assert 34 <= len(p) <= 40          # 32 shared + 2..8 turn tokens
+    st = ag.prompt_stats()
+    assert st["prefix_share_rate"] > 0.5   # the prefix IS the prompt
 
 
 def test_sample_deterministic_and_prefixed():
@@ -261,6 +289,113 @@ def test_get_profile_passthrough_and_replace():
     prof = traffic_mod.smoke_profile(requests=3)
     assert traffic_mod.get_profile(prof) is prof
     assert traffic_mod.get_profile(prof, requests=9).requests == 9
+
+
+# ---------------------------------------------------------------------------
+# RecordedProfile: measured traffic from a reqlog export (ISSUE 15)
+
+
+def _rec(sub_s, done_s, prompt, decode, cached=0, computed=None,
+         chain=(), page=4, drafted=0, accepted=0):
+    """A synthetic reqlog record with hand-controllable moments."""
+    return {
+        "submit_ns": int(sub_s * 1e9),
+        "first_token_ns": int((sub_s + 0.1) * 1e9),
+        "done_ns": int(done_s * 1e9),
+        "prompt_tokens": prompt,
+        "decode_tokens": decode,
+        "cached_prefill_tokens": cached,
+        "prefill_tokens": (prompt - cached if computed is None
+                           else computed),
+        "prefix_chain": list(chain),
+        "page_size": page,
+        "spec_draft_tokens": drafted,
+        "spec_accepted_tokens": accepted,
+    }
+
+
+def test_recorded_profile_hand_computed_stats():
+    """Every pricer input comes from the log — checked against the
+    values computed by hand: prompt moments, measured prefix share,
+    Little's-law concurrency, arrival process, realized acceptance."""
+    records = [
+        _rec(0.0, 2.0, prompt=8, decode=4, cached=0, drafted=6,
+             accepted=3),
+        _rec(1.0, 3.0, prompt=16, decode=8, cached=4, drafted=4,
+             accepted=3),
+    ]
+    prof = traffic_mod.RecordedProfile(records, name="hand")
+    assert prof.requests == 2
+    assert prof.new_tokens == 6                       # round(mean(4, 8))
+    assert prof.new_tokens_per_request == [4, 8]      # arrival order
+    st = prof.prompt_stats()
+    assert st["mean_prompt_tokens"] == pytest.approx(12.0)
+    assert st["p95_prompt_tokens"] == 16.0            # nearest-rank
+    # cache served 4 of the 4 + (8 + 12) looked-up prompt tokens
+    assert st["prefix_share_rate"] == pytest.approx(4 / 24)
+    # Little's law: residence (2 + 2) s over a 3 s makespan
+    assert st["offered_concurrency"] == pytest.approx(4 / 3)
+    ar = prof.arrival_stats()
+    assert ar["requests"] == 2.0
+    assert ar["makespan_s"] == pytest.approx(3.0)
+    assert ar["arrival_rate_rps"] == pytest.approx(2 / 3)
+    assert ar["mean_interarrival_s"] == pytest.approx(1.0)
+    assert ar["p95_interarrival_s"] == pytest.approx(1.0)
+    # acceptance: 6 of the 10 drafted tokens landed
+    assert prof.measured_acceptance() == pytest.approx(0.6)
+    # a log that never drafted measures None (search falls back)
+    assert traffic_mod.RecordedProfile(
+        [_rec(0.0, 1.0, prompt=4, decode=2)]).measured_acceptance() is None
+    with pytest.raises(ValueError):
+        traffic_mod.RecordedProfile([])
+
+
+def test_recorded_profile_sample_resynthesizes_shared_prefix():
+    """The records' hash chains prove the prompts shared their first
+    page: sample() re-draws ONE shared prefix of that depth and opens
+    every replayed prompt with it, deterministically in the seed."""
+    records = [
+        _rec(0.0, 1.0, prompt=8, decode=2, chain=("aa", "bb"), page=4),
+        _rec(0.5, 1.5, prompt=9, decode=2, chain=("aa", "cc"), page=4),
+    ]
+    prof = traffic_mod.RecordedProfile(records)
+    a = prof.sample(np.random.RandomState(3), vocab=64)
+    b = prof.sample(np.random.RandomState(3), vocab=64)
+    assert [len(p) for p in a.prompts] == [8, 9]      # recorded lengths
+    assert a.shared_prefix is not None and len(a.shared_prefix) == 4
+    for pa, pb in zip(a.prompts, b.prompts):
+        np.testing.assert_array_equal(pa, pb)
+        np.testing.assert_array_equal(pa[:4], a.shared_prefix)
+    # divergent chains (or a single record) -> no synthetic prefix
+    lone = traffic_mod.RecordedProfile(records[:1])
+    assert lone.sample(np.random.RandomState(0), vocab=64) \
+        .shared_prefix is None
+    # the shared block always leaves a computed suffix: common depth 2
+    # (8 tokens) against a 8-token shortest prompt caps at 7
+    deep = traffic_mod.RecordedProfile([
+        _rec(0.0, 1.0, prompt=8, decode=2, chain=("aa", "bb"), page=4),
+        _rec(0.5, 1.5, prompt=12, decode=2, chain=("aa", "bb", "cc"),
+             page=4),
+    ])
+    s = deep.sample(np.random.RandomState(0), vocab=64)
+    assert len(s.shared_prefix) == 7
+    assert [len(p) for p in s.prompts] == [8, 12]
+
+
+def test_recorded_profile_from_reqlog_and_get_profile(tmp_path):
+    from flexflow_tpu.obs import reqlog as reqlog_mod
+
+    records = [_rec(0.0, 1.0, prompt=4, decode=2)]
+    p = str(tmp_path / "run.jsonl")
+    reqlog_mod.dump_jsonl(p, records)
+    prof = traffic_mod.RecordedProfile.from_reqlog(p)
+    assert prof.name == "replay:run.jsonl"
+    assert prof.requests == 1
+    # a RecordedProfile is measured, not parameterized: passthrough
+    # works, overrides are refused
+    assert traffic_mod.get_profile(prof) is prof
+    with pytest.raises(ValueError, match="measured"):
+        traffic_mod.get_profile(prof, requests=5)
 
 
 # ---------------------------------------------------------------------------
@@ -361,6 +496,51 @@ def test_search_refuses_stale_calibration(graph):
                                   traffic="smoke", budget=40, seed=0,
                                   slots=4, max_len=128)
     assert res.default_objective == plain.default_objective
+
+
+def test_search_replay_prices_measured_traffic(graph):
+    """`servesearch search --replay` substance (ISSUE 15 acceptance):
+    searching against a RecordedProfile returns a valid strategy whose
+    pricer inputs come from the LOG — the result's stats/arrival/
+    acceptance blocks equal the hand-computable measured values."""
+    records = [
+        _rec(0.0, 2.0, prompt=8, decode=4, drafted=8, accepted=6),
+        _rec(1.0, 3.0, prompt=16, decode=8, cached=4, drafted=8,
+             accepted=6),
+    ]
+    prof = traffic_mod.RecordedProfile(records, name="replay:test")
+    res = search_serve_strategy(graph=graph, cost=_cost(), traffic=prof,
+                                budget=80, seed=0, slots=4, max_len=128)
+    res.best.validate(max_len=128)
+    assert res.traffic == "replay:test"
+    assert res.acceptance == {"rate": pytest.approx(0.75),
+                              "source": "measured"}
+    assert res.stats == prof.prompt_stats()
+    assert res.stats["mean_prompt_tokens"] == pytest.approx(12.0)
+    assert res.stats["prefix_share_rate"] == pytest.approx(4 / 24)
+    assert res.arrival == prof.arrival_stats()
+    assert res.arrival["arrival_rate_rps"] == pytest.approx(2 / 3)
+    # provenance survives the persisted-result round trip
+    back = ServeSearchResult.from_json(
+        json.loads(json.dumps(res.to_json())))
+    assert back.acceptance == res.acceptance
+    assert back.stats == res.stats and back.arrival == res.arrival
+
+
+def test_search_acceptance_source_default_and_explicit(graph):
+    """Named profiles have no measured acceptance -> the prior, tagged
+    'default'; a caller-supplied rate is tagged 'explicit'."""
+    from flexflow_tpu.search.servesearch import DEFAULT_ACCEPTANCE_RATE
+
+    res = search_serve_strategy(graph=graph, cost=_cost(), traffic="smoke",
+                                budget=40, seed=0, slots=4, max_len=128)
+    assert res.acceptance == {"rate": DEFAULT_ACCEPTANCE_RATE,
+                              "source": "default"}
+    assert res.arrival is None            # closed-form profiles: no log
+    res = search_serve_strategy(graph=graph, cost=_cost(), traffic="smoke",
+                                budget=40, seed=0, slots=4, max_len=128,
+                                acceptance_rate=0.5)
+    assert res.acceptance == {"rate": 0.5, "source": "explicit"}
 
 
 def test_hbm_budget_steers_search(graph):

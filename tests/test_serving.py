@@ -310,6 +310,43 @@ def test_request_metric_retention_is_bounded():
         ff.serve_generation(slots=1, max_len=16, request_record_limit=0)
 
 
+def test_v2_metrics_reports_bounded_retention_drops():
+    """request_record_limit and the reqlog ring share ONE bounded-
+    retention path (obs.reqlog.BoundedRing), and BOTH drop counts ride
+    the /v2/models/<name>/metrics payload — truncation is visible to a
+    scraper, never silent (ISSUE 15 satellite)."""
+    import json
+    import urllib.request
+
+    from flexflow_tpu.serving import http_serve, serve
+
+    ff, lcfg = _causal_lm()
+    fwd = serve(ff, batch_sizes=(1,), warmup=False)
+    gen = ff.serve_generation(slots=2, max_len=32, paged=True, page_size=4,
+                              request_record_limit=2, reqlog_capacity=2)
+    httpd = http_serve(fwd, port=0, model_name="lm", generation_server=gen)
+    try:
+        rs = np.random.RandomState(8)
+        for n in (3, 5, 4):
+            gen.generate(rs.randint(0, lcfg.vocab_size, (n,))
+                         .astype(np.int32), max_new_tokens=3)
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with urllib.request.urlopen(f"{base}/v2/models/lm/metrics") as r:
+            g = json.loads(r.read())["generation"]
+        assert g["requests_served"] == 3
+        assert len(g["requests"]) == 2                 # ring kept 2...
+        assert g["request_records_dropped"] == 1       # ...dropped 1
+        assert g["reqlog"] == {"enabled": True, "records": 2,
+                               "capacity": 2, "dropped": 1}
+        # the flight recorder holds the NEWEST records (prompts 5, 4)
+        assert [r_["prompt_tokens"]
+                for r_ in gen.request_log.records()] == [5, 4]
+    finally:
+        httpd.shutdown()
+        fwd.stop()
+        gen.stop()
+
+
 def test_generation_server_stop_contract():
     """submit after stop raises; bad max_new_tokens rejected; stop cancels
     (never silently truncates) in-flight work."""

@@ -201,7 +201,8 @@ PASS_ROOTS = {
                   "tools/fflint.py"),
     "shapecheck": ("flexflow_tpu/paged", "flexflow_tpu/spec",
                    "flexflow_tpu/serving.py", "flexflow_tpu/runtime",
-                   "flexflow_tpu/analysis", "tools/fflint.py"),
+                   "flexflow_tpu/obs", "flexflow_tpu/analysis",
+                   "tools/fflint.py"),
 }
 
 

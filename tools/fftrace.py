@@ -11,7 +11,20 @@ Subcommands:
         trace.json.gz    Chrome-trace / Perfetto trace_event JSON
         ledger.json      TickLedger with the priced base step stamped in
         calibration.json predicted-vs-measured report (fftrace calibrate)
+        reqlog.jsonl     request-log flight-recorder export (obs.reqlog)
+                         — the input to `fftrace replay` and
+                         `servesearch search --replay`
       The last stdout line is a one-line JSON summary.
+
+  replay REQLOG.jsonl [--out DIR] [--seed S] [--slots K] [--max-len L]
+         [--page-size P]
+      Re-serve a recorded request log against the current (tiny smoke)
+      server config: the log's RecordedProfile replays the recorded
+      arrival order and prompt lengths (content re-drawn — logs never
+      hold raw tokens) with each request's recorded decode budget, on a
+      speculative server when the log recorded drafting. Reports
+      recorded-vs-replayed TTFT p50/p95 and tokens/s deltas; the last
+      stdout line is the JSON report.
 
   calibrate LEDGER [--out FILE]
       Load a saved TickLedger and emit the calibration report: per
@@ -80,6 +93,7 @@ def cmd_smoke(args) -> int:
                for _ in range(args.requests)]
 
     rec = obs.enable()
+    reqlog_records = []
 
     def serve(speculate=None):
         server = ff.serve_generation(slots=2, max_len=48, paged=True,
@@ -91,6 +105,9 @@ def cmd_smoke(args) -> int:
                 f.result(timeout=600)
             return server.metrics()
         finally:
+            # flight-recorder export rides the same smoke run: the
+            # plain and speculative passes append to one reqlog.jsonl
+            reqlog_records.extend(server.request_log.records())
             server.stop()
 
     try:
@@ -109,11 +126,17 @@ def cmd_smoke(args) -> int:
     calib_path = os.path.join(out, "calibration.json")
     with open(calib_path, "w") as f:
         json.dump(report, f, indent=1)
+    from flexflow_tpu.obs import reqlog as reqlog_mod
+
+    reqlog_path = os.path.join(out, "reqlog.jsonl")
+    n_logged = reqlog_mod.dump_jsonl(reqlog_path, reqlog_records)
 
     print(json.dumps({
         "trace": trace_path,
         "ledger": ledger_path,
         "calibration": calib_path,
+        "reqlog": reqlog_path,
+        "reqlog_records": n_logged,
         "schema_version": report["version"],
         "created_at": report["created_at"],
         "events": len(rec.events),
@@ -121,6 +144,77 @@ def cmd_smoke(args) -> int:
         "shapes": sorted(report["tick_scales"]),
         "phases": {k: round(v, 3) for k, v in report["phases"].items()},
     }))
+    return 0
+
+
+def cmd_replay(args) -> int:
+    from flexflow_tpu.parallel.compat import ensure_cpu_devices
+
+    ensure_cpu_devices(8)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from flexflow_tpu.obs.slo import percentile
+    from flexflow_tpu.search.traffic import RecordedProfile
+
+    profile = RecordedProfile.from_reqlog(args.log)
+
+    def _stats(records):
+        ttfts = [(r["first_token_ns"] - r["submit_ns"]) / 1e9
+                 for r in records]
+        makespan = (max(r["done_ns"] for r in records)
+                    - min(r["submit_ns"] for r in records)) / 1e9
+        toks = sum(int(r.get("decode_tokens", 0)) for r in records)
+        return {
+            "requests": len(records),
+            "ttft_p50_s": percentile(ttfts, 0.5),
+            "ttft_p95_s": percentile(ttfts, 0.95),
+            "decode_tokens": toks,
+            "tokens_per_s": toks / makespan if makespan > 0 else 0.0,
+        }
+
+    recorded = _stats(profile.records)
+    ff = _build_tiny_ff()
+    rs = np.random.RandomState(args.seed)
+    sampled = profile.sample(rs, vocab=128)
+    speculate = None
+    if profile.measured_acceptance() is not None:
+        # the log drafted, so the replay drafts: same server family
+        from flexflow_tpu.spec import SpecConfig
+
+        speculate = SpecConfig(width=2, depth=3)
+    server = ff.serve_generation(
+        slots=args.slots, max_len=args.max_len, paged=True,
+        page_size=args.page_size, speculate=speculate)
+    try:
+        budgets = profile.new_tokens_per_request
+        futs = [server.submit(p, max_new_tokens=budgets[i % len(budgets)])
+                for i, p in enumerate(sampled.prompts)]
+        for f in futs:
+            f.result(timeout=600)
+        replayed_records = server.request_log.records()
+    finally:
+        server.stop()
+    replayed = _stats(replayed_records)
+    doc = {
+        "log": args.log,
+        "profile": profile.name,
+        "speculate": speculate is not None,
+        "recorded": recorded,
+        "replayed": replayed,
+        "delta": {k: replayed[k] - recorded[k]
+                  for k in ("ttft_p50_s", "ttft_p95_s", "tokens_per_s")},
+    }
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        path = os.path.join(args.out, "replay_report.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        doc["report"] = path
+    print(json.dumps(doc))
     return 0
 
 
@@ -176,6 +270,17 @@ def main(argv=None) -> int:
     sm.add_argument("--max-new", type=int, default=8)
     sm.add_argument("--no-speculate", dest="speculate", action="store_false")
     sm.set_defaults(func=cmd_smoke, speculate=True)
+
+    rp = sub.add_parser("replay", help="re-serve a recorded request log")
+    rp.add_argument("log", help="reqlog JSONL export (fftrace smoke / "
+                                "server.request_log.export_jsonl)")
+    rp.add_argument("--out", default=None,
+                    help="also write replay_report.json into this dir")
+    rp.add_argument("--seed", type=int, default=0)
+    rp.add_argument("--slots", type=int, default=2)
+    rp.add_argument("--max-len", type=int, default=48)
+    rp.add_argument("--page-size", type=int, default=8)
+    rp.set_defaults(func=cmd_replay)
 
     ca = sub.add_parser("calibrate", help="predicted-vs-measured report")
     ca.add_argument("ledger")

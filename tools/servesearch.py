@@ -5,14 +5,21 @@ search").
 
 Subcommands:
 
-  search [--profile NAME] [--budget N] [--seed S] [--slots K]
+  search [--profile NAME | --replay REQLOG.jsonl] [--budget N]
+         [--seed S] [--slots K]
          [--max-len L] [--calibration REPORT.json] [--hbm-budget BYTES]
          [--acceptance-rate A] [--mesh-layouts SPEC] [--inner-budget M]
          [--out FILE]
       Build the tiny smoke model on CPU, run the serving-strategy
       search against the named traffic profile
       (flexflow_tpu.search.traffic: smoke, shared-system-prompt,
-      mixed-length) and write the full result JSON — winning
+      mixed-length, long-context-summarization, agentic-multiturn) —
+      or, with --replay, against a RECORDED request log
+      (obs.reqlog JSONL from `server.request_log.export_jsonl` or
+      `fftrace smoke`): prompt moments, prefix share, arrival process
+      and spec acceptance are then MEASURED from the log
+      (search/traffic.py RecordedProfile) — and write the full result
+      JSON — winning
       ServeStrategy, simulated SLO metrics for it and the hand default,
       per-layout step prices, calibration provenance. A fresh `fftrace
       calibrate` report sharpens the tick prices; stale reports are
@@ -89,12 +96,20 @@ def cmd_search(args) -> int:
         search_serve_strategy,
     )
 
+    traffic = args.profile
+    if args.replay:
+        # score candidates against RECORDED traffic: the reqlog export
+        # becomes the profile, and its measured stats (prompt moments,
+        # arrival process, realized spec acceptance) feed the pricer
+        from flexflow_tpu.search.traffic import RecordedProfile
+
+        traffic = RecordedProfile.from_reqlog(args.replay)
     ff = _build_tiny_ff()
     objective = None
     if args.hbm_budget is not None:
         objective = ServeObjective(hbm_budget_bytes=float(args.hbm_budget))
     res = search_serve_strategy(
-        ff, traffic=args.profile, budget=args.budget, seed=args.seed,
+        ff, traffic=traffic, budget=args.budget, seed=args.seed,
         slots=args.slots, max_len=args.max_len, objective=objective,
         calibration=args.calibration, acceptance_rate=args.acceptance_rate,
         layouts=_parse_layouts(args.mesh_layouts),
@@ -111,6 +126,8 @@ def cmd_search(args) -> int:
         "improvement": round(res.improvement, 4),
         "trials": res.trials,
         "calibration": res.calibration,
+        "acceptance": res.acceptance,
+        "arrival": res.arrival,
         "out": args.out,
     }))
     return 0
@@ -239,6 +256,11 @@ def main(argv=None) -> int:
 
     se = sub.add_parser("search", help="search the serving-strategy space")
     se.add_argument("--profile", default="smoke")
+    se.add_argument("--replay", default=None, metavar="REQLOG_JSONL",
+                    help="score against a recorded request log "
+                         "(obs.reqlog export; overrides --profile and "
+                         "supplies measured prompt/arrival/acceptance "
+                         "stats)")
     se.add_argument("--budget", type=int, default=200)
     se.add_argument("--seed", type=int, default=0)
     se.add_argument("--slots", type=int, default=4)
@@ -247,7 +269,9 @@ def main(argv=None) -> int:
                     help="fftrace calibrate report (<= 7 days old)")
     se.add_argument("--hbm-budget", type=float, default=None,
                     help="HBM budget in bytes (default: the machine model)")
-    se.add_argument("--acceptance-rate", type=float, default=0.6)
+    se.add_argument("--acceptance-rate", type=float, default=None,
+                    help="spec acceptance prior (default: measured from "
+                         "--replay's log when it drafted, else 0.6)")
     se.add_argument("--mesh-layouts", default=None,
                     help='candidate meshes, e.g. "data=8;data=2,model=4"')
     se.add_argument("--inner-budget", type=int, default=0,
