@@ -48,7 +48,8 @@ from typing import Dict, List, Optional, Set
 
 from flexflow_tpu.analysis import AnalysisContext, Finding, register_pass
 
-DEFAULT_ROOTS = ("runtime", "serving.py", "paged", "spec", "obs")
+DEFAULT_ROOTS = ("runtime", "serving.py", "paged", "spec", "obs",
+                 "serving_autopilot.py")
 
 _SYNC_CALLS = {("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
                ("numpy", "array"), ("jax", "device_get")}

@@ -116,6 +116,10 @@ class PoolModel:
                             not its scale-sidecar entry;
       scale_realloc_leak  — allocation hands out a page without
                             resetting its previous tenant's scale;
+      swap_free_skip      — drain-and-swap detaches live owners but
+                            leaves their pages allocated in the adopted
+                            pool (carried requests re-admit and the old
+                            pages leak with no owner);
       scale_defrag_drop   — defrag permutes page payloads but leaves
                             the scale sidecar at the old slots.
 
@@ -272,11 +276,15 @@ class PoolModel:
                 ops.append(f"preempt({i})")
         if self.pool._refs or self.pool._lru:
             ops.append("defrag")
+        if active:
+            ops.append("swap")
         return ops
 
     def apply(self, label: str):
         if label == "defrag":
             return self._op_defrag()
+        if label == "swap":
+            return self._op_swap()
         op, rid = label[:-1].split("(")
         return getattr(self, "_op_" + op)(int(rid))
 
@@ -438,6 +446,35 @@ class PoolModel:
     def _op_preempt(self, i: int):
         self._do_preempt(self.reqs[i])
 
+    def _op_swap(self):
+        """Strategy change in flight: mirror of the drain-and-swap
+        handoff (scheduler._detach_active + the successor's
+        adopt_pool_from/absorb_requests). Every live owner publishes
+        its tail, releases its pages into the pool the successor
+        adopts, and carries over as queued with its emitted tokens
+        intact — re-admission re-attaches via prefix lookup, so the
+        carried streams stay token-identical. Unlike preempt (one
+        victim under page pressure) this detaches ALL actives
+        atomically between ticks."""
+        for req in self.reqs:
+            if req.state != "active":
+                continue
+            self._publish_tail(req)
+            if "swap_free_skip" in self.mutations:
+                # SEEDED DEFECT: the detach hands the request to the
+                # successor but never frees its pages — the adopted
+                # pool keeps refcounts nobody owns, and the carried
+                # request double-allocates on re-admission
+                pass
+            else:
+                self.pool.free(list(reversed(req.pages)))  # leaf-first
+            req.pages = []
+            req.pos = 0
+            req.prefill_pos = 0
+            req.prefill_target = 0
+            req.hashed_blocks = 0
+            req.state = "queued"
+
     def _op_defrag(self):
         """pool.defrag() + the scheduler-side owner-table rewrite, with
         the defrag-preserve invariant checked against the pre-state."""
@@ -594,7 +631,7 @@ def replay(trace, config: str = "base", pool_factory=None,
 # ---------------------------------------------------------------------------
 # lint arm: AST checks over serving.py / paged/ / spec/
 
-LINT_ROOTS = ("serving.py", "paged", "spec")
+LINT_ROOTS = ("serving.py", "paged", "spec", "serving_autopilot.py")
 # the host-side state-machine files the page/table write checks cover
 # (kernel files write K/V rows THROUGH the table by design)
 _STATE_FILE_BASENAMES = {"scheduler.py", "pool.py", "server.py"}
@@ -605,7 +642,11 @@ _COW_FNS = {"copy_page",
             # table writes in _admit/_ensure_pages
             "reset_page_scales"}
 _TABLE_FNS = {"__init__", "_admit", "_apply_defrag", "_release_slot",
-              "_evict", "_ensure_pages"}
+              "_evict", "_ensure_pages",
+              # the release arm of drain-and-swap: joins the loop, frees
+              # every slot's pages, then zeroes the rows — the model
+              # checker's `swap` op mirrors it
+              "_detach_active"}
 _DIRECTIVES = ("lock-ok", "cow-ok", "table-ok", "pool-ok")
 
 

@@ -508,6 +508,35 @@ def catalog_for_strategy(strategy, *, slots: int, max_len: int) -> Dict:
         num_pages=kw["num_pages"], kv_dtype=kw["kv_dtype"])
 
 
+def union_catalogs(*catalogs: Dict) -> Dict:
+    """Merge launch-shape catalogs into one whose entries enumerate the
+    UNION of every input's shapes — the catalog a drain-and-swap
+    cutover is judged against (serving_autopilot): while requests from
+    both sides are in flight, a compile event is sound if EITHER
+    strategy's enumeration reaches it. Configs are kept as a list for
+    provenance; total_compilations is recomputed over the union (shapes
+    shared by both sides count once — warmed once, reused across the
+    swap)."""
+    if not catalogs:
+        raise ValueError("union_catalogs needs at least one catalog")
+    merged: Dict[str, Set[Tuple[int, ...]]] = {}
+    configs = []
+    for cat in catalogs:
+        configs.append(cat.get("config", {}))
+        for name, ent in cat.get("entries", {}).items():
+            merged.setdefault(name, set()).update(
+                tuple(int(x) for x in s) for s in ent.get("shapes", ()))
+    entries = {name: {"shapes": [list(s) for s in sorted(shapes)],
+                      "count": len(shapes)}
+               for name, shapes in sorted(merged.items())}
+    return {
+        "version": 1,
+        "config": {"union": configs},
+        "entries": entries,
+        "total_compilations": sum(e["count"] for e in entries.values()),
+    }
+
+
 def check_soundness(catalog: Dict, events: Sequence[Dict]) -> List[Finding]:
     """Diff observed compile events (CompileTracker.observed()) against a
     static catalog: any event whose (entry, shape) is not enumerated is a

@@ -1222,7 +1222,7 @@ class FFModel:
                          request_record_limit=None, serve_strategy=None,
                          search_budget=None, traffic="smoke",
                          reqlog_capacity=None, slo=None, slo_dump_dir=None,
-                         kv_quant_canary=None):
+                         kv_quant_canary=None, defer_start: bool = False):
         """Continuous-batching autoregressive generation endpoint (KV-cache
         decode with per-slot positions — flexflow_tpu.serving). With
         `paged=True` the KV cache is a block-paged pool shared by all
@@ -1251,7 +1251,11 @@ class FFModel:
         recorder (0 disables), `slo=SLOTarget(...)` arms the live SLO
         monitor with breach dumps under `slo_dump_dir`, and
         `kv_quant_canary=N` samples the fp32 quantization-error shadow
-        onto every Nth request (docs/observability.md)."""
+        onto every Nth request (docs/observability.md).
+        `defer_start=True` builds the server without starting its loop —
+        the drain-and-swap handoff warms shapes, adopts the predecessor's
+        pool and absorbs its carried requests before calling .start()
+        (docs/serving.md, "Autopilot & drain-and-swap")."""
         from flexflow_tpu.serving import serve_generation as _sg
 
         return _sg(self, slots=slots, max_len=max_len, eos_id=eos_id,
@@ -1265,7 +1269,8 @@ class FFModel:
                    search_budget=search_budget, traffic=traffic,
                    reqlog_capacity=reqlog_capacity, slo=slo,
                    slo_dump_dir=slo_dump_dir,
-                   kv_quant_canary=kv_quant_canary)
+                   kv_quant_canary=kv_quant_canary,
+                   defer_start=defer_start)
 
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],
                 batch_size: Optional[int] = None) -> np.ndarray:

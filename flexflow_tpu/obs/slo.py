@@ -154,11 +154,17 @@ class SLOMonitor:
     # -- breach capture --------------------------------------------------
 
     def dump(self, reqlog=None, recorder=None,
-             metrics: Optional[Callable[[], dict]] = None) -> Optional[str]:
+             metrics: Optional[Callable[[], dict]] = None,
+             strategy: Optional[dict] = None,
+             compile_snapshot: Optional[dict] = None) -> Optional[str]:
         """Bundle the flight-recorder state into
         `<dump_dir>/breach_NNNN/`: the reqlog tail (JSONL), the span
         recorder's Chrome-trace tail (when one is live), the server
-        metrics snapshot, and this monitor's own snapshot. Returns the
+        metrics snapshot, and this monitor's own snapshot — plus, when
+        the caller passes them, the active ServeStrategy JSON
+        (`strategy.json`) and a compile-tracker snapshot
+        (`compile.json`), so the bundle says WHAT configuration was
+        breaching and whether recompiles were part of it. Returns the
         bundle dir (None when no dump_dir is configured). Capture must
         never take the server down: a failing snapshot is recorded as
         an error entry in the bundle, not raised into the loop."""
@@ -183,6 +189,13 @@ class SLOMonitor:
                 snap = {"error": f"{type(e).__name__}: {e}"}
             with open(os.path.join(bundle, "metrics.json"), "w") as f:
                 json.dump(snap, f, indent=1, sort_keys=True, default=str)
+        if strategy is not None:
+            with open(os.path.join(bundle, "strategy.json"), "w") as f:
+                json.dump(strategy, f, indent=1, sort_keys=True)
+        if compile_snapshot is not None:
+            with open(os.path.join(bundle, "compile.json"), "w") as f:
+                json.dump(compile_snapshot, f, indent=1, sort_keys=True,
+                          default=str)
         with open(os.path.join(bundle, "slo.json"), "w") as f:
             json.dump(self.snapshot(), f, indent=1, sort_keys=True)
         self.last_dump = bundle

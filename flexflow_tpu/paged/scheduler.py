@@ -90,13 +90,16 @@ class PagedGenerationServer(_GenerationServerBase):
                  kv_dtype: str = "auto",
                  reqlog_capacity: Optional[int] = None,
                  slo=None, slo_dump_dir: Optional[str] = None,
-                 kv_quant_canary: Optional[int] = None):
+                 kv_quant_canary: Optional[int] = None,
+                 serve_strategy=None, defer_start: bool = False):
         import jax
 
         super().__init__(ff, slots, max_len, eos_id, seed,
                          request_record_limit=request_record_limit,
                          reqlog_capacity=reqlog_capacity,
-                         slo=slo, slo_dump_dir=slo_dump_dir)
+                         slo=slo, slo_dump_dir=slo_dump_dir,
+                         serve_strategy=serve_strategy,
+                         defer_start=defer_start)
         self.page_size = int(page_size)
         # table_slack_tokens widens every page table beyond max_len —
         # speculative verify (flexflow_tpu.spec) writes its draft tree's
@@ -305,6 +308,11 @@ class PagedGenerationServer(_GenerationServerBase):
             }
 
         self._scale_reset = reset_page_scales
+        if self.serve_strategy is None:
+            # derive the strategy from the ACTUAL constructor knobs (after
+            # any debug-flag adjustments) so fingerprint() always reflects
+            # what this server runs, even when built without servesearch
+            self.serve_strategy = self._derive_strategy()
         self._start()
 
     def shape_config(self) -> dict:
@@ -545,6 +553,103 @@ class PagedGenerationServer(_GenerationServerBase):
         req.preemptions += 1
         self.preemptions += 1
         self._requeue.insert(0, req)
+
+    # -- drain-and-swap (serving_autopilot) -------------------------------
+
+    def _derive_strategy(self):
+        """Reconstruct the ServeStrategy this server actually runs —
+        called by the constructor when no explicit strategy was passed,
+        so reqlog stamping and autopilot window segmentation work on
+        hand-built servers too. Reads the knobs AFTER any debug-flag
+        adjustment (megastep forcing under FF_TPU_KV_QUANT_DEBUG), so
+        the fingerprint matches observable behaviour, not the args."""
+        from flexflow_tpu.search.servesearch import ServeStrategy
+
+        spec = getattr(self, "spec", None)
+        dense_pages = self.slots * self.max_pages_per_seq
+        frac = (1.0 if self.pool.num_pages >= dense_pages + 1
+                else max((self.pool.num_pages - 1) / dense_pages, 1e-6))
+        # a page (or chunk) wider than max_len behaves identically to
+        # one clamped at max_len — clamp so the derived strategy passes
+        # its own validate() and can round-trip through swap_to()
+        return ServeStrategy(
+            page_size=min(self.page_size, self.max_len),
+            prefill_chunk=min(self.prefill_chunk, self.max_len),
+            spec_width=(spec.width if spec is not None else 0),
+            spec_depth=(spec.depth if spec is not None else 0),
+            megastep_ticks=self.megastep_ticks,
+            ragged_pack=self.ragged_pack,
+            pool_fraction=round(frac, 6),
+            kv_dtype=self.kv_dtype,
+        )
+
+    def _detach_active(self) -> List[_GenRequest]:
+        """Carry-over side of detach_for_swap(): pull every live request
+        off its slot WITHOUT touching its future. Pages are published to
+        the prefix cache first (tail included) and then freed, so when
+        the successor adopts this pool its re-admission re-attaches
+        whatever content survives the LRU and recomputes only the rest.
+        Not a preemption — futures stay pending, counters untouched."""
+        carried: List[_GenRequest] = []
+        for slot in list(self._admit_order):
+            req = self._active[slot]
+            if req is None:
+                continue
+            if not self._kv_quant_debug:
+                self._close_canary(req)
+            self._publish_tail(req)
+            self.pool.free(list(reversed(req.pages)))  # leaf-first
+            req.pages = []
+            self._reset_prefill_state(req)
+            self._tables[slot] = 0
+            self._active[slot] = None
+            carried.append(req)
+        self._admit_order.clear()
+        self._mark_tables_dirty()
+        self._mark_temps_dirty()
+        carried.extend(self._requeue)
+        self._requeue.clear()
+        return carried
+
+    def absorb_requests(self, reqs: List[_GenRequest]):
+        """Seed this not-yet-started server (defer_start=True) with the
+        requests a predecessor carried out of detach_for_swap(). They
+        land at the FRONT of the admission order, ahead of anything
+        submitted to this server directly, so in-flight work resumes
+        first after cutover."""
+        if self._thread is not None:
+            raise RuntimeError(
+                "absorb_requests() requires a server whose loop has not "
+                "started (construct with defer_start=True)")
+        self._requeue[:0] = list(reqs)
+
+    def adopt_pool_from(self, old: "PagedGenerationServer") -> bool:
+        """Take over the predecessor's PagePool and device caches when
+        the pool geometry and storage dtype are identical, so content-
+        addressed prefix pages survive the swap and carried requests
+        re-attach instead of recomputing. Returns False on any mismatch
+        (or when either side runs a debug shadow cache) and keeps the
+        fresh pool — correct either way, just a colder start."""
+        if self._thread is not None:
+            raise RuntimeError(
+                "adopt_pool_from() requires a server whose loop has not "
+                "started (construct with defer_start=True)")
+        # both loops are quiescent here: self raises above unless
+        # defer_start, and the caller already joined the predecessor's
+        # loop via detach_for_swap — nothing mutates either server
+        # during the geometry comparison
+        same = (self.page_size == old.page_size
+                and self.pool.num_pages  # fflint: lock-ok (loops joined)
+                == old.pool.num_pages
+                and self.max_pages_per_seq == old.max_pages_per_seq
+                and self._kv_pool_dtype_name() == old._kv_pool_dtype_name()
+                and self._caches_ref is None  # fflint: lock-ok (joined)
+                and old._caches_ref is None)
+        if not same:
+            return False
+        self.pool = old.pool
+        self._caches = old._caches
+        return True
 
     def _reset_page_scales(self, pages: List[int]):
         """Zero the scale-sidecar entries of freshly ALLOCATED pages

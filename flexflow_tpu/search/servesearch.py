@@ -38,6 +38,7 @@ strategy search" is the narrative.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import logging
 import math
@@ -186,6 +187,17 @@ class ServeStrategy:
         kw = dict(d)
         kw["mesh"] = tuple((str(a), int(s)) for a, s in kw.get("mesh", ()))
         return cls(**kw)
+
+    def fingerprint(self) -> str:
+        """Stable short content hash over the canonical JSON form — the
+        strategy's identity across processes. Stamped into every reqlog
+        record and the /v2 metrics payload so post-swap records
+        attribute to the strategy that actually served them, and equal
+        for any two strategies with equal knobs regardless of how they
+        were constructed."""
+        doc = json.dumps(self.to_json(), sort_keys=True,
+                         separators=(",", ":"))
+        return hashlib.sha1(doc.encode("utf-8")).hexdigest()[:12]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -576,6 +588,10 @@ class ServeSearchResult:
     stats: Optional[Dict] = None
     arrival: Optional[Dict] = None
     acceptance: Optional[Dict] = None
+    # which evaluation backend scored the candidates: "closed-form"
+    # (ServePricer algebra) or "ticksim" (event-driven replay of the
+    # recorded arrival sequence — the --sim path)
+    backend: str = "closed-form"
 
     @property
     def improvement(self) -> float:
@@ -607,6 +623,7 @@ class ServeSearchResult:
             "stats": self.stats,
             "arrival": self.arrival,
             "acceptance": self.acceptance,
+            "backend": self.backend,
         }
 
     @classmethod
@@ -623,7 +640,57 @@ class ServeSearchResult:
             objective=ServeObjective.from_json(d["objective"]),
             trials=d["trials"], calibration=d.get("calibration"),
             layouts=d.get("layouts", []), stats=d.get("stats"),
-            arrival=d.get("arrival"), acceptance=d.get("acceptance"))
+            arrival=d.get("arrival"), acceptance=d.get("acceptance"),
+            backend=d.get("backend", "closed-form"))
+
+
+def build_pricer(ff=None, *, graph=None, cost=None, traffic="smoke",
+                 slots: int = 4, max_len: int = 512,
+                 acceptance_rate: Optional[float] = None,
+                 calibration=None,
+                 host_dispatch_s: float = HOST_DISPATCH_SECONDS,
+                 seed: int = 0) -> ServePricer:
+    """A ServePricer for one traffic profile WITHOUT running a search —
+    the entry `servesearch simulate` and the sim-accuracy tests share.
+    Same resolution rules as search_serve_strategy: a RecordedProfile's
+    measured acceptance wins over the prior, and a fresh calibration
+    report threads its measured tick scales into every price."""
+    if ff is not None:
+        from flexflow_tpu.search.api import _cost_model
+
+        graph = ff.graph
+        cost = _cost_model(ff.mesh, ff.config)
+    if graph is None or cost is None:
+        raise ValueError("build_pricer needs ff= or graph=+cost=")
+
+    from flexflow_tpu.search import traffic as traffic_mod
+
+    profile = traffic_mod.get_profile(traffic)
+    stats = profile.prompt_stats()
+    if acceptance_rate is None:
+        measured = (profile.measured_acceptance()
+                    if hasattr(profile, "measured_acceptance") else None)
+        acceptance_rate = (float(measured) if measured is not None
+                           else DEFAULT_ACCEPTANCE_RATE)
+    tick_scale_fn = None
+    if calibration is not None:
+        report = load_calibration(calibration)
+        if report is not None:
+            from flexflow_tpu.search.measured import MeasuredCostModel
+
+            if not isinstance(cost, MeasuredCostModel):
+                cost = MeasuredCostModel(
+                    machine=cost.machine, axis_sizes=dict(cost.axis_sizes),
+                    backward_factor=cost.backward_factor,
+                    param_parallel=cost.param_parallel,
+                    attr_parallel=cost.attr_parallel)
+            cost.set_tick_calibration(report)
+            tick_scale_fn = cost.tick_scale
+    priced = price_layouts(graph, cost, [dict(cost.axis_sizes)], seed=seed)
+    return ServePricer(priced, stats, slots=slots, max_len=max_len,
+                       acceptance_rate=acceptance_rate,
+                       host_dispatch_s=host_dispatch_s,
+                       tick_scale=tick_scale_fn)
 
 
 def search_serve_strategy(
@@ -636,6 +703,7 @@ def search_serve_strategy(
     inner_budget: int = 0, calibration=None,
     acceptance_rate: Optional[float] = None,
     host_dispatch_s: float = HOST_DISPATCH_SECONDS, verbose: bool = False,
+    sim: bool = False,
 ) -> ServeSearchResult:
     """Search the ServeStrategy space for `traffic`, minimizing
     `objective` (default: TTFT p95 + seconds/token at the machine's HBM
@@ -652,7 +720,15 @@ def search_serve_strategy(
     `acceptance_rate=None` (default) resolves automatically: a
     RecordedProfile's MEASURED spec acceptance when `traffic` carries
     one (the --replay path), else the 0.6 prior. An explicit value
-    always wins. The result's `acceptance` dict records which."""
+    always wins. The result's `acceptance` dict records which.
+
+    `sim=True` evaluates each candidate with the event-driven
+    `ticksim.TickSimulator` — replaying the profile's recorded arrival
+    sequence through the simulated tick loop — instead of the
+    closed-form ServePricer, IF the profile carries an arrival trace
+    (a RecordedProfile / --replay log); otherwise it falls back to the
+    closed form with a warning. The result's `backend` field records
+    which backend scored the winner."""
     if ff is not None:
         from flexflow_tpu.search.api import _cost_model
 
@@ -722,6 +798,24 @@ def search_serve_strategy(
                          host_dispatch_s=host_dispatch_s,
                          tick_scale=tick_scale_fn)
 
+    # -- evaluation backend: closed-form algebra or event replay --------
+    backend = "closed-form"
+    simulator = None
+    if sim:
+        from flexflow_tpu.search.ticksim import (
+            TickSimulator,
+            has_arrival_trace,
+        )
+
+        if has_arrival_trace(profile):
+            simulator = TickSimulator(pricer)
+            backend = "ticksim"
+        else:
+            logger.warning(
+                "servesearch sim=True: profile %r carries no arrival "
+                "trace (not a recorded reqlog) — falling back to the "
+                "closed-form pricer", profile.name)
+
     # -- knob table + start point ---------------------------------------
     if default is None:
         default = ServeStrategy()
@@ -771,7 +865,10 @@ def search_serve_strategy(
             except ValueError:
                 hit = (INVALID_OBJECTIVE, None)
             else:
-                m = pricer.metrics(strat)
+                if simulator is not None:
+                    m = simulator.simulate(strat, profile, seed=seed).metrics
+                else:
+                    m = pricer.metrics(strat)
                 hit = (objective.value(m), m)
             cache[key] = hit
         return hit[0]
@@ -805,4 +902,5 @@ def search_serve_strategy(
         objective=objective, trials=len(cache), calibration=cal_summary,
         layouts=[lay.summary() for lay in priced], stats=stats,
         arrival=arrival,
-        acceptance={"rate": acceptance_rate, "source": acceptance_src})
+        acceptance={"rate": acceptance_rate, "source": acceptance_src},
+        backend=backend)

@@ -519,10 +519,25 @@ class _GenerationServerBase:
                  eos_id: Optional[int], seed: int,
                  request_record_limit: Optional[int] = None,
                  reqlog_capacity: Optional[int] = None,
-                 slo=None, slo_dump_dir: Optional[str] = None):
+                 slo=None, slo_dump_dir: Optional[str] = None,
+                 serve_strategy=None, defer_start: bool = False):
         import jax
 
         self.ff = ff
+        # the ServeStrategy this server realizes (search.servesearch),
+        # when known: its fingerprint stamps every reqlog record and the
+        # /v2 metrics payload so records attribute to the strategy that
+        # served them across autopilot swaps. The paged scheduler
+        # derives one from its own knobs when the caller passed none.
+        self.serve_strategy = serve_strategy
+        self._strategy_fp: Optional[str] = None
+        # defer_start=True builds the server WITHOUT launching the loop
+        # thread — the drain-and-swap path warms launch shapes and
+        # absorbs carried requests first, then calls start()
+        self._defer_start = bool(defer_start)
+        # set while detach_for_swap() pauses the loop: the finally-drain
+        # must NOT cancel futures that are about to be carried over
+        self._detaching = False
         self.slots = int(slots)
         self.max_len = int(max_len)
         # learned-position models (GPT-2/BERT-style): serving past the
@@ -615,7 +630,18 @@ class _GenerationServerBase:
 
     def _start(self):
         """Subclasses call this LAST in __init__ (the loop thread must not
-        observe a half-built server)."""
+        observe a half-built server). A defer_start=True server skips it;
+        the builder calls start() after warmup/absorption."""
+        if not self._defer_start:
+            self.start()
+
+    def start(self):
+        """Launch the serving loop thread. Construction does this
+        automatically unless defer_start=True — the drain-and-swap path
+        defers so it can warm_launch_shapes() and absorb carried
+        requests against a loop that is provably not running yet."""
+        if self._thread is not None:
+            raise RuntimeError(f"{type(self).__name__} already started")
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -650,10 +676,13 @@ class _GenerationServerBase:
                  temperature: float = 0.0) -> np.ndarray:
         return self.submit(prompt_ids, max_new_tokens, temperature).result()
 
-    def stop(self):  # fflint: lock-ok (_thread is written once at _start, before any stop() can race)
+    def stop(self):  # fflint: lock-ok (_thread is written once at start(), before any stop() can race)
         with self._lock:
             self._running = False
             self._stop.set()
+        if self._thread is None:  # built deferred, never started
+            self._drain()
+            return
         self._thread.join(timeout=30)
         # drain from this thread ONLY once the loop thread is dead —
         # otherwise its finally-drain owns the cleanup and a concurrent
@@ -682,6 +711,16 @@ class _GenerationServerBase:
         target was declared."""
         return self._slo
 
+    @property
+    def strategy_fingerprint(self) -> Optional[str]:
+        """Short content hash of the ServeStrategy this server realizes
+        (None when unknown — the dense server without an explicit
+        strategy). Stamped into reqlog records and /v2 metrics so
+        post-swap records segment by the strategy that served them."""
+        if self._strategy_fp is None and self.serve_strategy is not None:
+            self._strategy_fp = self.serve_strategy.fingerprint()
+        return self._strategy_fp
+
     def metrics(self) -> dict:  # fflint: lock-ok (relaxed metrics snapshot; int reads are atomic, staleness is fine for scraping)
         """Aggregate serving metrics + per-request records of the last
         `request_record_limit` COMPLETED requests (subclasses extend:
@@ -709,6 +748,11 @@ class _GenerationServerBase:
             "compile": snap,
             "histograms": self.registry.to_json(),
         }
+        if self.serve_strategy is not None:
+            out["strategy"] = {
+                "fingerprint": self.strategy_fingerprint,
+                "knobs": self.serve_strategy.to_json(),
+            }
         if self._slo is not None:
             out["slo"] = self._slo.snapshot()
         return out
@@ -891,6 +935,9 @@ class _GenerationServerBase:
                 "decode_s": max(0.0, done_t - first_t),
             },
         }
+        fp = self.strategy_fingerprint
+        if fp is not None:
+            rec["strategy"] = fp
         return rec
 
     def _release_slot(self, slot: int, req: _GenRequest,
@@ -920,9 +967,15 @@ class _GenerationServerBase:
                     self._g_goodput.set(self._slo.goodput)
                     if tripped:
                         self._c_slo_breaches.inc()
-                        self._slo.dump(reqlog=self._reqlog,
-                                       recorder=obs.recorder(),
-                                       metrics=self.metrics)
+                        self._slo.dump(
+                            reqlog=self._reqlog,
+                            recorder=obs.recorder(),
+                            metrics=self.metrics,
+                            strategy=(self.serve_strategy.to_json()
+                                      if self.serve_strategy is not None
+                                      else None),
+                            compile_snapshot=self._compile_tracker.snapshot(
+                                self._compile_events_base))
             rec = obs.recorder()
             if rec is not None:
                 # lifecycle track (queued→prefill→decode) from the same
@@ -952,6 +1005,51 @@ class _GenerationServerBase:
             # blocked callers always unblock instead of hanging forever
             self._drain()
 
+    # -- drain-and-swap (serving_autopilot) ------------------------------
+
+    def detach_for_swap(self) -> List["_GenRequest"]:
+        """Pause the serving loop WITHOUT cancelling futures and hand
+        back every request still owed a result, in service order:
+        mid-flight requests first (oldest first — re-admission preserves
+        their priority), then whatever was queued. The drain-and-swap
+        half that makes 'zero requests dropped' literal: each returned
+        _GenRequest keeps its Future, its prompt, and every token it has
+        already decoded (seq_tokens()), so a successor server resumes it
+        via absorb_requests() and greedy streams stay token-identical.
+        This server is stopped afterwards — only its pool/caches remain
+        adoptable (PagedGenerationServer.adopt_pool_from)."""
+        with self._lock:
+            self._running = False
+            self._detaching = True
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                self._detaching = False
+                raise RuntimeError(
+                    "serving loop did not pause within 30s — refusing to "
+                    "detach requests from a live loop")
+        carried = self._detach_active()
+        while True:
+            try:
+                carried.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        return carried
+
+    def _detach_active(self) -> List["_GenRequest"]:
+        """Subclass hook: pull mid-flight requests out of their slots
+        without cancelling them (the paged scheduler also publishes
+        tails and frees pages so the successor can re-attach). Only
+        called with the loop provably stopped."""
+        carried: List[_GenRequest] = []
+        for s in range(self.slots):
+            req = self._active[s]
+            if req is not None:
+                self._active[s] = None
+                carried.append(req)
+        return carried
+
     def _loop_body(self, tr, ntr):
         raise NotImplementedError
 
@@ -959,7 +1057,11 @@ class _GenerationServerBase:
         """Cancel whatever is still queued or mid-decode so callers
         unblock — a truncated sequence must not look like a completed one.
         Runs on the loop thread at exit AND on the stop() caller's thread
-        after join, so a submit racing stop() still gets resolved."""
+        after join, so a submit racing stop() still gets resolved.
+        During a drain-and-swap detach the successor server owns every
+        pending future, so cancellation stands down."""
+        if self._detaching:  # fflint: lock-ok (set before _stop under _lock; the loop observes it only after the stop event)
+            return
         for s in range(self.slots):
             req = self._active[s]
             if req is not None:
@@ -996,13 +1098,16 @@ class GenerationServer(_GenerationServerBase):
                  eos_id: Optional[int] = None, seed: int = 0,
                  request_record_limit: Optional[int] = None,
                  reqlog_capacity: Optional[int] = None,
-                 slo=None, slo_dump_dir: Optional[str] = None):
+                 slo=None, slo_dump_dir: Optional[str] = None,
+                 serve_strategy=None, defer_start: bool = False):
         import jax
 
         super().__init__(ff, slots, max_len, eos_id, seed,
                          request_record_limit=request_record_limit,
                          reqlog_capacity=reqlog_capacity,
-                         slo=slo, slo_dump_dir=slo_dump_dir)
+                         slo=slo, slo_dump_dir=slo_dump_dir,
+                         serve_strategy=serve_strategy,
+                         defer_start=defer_start)
         ex = ff.executor
         self._step = ex.decode_fn()
         self._prefill_step = self._step  # one fn, two input shapes
@@ -1110,7 +1215,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                      reqlog_capacity: Optional[int] = None,
                      slo=None,
                      slo_dump_dir: Optional[str] = None,
-                     kv_quant_canary: Optional[int] = None
+                     kv_quant_canary: Optional[int] = None,
+                     defer_start: bool = False
                      ) -> "_GenerationServerBase":
     """Continuous-batching generation endpoint over a compiled causal-LM
     FFModel (KV-cache decode path required — see FFModel.generate).
@@ -1251,7 +1357,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             request_record_limit=request_record_limit,
             kv_dtype=kv_dtype, reqlog_capacity=reqlog_capacity,
             slo=slo, slo_dump_dir=slo_dump_dir,
-            kv_quant_canary=kv_quant_canary)
+            kv_quant_canary=kv_quant_canary,
+            serve_strategy=serve_strategy, defer_start=defer_start)
     if paged:
         from flexflow_tpu.paged.scheduler import PagedGenerationServer
 
@@ -1263,7 +1370,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             request_record_limit=request_record_limit,
             kv_dtype=kv_dtype, reqlog_capacity=reqlog_capacity,
             slo=slo, slo_dump_dir=slo_dump_dir,
-            kv_quant_canary=kv_quant_canary)
+            kv_quant_canary=kv_quant_canary,
+            serve_strategy=serve_strategy, defer_start=defer_start)
     if kv_dtype != "auto":
         raise ValueError(
             "kv_dtype rides the paged KV pool; pass paged=True")
@@ -1275,4 +1383,6 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                             seed=seed,
                             request_record_limit=request_record_limit,
                             reqlog_capacity=reqlog_capacity,
-                            slo=slo, slo_dump_dir=slo_dump_dir)
+                            slo=slo, slo_dump_dir=slo_dump_dir,
+                            serve_strategy=serve_strategy,
+                            defer_start=defer_start)

@@ -59,7 +59,8 @@ class SpeculativePagedServer(PagedGenerationServer):
                  kv_dtype: str = "auto",
                  reqlog_capacity: Optional[int] = None,
                  slo=None, slo_dump_dir: Optional[str] = None,
-                 kv_quant_canary: Optional[int] = None):
+                 kv_quant_canary: Optional[int] = None,
+                 serve_strategy=None, defer_start: bool = False):
         if not isinstance(spec, SpecConfig):
             raise TypeError(
                 f"speculate must be a SpecConfig, got {type(spec).__name__}")
@@ -86,7 +87,9 @@ class SpeculativePagedServer(PagedGenerationServer):
                          kv_dtype=kv_dtype,
                          reqlog_capacity=reqlog_capacity,
                          slo=slo, slo_dump_dir=slo_dump_dir,
-                         kv_quant_canary=kv_quant_canary)
+                         kv_quant_canary=kv_quant_canary,
+                         serve_strategy=serve_strategy,
+                         defer_start=defer_start)
         # per-tick draft acceptance rate (accepted / drafted this tick)
         self._h_accept = self.registry.histogram("spec_acceptance",
                                                  obs.RATIO_BUCKETS)

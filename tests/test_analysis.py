@@ -1168,6 +1168,41 @@ def test_poolcheck_flags_dropped_scale_sidecar_rewrite(mutation):
         (trace, replayed)
 
 
+def test_poolcheck_swap_op_models_the_drain_and_swap_handoff():
+    """The `swap` op (strategy change in flight: publish tails, free
+    leaf-first, requeue — the model of scheduler._detach_active feeding
+    adopt_pool_from/absorb_requests) is part of the explored op set
+    whenever a request is active, and the shipped hand-off replays
+    clean — the exhaustive clean sweep above
+    (test_poolcheck_model_clean_and_fully_explored_on_real_pool)
+    already explores it from EVERY reachable state of both configs."""
+    from flexflow_tpu.analysis import poolcheck
+
+    for trace in (["admit(0)", "swap"],
+                  ["admit(0)", "admit(1)", "step(0)", "swap",
+                   "admit(0)", "swap"]):
+        assert poolcheck.replay(trace, "base") == [], trace
+
+
+def test_poolcheck_flags_swap_that_skips_freeing_detached_pages():
+    """Seeded defect: a drain-and-swap that detaches live owners but
+    leaves their pages allocated in the adopted pool — the carried
+    requests re-admit and the old pages leak with no owner, which the
+    refcount-owners invariant must catch with a minimal trace ending in
+    the swap op."""
+    from flexflow_tpu.analysis import poolcheck
+
+    res = poolcheck.model_check("base", mutations=("swap_free_skip",))
+    assert any(h[0] == "refcount-owners" for h in res.hits), res.hits
+    name, _msg, trace = next(h for h in res.hits
+                             if h[0] == "refcount-owners")
+    assert trace[-1] == "swap", trace
+    replayed = poolcheck.replay(trace, "base",
+                                mutations=("swap_free_skip",))
+    assert any(v.split(":")[0] == name for v in replayed), (trace,
+                                                           replayed)
+
+
 def test_kv_pricing_dtype_misprice_fixture():
     """Seeded dtype mispricing: an int8 KV pool priced at the model
     dtype looks ~4x bigger than the buffers the executor actually
@@ -1551,3 +1586,44 @@ def test_shapecheck_shrunk_catalog_fails_soundness():
     assert findings[0].severity == "error"
     assert findings[0].where == "shapecheck:catalog/ragged_step"
     assert "(2, 1)" in findings[0].message
+
+
+def test_shapecheck_union_catalog_spans_a_strategy_swap():
+    """union_catalogs merges per-strategy launch-shape catalogs into
+    the one a drain-and-swap cutover is judged against: shapes from
+    EITHER side are sound, shared shapes count once, and soundness
+    still fails for a shape neither strategy enumerates."""
+    from flexflow_tpu.analysis.shapecheck import (
+        check_soundness,
+        enumerate_catalog,
+        union_catalogs,
+    )
+
+    old = enumerate_catalog(slots=2, max_len=32, page_size=4,
+                            prefill_chunk=6)
+    new = enumerate_catalog(slots=2, max_len=32, page_size=4,
+                            prefill_chunk=4, megastep_ticks=4)
+    union = union_catalogs(old, new)
+    # entry-wise set union; the shared decode/pick shapes count once
+    for cat in (old, new):
+        for entry, ent in cat["entries"].items():
+            got = {tuple(s) for s in union["entries"][entry]["shapes"]}
+            assert got >= {tuple(s) for s in ent["shapes"]}, entry
+    assert union["total_compilations"] < (old["total_compilations"]
+                                          + new["total_compilations"])
+    assert union["config"]["union"] == [old["config"], new["config"]]
+
+    # the cutover gate: one event only the OLD side emits (a width-6
+    # prefill), one only the NEW side emits (its fused megastep
+    # program) — the union judges both sound
+    events = [{"entry": "ragged_step", "shape": (1, 6), "seconds": 0.4,
+               "steady_state": False},
+              {"entry": "megastep", "shape": (2, 4), "seconds": 0.4,
+               "steady_state": False}]
+    assert check_soundness(old, [events[1]]) != []
+    assert check_soundness(new, [events[0]]) != []
+    assert check_soundness(union, events) == []
+    rogue = [{"entry": "ragged_step", "shape": (2, 9), "seconds": 0.4,
+              "steady_state": True}]
+    assert [f.code for f in check_soundness(union, rogue)] == \
+        ["shape-catalog-unsound"]

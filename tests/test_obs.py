@@ -723,13 +723,20 @@ def test_slo_breach_capture_end_to_end(tmp_path):
     bundle = slo["last_dump"]
     assert bundle == dump_dir + "/breach_0001"
     for name in ("reqlog_tail.jsonl", "trace_tail.json", "metrics.json",
-                 "slo.json"):
+                 "slo.json", "strategy.json", "compile.json"):
         assert (tmp_path / "dumps" / "breach_0001" / name).exists(), name
     # the dump ran mid-loop: its metrics snapshot already carries the
     # tripping request's reqlog record and the breach count
     dumped = json.load(open(bundle + "/metrics.json"))
     assert dumped["reqlog"]["records"] >= 1
     assert dumped["slo"]["breaches"] == 1
+    # the bundle says WHAT was breaching: the active ServeStrategy and
+    # whether recompiles were part of the excursion (ISSUE 16 satellite)
+    strat = json.load(open(bundle + "/strategy.json"))
+    assert strat["page_size"] == 4
+    comp = json.load(open(bundle + "/compile.json"))
+    assert comp["compile_events_total"] >= 1
+    assert comp["steady_state_recompiles"] == 0
 
 
 def test_slo_prometheus_series_gated_on_target():
@@ -783,3 +790,18 @@ def test_fftrace_replay_cli(tmp_path, capsys):
         "decode_tokens"]
     for k in ("ttft_p50_s", "ttft_p95_s", "tokens_per_s"):
         assert k in rep["delta"]
+    assert "paced" not in rep                 # opt-in only
+    # --pace=SPEEDUP additionally replays the recorded interarrival
+    # gaps (compressed 50x so the test stays fast) — the paced section
+    # reports its own replayed stats and deltas (ISSUE 16 satellite)
+    assert fft.main(["replay", log, "--out", str(tmp_path),
+                     "--pace", "50"]) == 0
+    capsys.readouterr()
+    rep = json.load(open(str(tmp_path / "replay_report.json")))
+    paced = rep["paced"]
+    assert paced["speedup"] == 50.0
+    assert paced["replayed"]["requests"] == 2
+    assert paced["replayed"]["decode_tokens"] == rep["recorded"][
+        "decode_tokens"]
+    for k in ("ttft_p50_s", "ttft_p95_s", "tokens_per_s"):
+        assert k in paced["delta"]

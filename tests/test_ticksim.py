@@ -1,0 +1,391 @@
+"""Event-driven serving simulation + online re-tuning (ISSUE 16).
+
+Contracts under test: `search.ticksim` replays a RecordedProfile's real
+arrival sequence through a priced copy of the serving tick loop — fixed
+seed makes it bit-reproducible, bursts queue where trickles do not, and
+its TTFT p95 lands STRICTLY closer to the served ground truth than the
+closed-form pricer on the smoke and agentic-multiturn profiles; the
+`--sim` search backend engages only when an arrival trace exists; and
+`serving_autopilot` hot-swaps a live ServeStrategy with zero dropped
+requests (greedy streams stay token-identical across the cutover), zero
+steady-state recompiles after the warmed handoff, the page pool adopted
+when the geometry matches, and reqlog history spanning the swap with
+per-strategy fingerprint stamps.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+from flexflow_tpu.ffconst import DataType
+from flexflow_tpu.models.llama import LlamaConfig, build_llama
+from flexflow_tpu.search import traffic as traffic_mod
+from flexflow_tpu.search.servesearch import (
+    PricedLayout,
+    ServePricer,
+    ServeStrategy,
+    build_pricer,
+    search_serve_strategy,
+)
+from flexflow_tpu.search.ticksim import (
+    TickSimulator,
+    _percentile,
+    arrivals_from_profile,
+    has_arrival_trace,
+)
+
+
+# ---------------------------------------------------------------------------
+# pure simulation (synthetic pricer — no model, no compile)
+
+
+def _lay():
+    return PricedLayout(axis_sizes={}, strategy={}, step_s=1e-3,
+                        base_tokens=256, mem_bytes=1e6, kv_token_bytes=512,
+                        mode="test", kv_token_elems=128, kv_scale_elems=16)
+
+
+def _rec(sub_s, prompt, decode, chain=()):
+    done = sub_s + 0.2 + 0.05 * decode
+    return {
+        "submit_ns": int(sub_s * 1e9),
+        "first_token_ns": int((sub_s + 0.1) * 1e9),
+        "done_ns": int(done * 1e9),
+        "prompt_tokens": prompt,
+        "decode_tokens": decode,
+        "cached_prefill_tokens": 0,
+        "prefill_tokens": prompt,
+        "prefix_chain": list(chain),
+        "page_size": 8,
+        "spec_draft_tokens": 0,
+        "spec_accepted_tokens": 0,
+    }
+
+
+def _profile(subs, prompt=12, decode=6):
+    return traffic_mod.RecordedProfile(
+        [_rec(s, prompt, decode) for s in subs], name="synthetic")
+
+
+def _pricer(profile, slots=4, max_len=128):
+    return ServePricer([_lay()], profile.prompt_stats(), slots=slots,
+                       max_len=max_len)
+
+
+def test_has_arrival_trace_gates_the_sim_backend():
+    recorded = _profile([0.0, 0.5])
+    assert has_arrival_trace(recorded)
+    assert not has_arrival_trace(traffic_mod.get_profile("smoke"))
+
+
+def test_sim_bit_reproducible_under_fixed_seed():
+    """Simulated time is priced seconds, never wall clock: the whole
+    timeline JSON (every per-request event time) is identical across
+    runs with the same seed."""
+    prof = _profile([0.0, 0.0, 0.1, 0.2, 0.2, 0.4, 0.4, 0.4])
+    strat = ServeStrategy(page_size=16, prefill_chunk=32, spec_width=2,
+                          spec_depth=2)
+    a = TickSimulator(_pricer(prof)).simulate(strat, prof, seed=7)
+    b = TickSimulator(_pricer(prof)).simulate(strat, prof, seed=7)
+    assert json.dumps(a.timeline_json(), sort_keys=True) == \
+        json.dumps(b.timeline_json(), sort_keys=True)
+    assert a.metrics["ttft_p95_s"] == b.metrics["ttft_p95_s"]
+
+
+def test_sim_completes_every_request_with_full_timeline():
+    prof = _profile([0.0, 0.3, 0.6, 0.9], prompt=20, decode=5)
+    strat = ServeStrategy(page_size=16, prefill_chunk=32)
+    res = TickSimulator(_pricer(prof)).simulate(strat, prof, seed=0)
+    assert len(res.records) == 4
+    for r in res.records:
+        assert r["done_s"] is not None
+        assert r["decode_tokens"] == 5
+        assert r["admit_s"] >= r["submit_s"]
+        assert r["first_token_s"] > r["admit_s"]
+        assert r["done_s"] >= r["first_token_s"]
+    doc = res.timeline_json()
+    assert doc["backend"] == "ticksim" and doc["version"] == 1
+    assert doc["metrics"]["makespan_s"] == res.makespan_s > 0
+    # the merged metrics keep the closed-form statics (HBM bill)
+    assert doc["metrics"]["hbm_bytes"] > 0
+
+
+def test_sim_burst_queues_where_a_trickle_does_not():
+    """The whole point of the event backend: 12 requests at t=0 on 4
+    slots queue for waves; the same 12 spread out do not. Closed-form
+    pricing cannot see this distinction — both profiles have identical
+    prompt moments."""
+    burst = _profile([0.0] * 12)
+    spread = _profile([0.8 * i for i in range(12)])
+    strat = ServeStrategy(page_size=16, prefill_chunk=32)
+    b = TickSimulator(_pricer(burst)).simulate(strat, burst, seed=0)
+    s = TickSimulator(_pricer(spread)).simulate(strat, spread, seed=0)
+    assert b.metrics["queue_p95_s"] > s.metrics["queue_p95_s"]
+    assert b.metrics["ttft_p95_s"] > s.metrics["ttft_p95_s"]
+    # both profiles hand the closed form identical prompt-shape
+    # moments — it only sees arrival structure through the single
+    # offered-concurrency scalar, never per-wave queueing
+    bs, ss = burst.prompt_stats(), spread.prompt_stats()
+    for k in ("mean_prompt_tokens", "p95_prompt_tokens", "new_tokens"):
+        assert bs[k] == ss[k]
+
+
+def test_sim_megastep_and_spec_strategies_run():
+    prof = _profile([0.0, 0.1, 0.2, 0.3], decode=8)
+    for strat in (ServeStrategy(page_size=16, megastep_ticks=8),
+                  ServeStrategy(page_size=16, spec_width=2, spec_depth=3)):
+        res = TickSimulator(_pricer(prof)).simulate(strat, prof, seed=1)
+        assert all(r["done_s"] is not None for r in res.records)
+        assert sum(r["decode_tokens"] for r in res.records) == 4 * 8
+        assert res.metrics["backend"] == "ticksim"
+
+
+def test_sim_pool_pressure_evicts_mid_tick_without_corruption():
+    """Regression: under a shrunk pool (pool_fraction < 1) a slot's
+    page grow can preempt ANOTHER slot that the same decode tick
+    already scanned — the evicted slot must simply decode nothing that
+    tick, not crash the scan. Every request still finishes, and the
+    preemption shows up in the tally."""
+    prof = _profile([0.0] * 8, prompt=8, decode=56)
+    strat = ServeStrategy(page_size=8, prefill_chunk=32,
+                          pool_fraction=0.25)
+    res = TickSimulator(_pricer(prof)).simulate(strat, prof, seed=0)
+    assert all(r["done_s"] is not None for r in res.records)
+    assert res.preemptions > 0
+    assert res.metrics["sim_preemptions"] == res.preemptions
+
+
+def test_sim_arrivals_clamped_to_pool_geometry():
+    prof = _profile([0.0], prompt=500, decode=50)
+    reqs = arrivals_from_profile(prof, max_len=64)
+    assert reqs[0].prompt_tokens < 64
+    assert reqs[0].prompt_tokens + reqs[0].new_tokens <= 64
+
+
+# ---------------------------------------------------------------------------
+# the --sim search backend (graph + cost — no compile)
+
+
+def _graph():
+    ff = FFModel(FFConfig(batch_size=4, num_devices=1))
+    build_llama(ff, LlamaConfig.tiny(vocab=512), batch_size=4, seq_len=64,
+                dtype=DataType.FLOAT)
+    ff.graph.infer_shapes()
+    return ff.graph
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return _graph()
+
+
+def _cost():
+    from flexflow_tpu.search.cost_model import CostModel
+    from flexflow_tpu.search.machine_model import TPUMachineModel
+
+    return CostModel(TPUMachineModel.make("v5e", 8),
+                     {"data": 2, "model": 4})
+
+
+def test_search_sim_backend_on_recorded_traffic(graph):
+    """`servesearch --sim --replay`: with an arrival trace the search
+    scores candidates with the tick simulator, the result says so, and
+    the winner is no worse than the default under that scoring. Fixed
+    seed keeps it deterministic."""
+    prof = _profile([0.0] * 6 + [0.2] * 6, prompt=16, decode=8)
+    a = search_serve_strategy(graph=graph, cost=_cost(), traffic=prof,
+                              budget=60, seed=0, slots=4, max_len=128,
+                              sim=True)
+    assert a.backend == "ticksim"
+    assert a.improvement >= 0.0
+    assert a.best_objective <= a.default_objective
+    a.best.validate(max_len=128)
+    b = search_serve_strategy(graph=graph, cost=_cost(), traffic=prof,
+                              budget=60, seed=0, slots=4, max_len=128,
+                              sim=True)
+    assert a.best == b.best and a.best_objective == b.best_objective
+
+
+def test_search_sim_falls_back_closed_form_without_trace(graph):
+    """A named profile has no arrival sequence to replay — `--sim`
+    falls back to the closed form and the result records the honest
+    backend."""
+    res = search_serve_strategy(graph=graph, cost=_cost(), traffic="smoke",
+                                budget=40, seed=0, slots=4, max_len=128,
+                                sim=True)
+    assert res.backend == "closed-form"
+    plain = search_serve_strategy(graph=graph, cost=_cost(),
+                                  traffic="smoke", budget=40, seed=0,
+                                  slots=4, max_len=128)
+    assert plain.backend == "closed-form"
+    assert res.best == plain.best
+
+
+# ---------------------------------------------------------------------------
+# sim vs served ground truth (real serving on the tiny model)
+
+
+def _causal_lm():
+    lcfg = LlamaConfig.tiny()
+    ff = FFModel(FFConfig(batch_size=1, seed=7))
+    build_llama(ff, lcfg, batch_size=1, seq_len=8, dtype=DataType.FLOAT)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff, lcfg
+
+
+@pytest.mark.parametrize("profile_name", ["smoke", "agentic-multiturn"])
+def test_sim_ttft_p95_closer_to_measured_than_closed_form(profile_name):
+    """ISSUE 16 acceptance: on a recorded bursty profile the simulated
+    TTFT p95 must land STRICTLY closer to the served ground truth than
+    the closed-form estimate. Both backends get the same global clock
+    calibration (their own throughput against the measured one), so the
+    margin is purely the queue structure the event backend models."""
+    ff, lcfg = _causal_lm()
+    prof = traffic_mod.get_profile(profile_name, requests=12, new_tokens=8)
+    warm = prof.sample(np.random.RandomState(11), lcfg.vocab_size)
+    sample = prof.sample(np.random.RandomState(12), lcfg.vocab_size)
+    gen = ff.serve_generation(slots=2, max_len=64, paged=True, page_size=8)
+    try:
+        # warm pass: same launch shapes (same lengths), different
+        # tokens — the measured burst below is compile-free
+        for f in [gen.submit(p, max_new_tokens=8) for p in warm.prompts]:
+            f.result(timeout=300)
+        base = len(gen.request_log.records())
+        for f in [gen.submit(p, max_new_tokens=8) for p in sample.prompts]:
+            f.result(timeout=300)
+        records = gen.request_log.records()[base:]
+        strategy = gen.serve_strategy
+    finally:
+        gen.stop()
+    assert len(records) == 12
+
+    measured_p95 = _percentile(
+        [(r["first_token_ns"] - r["submit_ns"]) / 1e9 for r in records],
+        0.95)
+    makespan = (max(r["done_ns"] for r in records)
+                - min(r["submit_ns"] for r in records)) / 1e9
+    measured_tps = sum(r["decode_tokens"] for r in records) / makespan
+
+    rprof = traffic_mod.RecordedProfile(records, name="measured")
+    pricer = build_pricer(ff, traffic=rprof, slots=2, max_len=64)
+    sim = TickSimulator(pricer).simulate(strategy, rprof, seed=0)
+    closed = pricer.metrics(strategy)
+    sim_cal = (sim.metrics["ttft_p95_s"]
+               * sim.metrics["tokens_per_s"] / measured_tps)
+    closed_cal = (closed["ttft_p95_s"]
+                  * closed["tokens_per_s"] / measured_tps)
+    assert abs(sim_cal - measured_p95) < abs(closed_cal - measured_p95), (
+        f"sim {sim_cal:.4f} closed {closed_cal:.4f} "
+        f"measured {measured_p95:.4f}")
+
+
+# ---------------------------------------------------------------------------
+# autopilot: drain-and-swap under live traffic
+
+
+def test_autopilot_hot_swap_zero_drops_and_zero_recompiles():
+    """THE swap acceptance test: greedy streams submitted continuously
+    while the autopilot warms and cuts over to a new strategy stay
+    token-identical to dense generate; pending requests are carried
+    (none dropped), the same-geometry pool is adopted, post-cutover
+    traffic causes zero steady-state recompiles, shapecheck soundness
+    holds against the union catalog spanning both strategies, and the
+    reqlog survives the swap with records segmented by fingerprint."""
+    from flexflow_tpu.analysis.shapecheck import check_soundness
+    from flexflow_tpu.serving_autopilot import ServingAutopilot
+
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(5)
+    pool = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+            for n in (3, 5, 4, 6)]
+    want = [ff.generate(p[None, :], max_new_tokens=8)[0] for p in pool]
+
+    ap = ServingAutopilot(ff, ServeStrategy(page_size=8, prefill_chunk=32),
+                          slots=2, max_len=32)
+    try:
+        fp_old = ap.strategy_fingerprint
+        alt = dataclasses.replace(ap.strategy, prefill_chunk=16)
+        swap = {}
+        worker = threading.Thread(
+            target=lambda: swap.update(ap.swap_to(alt)))
+        worker.start()
+        futs = []
+        i = 0
+        while worker.is_alive():
+            if sum(1 for _, f in futs if not f.done()) < 6:
+                futs.append(
+                    (i % 4, ap.submit(pool[i % 4], max_new_tokens=8)))
+                i += 1
+            else:
+                time.sleep(0.02)
+        worker.join()
+        # zero dropped, token-identical across the cutover
+        for k, f in futs:
+            np.testing.assert_array_equal(
+                want[k], np.asarray(f.result(timeout=300)))
+        assert swap["carried"] >= 1
+        assert swap["pool_adopted"] is True     # same geometry
+        assert swap["to"] == alt.fingerprint() != fp_old
+        # post-swap traffic: warmed cutover -> no steady recompiles
+        for j, f in enumerate(
+                [ap.submit(pool[j % 4], max_new_tokens=8)
+                 for j in range(4)]):
+            np.testing.assert_array_equal(
+                want[j % 4], np.asarray(f.result(timeout=300)))
+        events = ap.server.compile_events()
+        assert [e for e in events if e.get("steady_state")] == []
+        assert check_soundness(ap.catalog, events) == []
+        # reqlog spans the swap, segmented by strategy stamp
+        stamps = {r.get("strategy") for r in ap.request_log.records()}
+        assert stamps == {fp_old, alt.fingerprint()}
+        m = ap.metrics()
+        assert m["autopilot"]["swaps"] == 1
+        assert m["strategy"]["fingerprint"] == alt.fingerprint()
+    finally:
+        ap.stop()
+
+
+def test_autopilot_step_gates_and_decision_log():
+    """Controller decisions without a swap: an empty window holds on
+    insufficient-window; a full window searches (the ticksim backend,
+    since the window IS an arrival trace) but holds below the
+    improvement threshold; an unchanged window then holds on no-drift
+    without re-searching. Every completed request carries the strategy
+    fingerprint stamp the window segmentation depends on."""
+    from flexflow_tpu.serving_autopilot import ServingAutopilot
+
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(9)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 5, 4, 6)]
+    ap = ServingAutopilot(ff, ServeStrategy(page_size=8, prefill_chunk=32),
+                          slots=2, max_len=32, min_window=4,
+                          improvement=1e9, budget=24)
+    try:
+        d = ap.step()
+        assert d["action"] == "hold" and d["reason"] == "insufficient-window"
+        for f in [ap.submit(p, max_new_tokens=6) for p in prompts]:
+            f.result(timeout=300)
+        fp = ap.strategy_fingerprint
+        assert all(r.get("strategy") == fp
+                   for r in ap.request_log.records())
+        d = ap.step(force=True)
+        assert d["action"] == "hold"
+        assert d["reason"] in ("below-threshold", "already-optimal")
+        assert d["backend"] == "ticksim"
+        assert d["window"] == 4
+        d = ap.step()                       # same window -> drift 0
+        assert d["reason"] == "no-drift" and d["drift"] == 0.0
+        m = ap.metrics()["autopilot"]
+        assert m["steps"] == 3 and m["swaps"] == 0 and m["holds"] == 3
+        assert len(m["decisions"]) == 3
+        assert m["window_records"] == 4
+        assert m["predicted_ttft_p95_s"] > 0
+        assert m["measured_ttft_p95_s"] > 0
+    finally:
+        ap.stop()

@@ -195,13 +195,16 @@ PASS_ROOTS = {
     "hostsync": ("flexflow_tpu/runtime", "flexflow_tpu/serving.py",
                  "flexflow_tpu/paged", "flexflow_tpu/spec",
                  "flexflow_tpu/obs", "flexflow_tpu/analysis",
+                 "flexflow_tpu/serving_autopilot.py",
                  "tools/fflint.py"),
     "poolcheck": ("flexflow_tpu/paged", "flexflow_tpu/spec",
                   "flexflow_tpu/serving.py", "flexflow_tpu/analysis",
+                  "flexflow_tpu/serving_autopilot.py",
                   "tools/fflint.py"),
     "shapecheck": ("flexflow_tpu/paged", "flexflow_tpu/spec",
                    "flexflow_tpu/serving.py", "flexflow_tpu/runtime",
                    "flexflow_tpu/obs", "flexflow_tpu/analysis",
+                   "flexflow_tpu/serving_autopilot.py",
                    "tools/fflint.py"),
 }
 

@@ -17,14 +17,19 @@ Subcommands:
       The last stdout line is a one-line JSON summary.
 
   replay REQLOG.jsonl [--out DIR] [--seed S] [--slots K] [--max-len L]
-         [--page-size P]
+         [--page-size P] [--pace[=SPEEDUP]]
       Re-serve a recorded request log against the current (tiny smoke)
       server config: the log's RecordedProfile replays the recorded
       arrival order and prompt lengths (content re-drawn — logs never
       hold raw tokens) with each request's recorded decode budget, on a
       speculative server when the log recorded drafting. Reports
-      recorded-vs-replayed TTFT p50/p95 and tokens/s deltas; the last
-      stdout line is the JSON report.
+      recorded-vs-replayed TTFT/queue-time p50/p95 and tokens/s deltas.
+      The default replay is a BURST (every request queued at once);
+      --pace additionally replays the recorded interarrival deltas
+      (sleeping each gap, divided by SPEEDUP) so the replayed
+      percentiles are measured under the recorded arrival process and
+      compare apples-to-apples — the report carries both modes' deltas.
+      The last stdout line is the JSON report.
 
   calibrate LEDGER [--out FILE]
       Load a saved TickLedger and emit the calibration report: per
@@ -155,6 +160,8 @@ def cmd_replay(args) -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
+    import time
+
     import numpy as np
 
     from flexflow_tpu.obs.slo import percentile
@@ -165,6 +172,8 @@ def cmd_replay(args) -> int:
     def _stats(records):
         ttfts = [(r["first_token_ns"] - r["submit_ns"]) / 1e9
                  for r in records]
+        queues = [max(0.0, (r["admit_ns"] - r["submit_ns"]) / 1e9)
+                  for r in records]
         makespan = (max(r["done_ns"] for r in records)
                     - min(r["submit_ns"] for r in records)) / 1e9
         toks = sum(int(r.get("decode_tokens", 0)) for r in records)
@@ -172,42 +181,72 @@ def cmd_replay(args) -> int:
             "requests": len(records),
             "ttft_p50_s": percentile(ttfts, 0.5),
             "ttft_p95_s": percentile(ttfts, 0.95),
+            "queue_p50_s": percentile(queues, 0.5),
+            "queue_p95_s": percentile(queues, 0.95),
             "decode_tokens": toks,
             "tokens_per_s": toks / makespan if makespan > 0 else 0.0,
         }
 
+    _DELTA_KEYS = ("ttft_p50_s", "ttft_p95_s", "queue_p50_s",
+                   "queue_p95_s", "tokens_per_s")
     recorded = _stats(profile.records)
     ff = _build_tiny_ff()
-    rs = np.random.RandomState(args.seed)
-    sampled = profile.sample(rs, vocab=128)
     speculate = None
     if profile.measured_acceptance() is not None:
         # the log drafted, so the replay drafts: same server family
         from flexflow_tpu.spec import SpecConfig
 
         speculate = SpecConfig(width=2, depth=3)
-    server = ff.serve_generation(
-        slots=args.slots, max_len=args.max_len, paged=True,
-        page_size=args.page_size, speculate=speculate)
-    try:
-        budgets = profile.new_tokens_per_request
-        futs = [server.submit(p, max_new_tokens=budgets[i % len(budgets)])
-                for i, p in enumerate(sampled.prompts)]
-        for f in futs:
-            f.result(timeout=600)
-        replayed_records = server.request_log.records()
-    finally:
-        server.stop()
-    replayed = _stats(replayed_records)
+
+    def _serve(pace):
+        """One replay pass. pace=None submits in recorded ORDER only
+        (burst — every request queued at once, the worst case); a
+        float sleeps the recorded interarrival deltas compressed by
+        that speedup factor, so queue-time and TTFT percentiles are
+        measured under the recorded arrival PROCESS and compare
+        directly to the log's own."""
+        rs = np.random.RandomState(args.seed)
+        sampled = profile.sample(rs, vocab=128)
+        server = ff.serve_generation(
+            slots=args.slots, max_len=args.max_len, paged=True,
+            page_size=args.page_size, speculate=speculate)
+        try:
+            budgets = profile.new_tokens_per_request
+            submit_ns = [r["submit_ns"] for r in profile.records]
+            futs = []
+            for i, p in enumerate(sampled.prompts):
+                if pace and i > 0:
+                    delta = (submit_ns[i % len(submit_ns)]
+                             - submit_ns[(i - 1) % len(submit_ns)])
+                    if delta > 0:
+                        time.sleep(delta / 1e9 / pace)
+                futs.append(server.submit(
+                    p, max_new_tokens=budgets[i % len(budgets)]))
+            for f in futs:
+                f.result(timeout=600)
+            return _stats(server.request_log.records())
+        finally:
+            server.stop()
+
+    replayed = _serve(None)
     doc = {
         "log": args.log,
         "profile": profile.name,
         "speculate": speculate is not None,
         "recorded": recorded,
         "replayed": replayed,
-        "delta": {k: replayed[k] - recorded[k]
-                  for k in ("ttft_p50_s", "ttft_p95_s", "tokens_per_s")},
+        "delta": {k: replayed[k] - recorded[k] for k in _DELTA_KEYS},
     }
+    if args.pace is not None:
+        # both modes ride one report: the burst numbers above show the
+        # config's queueing worst case, the paced numbers are the
+        # apples-to-apples comparison against the recorded percentiles
+        paced = _serve(args.pace)
+        doc["paced"] = {
+            "speedup": args.pace,
+            "replayed": paced,
+            "delta": {k: paced[k] - recorded[k] for k in _DELTA_KEYS},
+        }
     if args.out:
         os.makedirs(args.out, exist_ok=True)
         path = os.path.join(args.out, "replay_report.json")
@@ -280,6 +319,13 @@ def main(argv=None) -> int:
     rp.add_argument("--slots", type=int, default=2)
     rp.add_argument("--max-len", type=int, default=48)
     rp.add_argument("--page-size", type=int, default=8)
+    rp.add_argument("--pace", nargs="?", const=1.0, type=float,
+                    default=None, metavar="SPEEDUP",
+                    help="ALSO run a paced replay sleeping the recorded "
+                         "interarrival deltas (divided by SPEEDUP, "
+                         "default 1.0 = real time) — the report then "
+                         "carries both modes' recorded-vs-replayed "
+                         "deltas")
     rp.set_defaults(func=cmd_replay)
 
     ca = sub.add_parser("calibrate", help="predicted-vs-measured report")

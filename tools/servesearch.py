@@ -26,7 +26,18 @@ Subcommands:
       refused with a warning. --mesh-layouts takes
       "data=8;data=2,model=4" — candidate serving meshes each
       shard-searched by the existing MCMC driver for --inner-budget
-      iterations. The last stdout line is a one-line JSON summary.
+      iterations. With --sim (and --replay) every candidate is scored
+      by the EVENT-DRIVEN tick simulator (search/ticksim.py) replaying
+      the log's recorded arrival sequence instead of the closed-form
+      pricer, so bursts and queue depth shape the pick. The last
+      stdout line is a one-line JSON summary.
+
+  simulate REQLOG.jsonl [--strategy STRATEGY.json] [--slots K]
+           [--max-len L] [--seed S] [--out TIMELINE.json]
+      Replay a recorded request log through the discrete-event tick
+      simulator under one strategy: per-request TTFT/queue/decode
+      timelines (--out writes the JSON), burst-aware p50/p95, and the
+      closed-form TTFT p95 alongside for contrast.
 
   explain RESULT.json [--calibration REPORT.json]
       Human-readable breakdown of a search result: the winning knobs,
@@ -113,13 +124,14 @@ def cmd_search(args) -> int:
         slots=args.slots, max_len=args.max_len, objective=objective,
         calibration=args.calibration, acceptance_rate=args.acceptance_rate,
         layouts=_parse_layouts(args.mesh_layouts),
-        inner_budget=args.inner_budget)
+        inner_budget=args.inner_budget, sim=args.sim)
     doc = res.to_json()
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
     print(json.dumps({
         "profile": res.traffic,
+        "backend": res.backend,
         "best": res.best.describe(),
         "best_objective": res.best_objective,
         "default_objective": res.default_objective,
@@ -128,6 +140,53 @@ def cmd_search(args) -> int:
         "calibration": res.calibration,
         "acceptance": res.acceptance,
         "arrival": res.arrival,
+        "out": args.out,
+    }))
+    return 0
+
+
+def cmd_simulate(args) -> int:
+    from flexflow_tpu.search.servesearch import ServeStrategy, build_pricer
+    from flexflow_tpu.search.ticksim import TickSimulator
+    from flexflow_tpu.search.traffic import RecordedProfile
+
+    import dataclasses
+
+    profile = RecordedProfile.from_reqlog(args.reqlog)
+    strategy = ServeStrategy()
+    # default knobs clamp to the serving window, same as the search
+    strategy = dataclasses.replace(
+        strategy, page_size=min(strategy.page_size, args.max_len),
+        prefill_chunk=min(strategy.prefill_chunk, args.max_len))
+    if args.strategy:
+        with open(args.strategy) as f:
+            doc = json.load(f)
+        # accept a bare strategy JSON (servesearch apply --out) or a
+        # full search result (its `best` is the strategy)
+        if isinstance(doc.get("best"), dict):
+            doc = doc["best"]
+        strategy = ServeStrategy.from_json(doc)
+    ff = _build_tiny_ff()
+    pricer = build_pricer(ff, traffic=profile, slots=args.slots,
+                          max_len=args.max_len,
+                          calibration=args.calibration)
+    sim = TickSimulator(pricer).simulate(strategy, profile,
+                                         seed=args.seed)
+    closed = pricer.metrics(strategy)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(sim.timeline_json(), f, indent=1, sort_keys=True)
+    print(json.dumps({
+        "reqlog": args.reqlog,
+        "strategy": strategy.describe(),
+        "requests": len(sim.records),
+        "ticks": sim.ticks,
+        "preemptions": sim.preemptions,
+        "makespan_s": round(sim.makespan_s, 6),
+        "sim_ttft_p95_s": round(sim.metrics["ttft_p95_s"], 6),
+        "sim_queue_p95_s": round(sim.metrics["queue_p95_s"], 6),
+        "sim_tokens_per_s": round(sim.metrics["tokens_per_s"], 2),
+        "closed_form_ttft_p95_s": round(closed["ttft_p95_s"], 6),
         "out": args.out,
     }))
     return 0
@@ -276,8 +335,34 @@ def main(argv=None) -> int:
                     help='candidate meshes, e.g. "data=8;data=2,model=4"')
     se.add_argument("--inner-budget", type=int, default=0,
                     help="mcmc budget per candidate mesh layout")
+    se.add_argument("--sim", action="store_true",
+                    help="score candidates with the event-driven tick "
+                         "simulator (search.ticksim) replaying the "
+                         "profile's recorded arrival sequence — needs "
+                         "--replay (falls back to closed-form with a "
+                         "warning otherwise)")
     se.add_argument("--out", default=None)
     se.set_defaults(func=cmd_search)
+
+    si = sub.add_parser("simulate",
+                        help="replay a recorded reqlog through the "
+                             "event-driven tick simulator")
+    si.add_argument("reqlog", metavar="REQLOG_JSONL",
+                    help="obs.reqlog export (server.request_log"
+                         ".export_jsonl or fftrace smoke)")
+    si.add_argument("--strategy", default=None,
+                    help="strategy JSON to simulate (servesearch apply "
+                         "--out, or a full search result); default: the "
+                         "serve_generation default knobs")
+    si.add_argument("--slots", type=int, default=4)
+    si.add_argument("--max-len", type=int, default=64)
+    si.add_argument("--seed", type=int, default=0)
+    si.add_argument("--calibration", default=None,
+                    help="fftrace calibrate report (<= 7 days old)")
+    si.add_argument("--out", default=None, metavar="TIMELINE_JSON",
+                    help="write the per-request TTFT/queue/decode "
+                         "timeline JSON")
+    si.set_defaults(func=cmd_simulate)
 
     ex = sub.add_parser("explain", help="break down a search result")
     ex.add_argument("result")
