@@ -177,6 +177,52 @@ def _scale_sidecar(pool, scale_of: Dict[int, int],
     return [f"scale-sidecar: {m}" for m in v]
 
 
+def _tier_partition(pool) -> List[str]:
+    """With a host tier attached (disagg/host_tier.py), every hash is in
+    EXACTLY one place: resident (pool._full, owning a device page) or
+    spilled (a tier entry holding the host payload) — never both, never
+    neither-with-a-page. A hash resident AND spilled would let the two
+    copies diverge (a COW writer re-registers, the stale spilled copy
+    later fetches over it); a tier entry is by definition
+    registered-but-NOT-resident."""
+    tier = getattr(pool, "_tier", None)
+    if tier is None:
+        return []
+    v = []
+    spilled = set(tier.hashes())
+    both = spilled & set(pool._full)
+    if both:
+        v.append(f"hashes {sorted(h[:8] for h in both)} are resident "
+                 "AND spilled — the hash index is no longer a partition")
+    if tier.occupancy_pages > tier.capacity_pages:
+        v.append(f"tier holds {tier.occupancy_pages} entries over its "
+                 f"capacity {tier.capacity_pages}")
+    return [f"tier-partition: {m}" for m in v]
+
+
+def _tier_scales(pool, tier_scale_of: Dict[str, int],
+                 tier_content_tag: Dict[str, int]) -> List[str]:
+    """Scales travel on spill and fetch: every spilled payload carries
+    the scale-sidecar state its content was quantized under.
+    `tier_content_tag` is the spec's ground truth (the content state the
+    page had when it spilled); `tier_scale_of` mirrors the scale the
+    implementation actually packed into the payload. A spilled page
+    fetched under the wrong (or a zeroed) scale dequantizes to garbage
+    on a different server — silent cross-worker corruption."""
+    tier = getattr(pool, "_tier", None)
+    if tier is None:
+        return []
+    v = []
+    for h in tier.hashes():
+        s = tier_scale_of.get(h, 0)
+        c = tier_content_tag.get(h, 0)
+        if s != c:
+            v.append(f"spilled entry {h[:8]}: payload scale state {s} "
+                     f"does not match its content state {c} (the scale "
+                     "sidecar was dropped on spill or fetch)")
+    return [f"tier-scales: {m}" for m in v]
+
+
 CATALOG: Tuple[Invariant, ...] = (
     Invariant(
         "free-accounting", "pool",
@@ -215,6 +261,19 @@ CATALOG: Tuple[Invariant, ...] = (
         "dropped, leaked across a realloc, or left at a moved page's "
         "old slot",
         _scale_sidecar),
+    Invariant(
+        "tier-partition", "pool",
+        "with a host tier attached, resident ⊎ spilled partitions the "
+        "hash index: a tiered page is registered-but-not-resident (its "
+        "hash is in the tier, not in _full), no hash is in both, and "
+        "the tier never exceeds its capacity",
+        _tier_partition),
+    Invariant(
+        "tier-scales", "tier-scales",
+        "scales travel with their page through the host tier: every "
+        "spilled payload carries the scale-sidecar state of the content "
+        "it was read from, and a fetch restores both together",
+        _tier_scales),
     Invariant(
         "cow-write", "op",
         "no row write lands in a page the writer does not own, a page "
@@ -267,4 +326,16 @@ def check_scales(pool, scale_of: Dict[int, int],
     for entry in CATALOG:
         if entry.scope == "scales":
             v += entry.check(pool, scale_of, content_tag)
+    return v
+
+
+def check_tier_scales(pool, tier_scale_of: Dict[str, int],
+                      tier_content_tag: Dict[str, int]) -> List[str]:
+    """Run the host-tier scale-travel invariants over the attached
+    tier's spilled entries (model checker only — the live tier stores
+    scales inside its opaque payloads)."""
+    v: List[str] = []
+    for entry in CATALOG:
+        if entry.scope == "tier-scales":
+            v += entry.check(pool, tier_scale_of, tier_content_tag)
     return v

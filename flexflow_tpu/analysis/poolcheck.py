@@ -97,6 +97,15 @@ CONFIGS: Dict[str, Dict] = {
     "spec": dict(num_pages=8, page_size=2, slots=2, spec_nodes=2,
                  prompts=((1, 2, 3), (1, 2, 3)),
                  max_new=(2, 2)),
+    # host-memory tier (disagg): a pool SMALL enough that admission
+    # pressure must evict-and-spill, a tier small enough to exercise its
+    # own capacity drops, and a shared prefix so fetches re-attach.
+    # Gains ops: spill (proactive spill_oldest), fetch (prefetch of a
+    # spilled hash), adopt (the prefill->decode handoff of a
+    # prefill-complete request through the tier).
+    "tiered": dict(num_pages=6, page_size=2, slots=2, spec_nodes=0,
+                   prompts=((1, 2, 3), (1, 2, 3, 4)),
+                   max_new=(2, 1), tier_pages=3),
 }
 
 
@@ -121,7 +130,11 @@ class PoolModel:
                             pool (carried requests re-admit and the old
                             pages leak with no owner);
       scale_defrag_drop   — defrag permutes page payloads but leaves
-                            the scale sidecar at the old slots.
+                            the scale sidecar at the old slots;
+      spill_scale_drop    — the spill payload carries the page content
+                            but ZEROES its scale-sidecar state: a fetch
+                            (possibly on another server) dequantizes
+                            the int8 rows under the wrong scale.
 
     The quantized-pool scale sidecar is modeled as a pair of per-page
     tags: `content_tag` is the spec truth — a bounded
@@ -135,7 +148,8 @@ class PoolModel:
 
     def __init__(self, pool_factory=None, *, num_pages: int,
                  page_size: int, slots: int, spec_nodes: int,
-                 prompts, max_new, mutations: Tuple[str, ...] = ()):
+                 prompts, max_new, tier_pages: int = 0,
+                 mutations: Tuple[str, ...] = ()):
         self.P = int(page_size)
         self.slots = int(slots)
         self.spec_nodes = int(spec_nodes)
@@ -150,6 +164,35 @@ class PoolModel:
         self.scale_of: Dict[int, int] = {}     # impl's sidecar mirror
         self.content_tag: Dict[int, int] = {}  # spec's content truth
         self.violations: List[str] = []
+        self.tier = None
+        if tier_pages:
+            # drive the REAL spill/fetch code (pool._spill_page,
+            # _fetch_full, spill_request, spill_oldest, prefetch) with
+            # bookkeeping-mirror payloads instead of device buffers: a
+            # payload is (content_tag, scale_of, committed) at read time
+            from flexflow_tpu.disagg.host_tier import HostTier
+
+            self.tier = HostTier(int(tier_pages))
+            self.pool.attach_tier(self.tier, self._tier_read_model,
+                                  self._tier_write_model)
+
+    # -- host-tier payload mirrors (tiered config) -------------------------
+
+    def _tier_read_model(self, page: int):
+        scale = self.scale_of.get(page, 0)
+        if "spill_scale_drop" in self.mutations:
+            # SEEDED DEFECT: the spill packs the page's rows but not its
+            # scale-sidecar entry — the payload lands in the tier with a
+            # zeroed scale state and every fetch restores garbage
+            scale = 0
+        return (self.content_tag.get(page, 0), scale,
+                self.committed.get(page, 0))
+
+    def _tier_write_model(self, page: int, payload):
+        content, scale, committed = payload
+        self.content_tag[page] = content
+        self.scale_of[page] = scale
+        self.committed[page] = committed
 
     # -- bookkeeping helpers ----------------------------------------------
 
@@ -278,6 +321,20 @@ class PoolModel:
             ops.append("defrag")
         if active:
             ops.append("swap")
+        if self.tier is not None:
+            if self.pool._lru:
+                ops.append("spill")      # proactive spill_oldest
+            if self.pool.free_pages >= 1:
+                # prefetch always lands when a page is allocatable
+                for j in range(len(self.tier.hashes())):
+                    ops.append(f"fetch({j})")
+            for i, r in enumerate(self.reqs):
+                # the prefill->decode handoff fires at prefill
+                # completion; post-prefill is when a request's pages
+                # can leave through the tier
+                if r.state == "active" \
+                        and r.prefill_pos >= r.prefill_target:
+                    ops.append(f"adopt({i})")
         return ops
 
     def apply(self, label: str):
@@ -285,6 +342,8 @@ class PoolModel:
             return self._op_defrag()
         if label == "swap":
             return self._op_swap()
+        if label == "spill":
+            return self._op_spill()
         op, rid = label[:-1].split("(")
         return getattr(self, "_op_" + op)(int(rid))
 
@@ -446,6 +505,37 @@ class PoolModel:
     def _op_preempt(self, i: int):
         self._do_preempt(self.reqs[i])
 
+    def _op_spill(self):
+        """Proactive pressure relief: PagePool.spill_oldest moves the
+        LRU-oldest dead page's payload into the tier and frees it."""
+        self.pool.spill_oldest()
+
+    def _op_fetch(self, j: int):
+        """PagePool.prefetch of the j-th spilled hash (sorted for a
+        deterministic label): the payload lands in a fresh page, parked
+        dead-cached for the next lookup."""
+        hashes = sorted(self.tier.hashes())
+        if j < len(hashes):
+            self.pool.prefetch(hashes[j])
+
+    def _op_adopt(self, i: int):
+        """The prefill->decode handoff (disagg/workers.py
+        PrefillWorker._on_prefill_complete): publish, spill the
+        request's pages into the tier, free, and requeue with tokens
+        intact — the later admit(i) re-attaches via lookup's
+        transparent fetch, modeling the decode worker's admission
+        (one pool plays both sides; the tier is the channel)."""
+        req = self.reqs[i]
+        self._publish_tail(req)
+        self.pool.spill_request(req.pages)
+        self.pool.free(list(reversed(req.pages)))  # leaf-first
+        req.pages = []
+        req.pos = 0
+        req.prefill_pos = 0
+        req.prefill_target = 0
+        req.hashed_blocks = 0
+        req.state = "queued"
+
     def _op_swap(self):
         """Strategy change in flight: mirror of the drain-and-swap
         handoff (scheduler._detach_active + the successor's
@@ -552,7 +642,12 @@ class PoolModel:
                 tuple(sorted((p, t) for p, t in self.scale_of.items()
                              if p in live)),
                 tuple(sorted((p, t) for p, t in self.content_tag.items()
-                             if p in live)))
+                             if p in live)),
+                # tier entries IN ORDER (its LRU eviction order is
+                # semantic, like the pool's dead list)
+                (tuple((h, self.tier.peek(h))
+                       for h in self.tier.hashes())
+                 if self.tier is not None else ()))
 
 
 class CheckResult:
@@ -569,11 +664,21 @@ class CheckResult:
 
 
 def _state_violations(state: PoolModel) -> List[str]:
-    return (list(state.violations)
-            + inv.check_pool(state.pool, state.owners())
-            + inv.check_committed(state.pool, state.committed)
-            + inv.check_scales(state.pool, state.scale_of,
-                               state.content_tag))
+    v = (list(state.violations)
+         + inv.check_pool(state.pool, state.owners())
+         + inv.check_committed(state.pool, state.committed)
+         + inv.check_scales(state.pool, state.scale_of,
+                            state.content_tag))
+    if state.tier is not None:
+        # unpack the mirror payloads: scales must have traveled
+        tier_scale: Dict[str, int] = {}
+        tier_content: Dict[str, int] = {}
+        for h in state.tier.hashes():
+            payload = state.tier.peek(h)
+            if payload is not None:
+                tier_content[h], tier_scale[h], _ = payload
+        v += inv.check_tier_scales(state.pool, tier_scale, tier_content)
+    return v
 
 
 def model_check(config: str = "base", pool_factory=None,
@@ -631,7 +736,8 @@ def replay(trace, config: str = "base", pool_factory=None,
 # ---------------------------------------------------------------------------
 # lint arm: AST checks over serving.py / paged/ / spec/
 
-LINT_ROOTS = ("serving.py", "paged", "spec", "serving_autopilot.py")
+LINT_ROOTS = ("serving.py", "paged", "spec", "serving_autopilot.py",
+              "disagg")
 # the host-side state-machine files the page/table write checks cover
 # (kernel files write K/V rows THROUGH the table by design)
 _STATE_FILE_BASENAMES = {"scheduler.py", "pool.py", "server.py"}
@@ -640,7 +746,12 @@ _COW_FNS = {"copy_page",
             # handed out by the allocator (exclusively owned, nothing
             # published), part of the allocation lifecycle like the
             # table writes in _admit/_ensure_pages
-            "reset_page_scales"}
+            "reset_page_scales",
+            # host-tier restore: writes a spilled payload into a page
+            # the allocator JUST handed out (_fetch_full pins it at
+            # refcount 1 before anything can share it) — the fetch-side
+            # twin of the alloc lifecycle, never a shared-page write
+            "write_page"}
 _TABLE_FNS = {"__init__", "_admit", "_apply_defrag", "_release_slot",
               "_evict", "_ensure_pages",
               # the release arm of drain-and-swap: joins the loop, frees

@@ -1,0 +1,11 @@
+"""Disaggregated serving (docs/disaggregation.md): host-memory KV tier
+behind the page pool's LRU dead list, prefill/decode worker split with
+per-request page adoption through the tier, and a prefix-affinity
+router fronting N serving instances."""
+
+from flexflow_tpu.disagg.host_tier import HostTier
+from flexflow_tpu.disagg.router import PrefixAffinityRouter
+from flexflow_tpu.disagg.workers import DisaggPair, PrefillWorker
+
+__all__ = ["HostTier", "PrefixAffinityRouter", "DisaggPair",
+           "PrefillWorker"]

@@ -1222,7 +1222,8 @@ class FFModel:
                          request_record_limit=None, serve_strategy=None,
                          search_budget=None, traffic="smoke",
                          reqlog_capacity=None, slo=None, slo_dump_dir=None,
-                         kv_quant_canary=None, defer_start: bool = False):
+                         kv_quant_canary=None, defer_start: bool = False,
+                         host_tier=None):
         """Continuous-batching autoregressive generation endpoint (KV-cache
         decode with per-slot positions — flexflow_tpu.serving). With
         `paged=True` the KV cache is a block-paged pool shared by all
@@ -1255,7 +1256,11 @@ class FFModel:
         `defer_start=True` builds the server without starting its loop —
         the drain-and-swap handoff warms shapes, adopts the predecessor's
         pool and absorbs its carried requests before calling .start()
-        (docs/serving.md, "Autopilot & drain-and-swap")."""
+        (docs/serving.md, "Autopilot & drain-and-swap").
+        `host_tier=HostTier(...)` (or a page count, paged only) backs
+        the pool with a host-RAM KV spill tier: LRU evictions spill
+        instead of dropping and later lookups fetch pages back
+        (docs/disaggregation.md)."""
         from flexflow_tpu.serving import serve_generation as _sg
 
         return _sg(self, slots=slots, max_len=max_len, eos_id=eos_id,
@@ -1270,7 +1275,7 @@ class FFModel:
                    reqlog_capacity=reqlog_capacity, slo=slo,
                    slo_dump_dir=slo_dump_dir,
                    kv_quant_canary=kv_quant_canary,
-                   defer_start=defer_start)
+                   defer_start=defer_start, host_tier=host_tier)
 
     def predict(self, x: Union[np.ndarray, Sequence[np.ndarray]],
                 batch_size: Optional[int] = None) -> np.ndarray:

@@ -68,6 +68,16 @@ class PagePool:
         self.hits = 0          # lookups that mapped at least one row
         self.misses = 0
         self.evictions = 0     # cached pages reclaimed for fresh allocs
+        # host-memory tier (disagg/host_tier.py), attached lazily: dead-
+        # list evictions SPILL full pages' payloads instead of dropping
+        # them, and lookups transparently FETCH spilled hashes back into
+        # fresh pages. The pool only moves bookkeeping; payloads travel
+        # through the attached reader/writer closures.
+        self._tier = None
+        self._tier_read = None   # page id -> opaque payload (+ scales)
+        self._tier_write = None  # (page id, payload) -> None
+        self.spilled_pages = 0   # pages pushed to the tier (evict+handoff)
+        self.fetched_pages = 0   # pages pulled back from the tier
 
     # -- accounting -----------------------------------------------------
 
@@ -93,6 +103,110 @@ class PagePool:
 
     def refcount(self, page: int) -> int:
         return self._refs.get(page, 0)
+
+    # -- host-memory tier (disagg) ---------------------------------------
+
+    @property
+    def tier(self):
+        """The attached HostTier, or None (untired pool — evictions
+        drop, lookups never fetch; the pre-disagg behaviour)."""
+        return self._tier
+
+    def attach_tier(self, tier, read_page, write_page) -> None:
+        """Arm the host tier: `read_page(page) -> payload` snapshots one
+        device page's rows AND its scale-sidecar entries into an opaque
+        host payload; `write_page(page, payload)` restores one. The
+        scheduler supplies device_get/device_put closures; the poolcheck
+        model supplies its bookkeeping mirrors. Attach before the pool
+        serves traffic — the closures run inside alloc()/lookup()."""
+        if tier is None or read_page is None or write_page is None:
+            raise ValueError(
+                "attach_tier needs a tier and both payload closures")
+        self._tier = tier
+        self._tier_read = read_page
+        self._tier_write = write_page
+
+    def _spill_page(self, page: int) -> int:
+        """Push `page`'s payload into the tier under every FULL chain
+        hash naming it (a hash-addressed page is its payload — partial
+        tail entries are COW hints and just drop). Returns the number of
+        tier entries written. The caller unregisters afterwards, so the
+        hash is never resident and spilled at once."""
+        if self._tier is None:
+            return 0
+        fulls = [h for kind, h in self._keys_of.get(page, ())
+                 if kind == "full"]
+        if not fulls:
+            return 0
+        payload = self._tier_read(page)
+        for h in fulls:
+            self._tier.spill(h, payload)
+        self.spilled_pages += len(fulls)
+        return len(fulls)
+
+    def _fetch_full(self, chain_hash: str) -> Optional[int]:
+        """Pull one spilled full page back: pop the tier entry (move
+        semantics — a fetched hash leaves the tier), allocate a device
+        page, restore the payload (scales included), and re-register the
+        hash. Returns the page PINNED at refcount 1 (the allocation is
+        the lookup's retain), or None when the pool is too full to land
+        it (the tier entry is rolled back — still fetchable later)."""
+        payload = self._tier.fetch(chain_hash)
+        if payload is None:
+            return None  # raced a tier-capacity drop
+        got = self.alloc(1)  # may itself evict-and-spill the LRU oldest
+        if got is None:
+            self._tier.unfetch(chain_hash, payload)
+            return None
+        page = got[0]
+        self._tier_write(page, payload)
+        self._full[chain_hash] = page
+        self._keys_of.setdefault(page, []).append(("full", chain_hash))
+        self.fetched_pages += 1
+        return page
+
+    def spill_request(self, pages: List[int]) -> int:
+        """Handoff spill (disagg/workers.py): push every full-registered
+        page of a request into the tier and UNREGISTER it here — the
+        pages' content moves to host RAM where another server's pool can
+        fetch it, and this pool's hash index stays disjoint from the
+        tier's. The caller still holds the refcounts and frees the now
+        index-less pages normally (they return to the free list).
+        Returns tier entries written. Requires an attached tier."""
+        if self._tier is None:
+            raise RuntimeError("spill_request needs an attached tier")
+        moved = 0
+        for p in pages:
+            moved += self._spill_page(p)
+            self._unregister(p)
+        return moved
+
+    def spill_oldest(self) -> Optional[int]:
+        """Force-spill the OLDEST dead-cached page (the next eviction
+        victim) to the tier ahead of allocation pressure — the proactive
+        variant of alloc()'s spill, used by the poolcheck `spill` op and
+        available to background pressure-relief. Returns the freed page
+        id, or None when nothing is dead-cached or no tier is armed."""
+        if self._tier is None or not self._lru:
+            return None
+        p, _ = self._lru.popitem(last=False)
+        self._spill_page(p)
+        self._unregister(p)
+        self._free.append(p)
+        return p
+
+    def prefetch(self, chain_hash: str) -> Optional[int]:
+        """Pull one spilled hash back WITHOUT pinning it: the fetched
+        page parks dead-cached (registered, refcount 0 — LRU newest), so
+        a later lookup hits it at device speed. The poolcheck `fetch` op
+        and warm-up paths use this. Returns the page id or None."""
+        if self._tier is None or not self._tier.contains(chain_hash):
+            return None
+        page = self._fetch_full(chain_hash)
+        if page is None:
+            return None
+        self.free([page])  # registered: parks on the LRU dead list
+        return page
 
     def fragmentation(self) -> float:
         """Hole fraction of the occupied span: 1 - occupied/span where
@@ -146,6 +260,11 @@ class PagePool:
             return
         self._full[chain_hash] = page
         self._keys_of.setdefault(page, []).append(("full", chain_hash))
+        if self._tier is not None:
+            # a writer recomputed this prefix while a spilled copy sat in
+            # the tier: residency wins, the tier entry drops — resident ⊎
+            # spilled stays a true partition of the hash index
+            self._tier.drop(chain_hash)
 
     def register_partial(self, page: int, parent_hash: str,
                          tokens) -> None:
@@ -194,6 +313,23 @@ class PagePool:
         parent = EMPTY_HASH
         for h in chain:
             p = self._full.get(h)
+            if p is not None:
+                # pin AS we walk (not after): a tier fetch further down
+                # the chain allocates, and allocation may evict exactly
+                # the dead-cached pages this walk already matched
+                self._retain(p)
+                if self._tier is not None:
+                    # residency wins over a spilled twin: a SHARED tier
+                    # (disagg handoff) can re-receive a prefix this pool
+                    # still holds — e.g. the prefill worker re-spills a
+                    # repeat prompt the decode pool never released. Drop
+                    # the duplicate so resident ⊎ spilled is a partition
+                    # again once the walk that observed it completes.
+                    self._tier.drop(h)
+            elif self._tier is not None and self._tier.contains(h):
+                # transparent fetch: the prefix was spilled, not lost —
+                # _fetch_full re-registers it and returns it pinned
+                p = self._fetch_full(h)
             if p is None:
                 break
             pages.append(p)
@@ -216,8 +352,6 @@ class PagePool:
                 if m > 0:
                     cow_page = pg
                     cached += m
-        for p in pages:
-            self._retain(p)
         if cow_page is not None:
             self._retain(cow_page)
         self.hit_tokens += cached
@@ -255,6 +389,10 @@ class PagePool:
                 p = self._free.pop()
             else:
                 p, _ = self._lru.popitem(last=False)  # oldest first
+                # with a host tier armed, eviction SPILLS instead of
+                # dropping: the payload moves to host RAM under its
+                # chain hashes, then the hash leaves the resident index
+                self._spill_page(p)
                 self._unregister(p)
                 self.evictions += 1
             self._refs[p] = 1

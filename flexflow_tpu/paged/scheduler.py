@@ -91,7 +91,8 @@ class PagedGenerationServer(_GenerationServerBase):
                  reqlog_capacity: Optional[int] = None,
                  slo=None, slo_dump_dir: Optional[str] = None,
                  kv_quant_canary: Optional[int] = None,
-                 serve_strategy=None, defer_start: bool = False):
+                 serve_strategy=None, defer_start: bool = False,
+                 host_tier=None):
         import jax
 
         super().__init__(ff, slots, max_len, eos_id, seed,
@@ -308,6 +309,51 @@ class PagedGenerationServer(_GenerationServerBase):
             }
 
         self._scale_reset = reset_page_scales
+
+        # host-memory KV tier (disagg/host_tier.py): evictions spill full
+        # pages' payloads (scale sidecar included — it is a leaf of the
+        # same caches dict) to host RAM instead of dropping them, and
+        # lookups transparently fetch spilled prefixes back. Pass a
+        # HostTier INSTANCE to share one tier between servers — that
+        # shared tier is the prefill/decode KV-transfer channel
+        # (disagg/workers.py) — or an int capacity for a private tier.
+        @jax.jit
+        def read_page(caches, page):
+            # one compiled program for every page id: the index is data
+            return jax.tree.map(lambda b: b[page], caches)
+
+        @jax.jit
+        def write_page(caches, page, payload):
+            return jax.tree.map(
+                lambda b, r: b.at[page].set(
+                    jax.numpy.asarray(r).astype(b.dtype)), caches, payload)
+
+        self._page_read = read_page
+        self._page_write = write_page
+        self.host_tier = None
+        # an int capacity of 0 disables; an EMPTY HostTier instance must
+        # not (it defines __len__, so plain truthiness would skip it)
+        if host_tier is not None and host_tier != 0:
+            from flexflow_tpu.disagg.host_tier import HostTier
+
+            if self._kv_quant_debug:
+                raise ValueError(
+                    "host_tier and FF_TPU_KV_QUANT_DEBUG=1 are mutually "
+                    "exclusive: the all-ticks fp32 shadow cannot observe "
+                    "pages restored behind its back")
+            self.host_tier = (host_tier if isinstance(host_tier, HostTier)
+                              else HostTier(int(host_tier)))
+            self.pool.attach_tier(self.host_tier, self._tier_read_page,
+                                  self._tier_write_page)
+        # spill/fetch counters ride the registry so they land on the
+        # Prometheus endpoint as ff_kv_spill_pages_total /
+        # ff_kv_fetch_pages_total; occupancy + fetch latency are gauges.
+        # metrics() syncs them from the pool/tier truth at scrape time.
+        self._c_spill = self.registry.counter("kv_spill_pages_total")
+        self._c_fetch = self.registry.counter("kv_fetch_pages_total")
+        self._g_tier_occ = self.registry.gauge("host_tier_occupancy_pages")
+        self._g_tier_ratio = self.registry.gauge("host_tier_occupancy_ratio")
+        self._g_tier_lat = self.registry.gauge("host_tier_fetch_latency_s")
         if self.serve_strategy is None:
             # derive the strategy from the ACTUAL constructor knobs (after
             # any debug-flag adjustments) so fingerprint() always reflects
@@ -404,6 +450,23 @@ class PagedGenerationServer(_GenerationServerBase):
                 "evictions": pool.evictions,
             },
         })
+        # host-tier block + registry sync: counters follow THIS pool's
+        # spill/fetch truth (a shared tier's totals aggregate producers;
+        # per-server counters must not double-count), gauges follow the
+        # tier. Synced at scrape time — both the JSON payload and the
+        # Prometheus endpoint call metrics() first.
+        self._c_spill.inc(pool.spilled_pages - self._c_spill.value)
+        self._c_fetch.inc(pool.fetched_pages - self._c_fetch.value)
+        tier = self.host_tier
+        m["host_tier"] = {"enabled": tier is not None,
+                          "spilled_pages": pool.spilled_pages,
+                          "fetched_pages": pool.fetched_pages}
+        if tier is not None:
+            tm = tier.metrics()
+            m["host_tier"].update(tm)
+            self._g_tier_occ.set(tm["occupancy_pages"])
+            self._g_tier_ratio.set(tm["occupancy_ratio"])
+            self._g_tier_lat.set(tm["fetch_latency_s_avg"])
         return m
 
     def _kv_pool_dtype_name(self) -> str:
@@ -554,6 +617,14 @@ class PagedGenerationServer(_GenerationServerBase):
         self.preemptions += 1
         self._requeue.insert(0, req)
 
+    def _on_prefill_complete(self, slot: int):
+        """Hook: runs inside _prefill_tick right after a request finishes
+        its chunked prefill (tail published, first token sampled) and
+        survived _finish_if_done. The monolithic server decodes in place;
+        a disagg PrefillWorker (disagg/workers.py) overrides this to
+        spill the request's pages into the shared host tier and hand the
+        request to the decode worker instead."""
+
     # -- drain-and-swap (serving_autopilot) -------------------------------
 
     def _derive_strategy(self):
@@ -651,6 +722,72 @@ class PagedGenerationServer(_GenerationServerBase):
         self._caches = old._caches
         return True
 
+    # -- host-tier payload closures (disagg/host_tier.py) -------------------
+
+    def _tier_read_page(self, page: int):
+        """Snapshot one pool page to host: every cache buffer's row —
+        the int8 scale-sidecar leaves live in the same dict, so scales
+        travel with their page by construction. The payload keeps the
+        caches dict's tree structure, so write restores it by tree_map."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.device_get(
+            self._page_read(self._caches, jnp.asarray(page, jnp.int32)))
+
+    def _tier_write_page(self, page: int, payload):
+        """Restore one spilled payload into a freshly allocated page
+        (device_put rides the jitted scatter). A fetch rewrites pool
+        content behind any open canary shadow, so the window closes —
+        the probe aborts rather than report phantom divergence."""
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        self._caches = self._page_write(
+            self._caches, jnp.asarray(page, jnp.int32), payload)
+        if self._caches_ref is not None and self._canary_req is not None:
+            self._close_canary(self._canary_req)
+        if self.host_tier is not None:
+            self.host_tier.observe_fetch_seconds(time.monotonic() - t0)
+
+    def adopt_request_pages(self, src: "PagedGenerationServer",  # fflint: lock-ok (quiescent receiver by contract — see docstring; no loop thread races these reads)
+                            req: _GenRequest) -> int:
+        """Per-request page adoption (the same-device KV-transfer path,
+        generalizing adopt_pool_from's whole-pool swap): copy the FULL
+        prefix pages `req`'s sequence has resident on `src` into this
+        server's pool, registered under the same chain hashes and parked
+        dead-cached, so this server's admission lookup re-attaches them.
+        Direct device-to-device, for pools that share devices AND a
+        quiescent receiver (this server's loop not yet started, or the
+        call made from its own loop thread — _caches is loop-owned);
+        the LIVE handoff path goes through a shared HostTier instead
+        (disagg/workers.py), whose lock makes the transfer safe across
+        worker threads. Returns pages adopted; a full pool or dtype
+        mismatch adopts fewer — correct either way, the remainder
+        recomputes."""
+        if self._kv_pool_dtype_name() != src._kv_pool_dtype_name():
+            return 0
+        import jax.numpy as jnp
+
+        adopted = 0
+        seq = req.seq_tokens()
+        for h in self.pool.chain_hashes(seq):
+            if h in self.pool._full:  # fflint: pool-ok (resident already)
+                continue
+            page = src.pool._full.get(h)  # fflint: pool-ok (src quiesced at handoff)
+            if page is None:
+                break  # src chain broke; nothing deeper can be resident
+            got = self.pool.alloc(1)
+            if got is None:
+                break
+            self._caches = self._page_write(
+                self._caches, jnp.asarray(got[0], jnp.int32),  # fflint: host-ok (one-time handoff copy, not a tick loop)
+                src._tier_read_page(page))
+            self.pool.register_full(got[0], h)
+            self.pool.free(got)  # registered: parks on the LRU dead list
+            adopted += 1
+        return adopted
+
     def _reset_page_scales(self, pages: List[int]):
         """Zero the scale-sidecar entries of freshly ALLOCATED pages
         (no-op on unquantized pools). Called wherever pages come off the
@@ -683,7 +820,11 @@ class PagedGenerationServer(_GenerationServerBase):
         cached = 0
         cow = None
         if self.prefix_cache:
+            fetched0 = self.pool.fetched_pages
             shared, cached, cow = self.pool.lookup(seq)
+            # attribute transparent host-tier fetches to THIS request
+            # (reqlog `fetched_pages`; disagg handoff arrives this way)
+            req.fetched_pages += self.pool.fetched_pages - fetched0
         # always recompute at least the LAST prompt token: its forward
         # pass produces the first sampled token's distribution (the
         # cache stores K/V, not logits)
@@ -1123,6 +1264,10 @@ class PagedGenerationServer(_GenerationServerBase):
                 self._publish_tail(req)
                 self._sample_first_token(s, req, row)
                 self._finish_if_done(s)
+                if self._active[s] is not None:
+                    # disagg hook: a PrefillWorker hands the request off
+                    # to its decode worker here instead of decoding it
+                    self._on_prefill_complete(s)
         chunked = self.prefill_chunk - budget
         self._g_waste.set(padded / total if total else 0.0)
         if sp:

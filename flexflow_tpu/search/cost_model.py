@@ -845,6 +845,13 @@ class TickPricer:
     pad_row_cost: relative cost of a padded launch row vs a live one.
       Padded rows skip attention reads (q_len 0) but still ride the
       dense projections, so they are discounted, not free.
+    host_fetch_bytes_per_s: host<->device transfer rate for the
+      disaggregation host tier (PCIe-ish ~8 GB/s by default — the
+      realistic bound for a device_get/device_put of one KV page).
+      fetch_seconds() prices moving one spilled page back, which is
+      what lets the simulator weigh SPILLING a cold page (pay a fetch
+      on the next hit) against PREEMPTING a request (pay its whole
+      prefill again).
     tick_scale: optional (phase, batch, chunk, width) -> float hook,
       wired to MeasuredCostModel.tick_scale when an `fftrace calibrate`
       report is loaded — measured wall-time truth multiplies the
@@ -856,6 +863,7 @@ class TickPricer:
     host_dispatch_s: float = HOST_DISPATCH_SECONDS
     pad_row_cost: float = 0.5
     tick_scale: Optional[Callable[[str, int, int, int], float]] = None
+    host_fetch_bytes_per_s: float = 8e9
 
     @property
     def token_seconds(self) -> float:
@@ -903,6 +911,17 @@ class TickPricer:
         comp = (self.token_seconds * rows
                 * self._scale("prefill", batch, chunk=int(chunk_tokens)))
         return comp + self.host_dispatch_s
+
+    def fetch_seconds(self, page_bytes: float, pages: int = 1) -> float:
+        """Seconds to move `pages` spilled KV pages (each `page_bytes`
+        on the wire, scale sidecar included) back from the host tier:
+        transfer at host_fetch_bytes_per_s plus one host dispatch per
+        page (each fetch is its own device_put + jitted scatter). The
+        spill direction prices the same; ticksim charges it off the
+        critical path (spills overlap decode, fetches gate admission)."""
+        bw = max(self.host_fetch_bytes_per_s, 1.0)
+        n = max(int(pages), 0)
+        return n * (max(page_bytes, 0.0) / bw + self.host_dispatch_s)
 
 
 def _kv_cache_node_rows(graph: Graph,

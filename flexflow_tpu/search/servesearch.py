@@ -98,7 +98,12 @@ class ServeStrategy:
     (paged.quant.KV_DTYPES; "auto" = the model's own dtype, "int8" =
     quantized pages with the per-page scale sidecar) — the OTHER HBM
     knob, trading bytes per cached token against a bounded logit
-    error instead of trading pages away."""
+    error instead of trading pages away. host_tier_pages sizes the
+    host-RAM KV spill tier (disagg.HostTier) in pages; 0 = no tier
+    (LRU evictions drop pages, prefix misses recompute). A tier lets
+    the pool trade a PCIe fetch for a prefill recompute — whether
+    that wins depends on traffic, which is exactly what the search
+    decides."""
 
     page_size: int = 64
     prefill_chunk: int = 64
@@ -108,6 +113,7 @@ class ServeStrategy:
     ragged_pack: bool = True
     pool_fraction: float = 1.0
     kv_dtype: str = "auto"
+    host_tier_pages: int = 0
     mesh: Tuple[Tuple[str, int], ...] = ()
 
     def validate(self, max_len: Optional[int] = None) -> None:
@@ -124,6 +130,9 @@ class ServeStrategy:
         if not (0.0 < self.pool_fraction <= 1.0):
             raise ValueError(
                 f"pool_fraction must be in (0, 1], got {self.pool_fraction}")
+        if self.host_tier_pages < 0:
+            raise ValueError(
+                f"host_tier_pages must be >= 0, got {self.host_tier_pages}")
         if (self.spec_width >= 1) != (self.spec_depth >= 1):
             raise ValueError(
                 f"spec_width/spec_depth must both be 0 or both >= 1, got "
@@ -166,16 +175,20 @@ class ServeStrategy:
             "num_pages": num_pages,
             "speculate": self.spec_config(),
             "kv_dtype": self.kv_dtype,
+            "host_tier": self.host_tier_pages or None,
         }
 
     def describe(self) -> str:
         spec = (f"spec {self.spec_width}x{self.spec_depth}"
                 if self.spec_width else "spec off")
         mesh = ",".join(f"{a}={s}" for a, s in self.mesh) or "compiled mesh"
+        tier = (f"tier {self.host_tier_pages}p"
+                if self.host_tier_pages else "tier off")
         return (f"page {self.page_size} + chunk {self.prefill_chunk} + "
                 f"megastep {self.megastep_ticks} + {spec} + "
                 f"{'packed' if self.ragged_pack else 'legacy'} + "
-                f"pool {self.pool_fraction:g} + kv {self.kv_dtype} + {mesh}")
+                f"pool {self.pool_fraction:g} + kv {self.kv_dtype} + "
+                f"{tier} + {mesh}")
 
     def to_json(self) -> Dict:
         d = dataclasses.asdict(self)
@@ -543,6 +556,7 @@ def default_space(*, max_len: int) -> Dict[str, List]:
         "ragged_pack": [True, False],
         "pool_fraction": [1.0, 0.75, 0.5, 0.25],
         "kv_dtype": ["auto", "int8"],
+        "host_tier_pages": [0, 256, 1024],
     }
 
 
@@ -832,6 +846,7 @@ def search_serve_strategy(
         "ragged_pack": default.ragged_pack,
         "pool_fraction": default.pool_fraction,
         "kv_dtype": default.kv_dtype,
+        "host_tier_pages": default.host_tier_pages,
     }
     for name, dval in defaults.items():
         vals = values.setdefault(name, [dval])
@@ -839,7 +854,8 @@ def search_serve_strategy(
             vals.insert(0, dval)
     knobs = [(name, values[name]) for name in
              ("page_size", "prefill_chunk", "spec", "megastep_ticks",
-              "ragged_pack", "pool_fraction", "kv_dtype")]
+              "ragged_pack", "pool_fraction", "kv_dtype",
+              "host_tier_pages")]
     if len(priced) > 1:
         knobs.append(("mesh", [lay.mesh_key for lay in priced]))
     table = _knob_table(knobs)

@@ -29,7 +29,15 @@ paged scheduler's tick loop, pricing each dispatch with the SAME
     re-admission re-attaches it), mirroring `_ensure_pages`;
   * the content-addressed prefix cache — published prefixes stay
     resident, later requests attach instead of recomputing, unattached
-    resident pages are reclaimed under pressure like the pool's LRU.
+    resident pages are reclaimed under pressure like the pool's LRU;
+  * the host-RAM KV tier (`ServeStrategy.host_tier_pages` > 0) —
+    reclaimed prefixes SPILL to a bounded host store instead of
+    dropping, and a later request whose prefix lives there fetches it
+    back at admission, priced with `TickPricer.fetch_seconds` (the
+    PCIe-ish bytes/s knob) instead of recomputing the prefill. This is
+    the spill-vs-preempt question the simulator answers: a fetch costs
+    page bytes over host bandwidth, a recompute costs whole prefill
+    ticks — which wins depends on the recorded traffic's reuse.
 
 The output is a per-request timeline (submit / admit / first-token /
 done) whose TTFT and queue percentiles reflect the recorded bursts and
@@ -247,11 +255,15 @@ class TickSimulator:
                           tick_scale=p.tick_scale)
         arrivals = arrivals_from_profile(profile, seed=seed,
                                          max_len=p.max_len)
-        run = _SimRun(strategy, tick, slots=p.slots, max_len=p.max_len,
-                      acceptance_rate=p.acceptance_rate, seed=seed)
-        run.play(arrivals)
-
         closed = p.metrics(strategy)
+        # one page's HBM footprint — what a host-tier fetch moves back
+        # over the PCIe-ish link when a spilled prefix gets re-attached
+        page_bytes = closed["kv_token_bytes"] * min(strategy.page_size,
+                                                    p.max_len)
+        run = _SimRun(strategy, tick, slots=p.slots, max_len=p.max_len,
+                      acceptance_rate=p.acceptance_rate, seed=seed,
+                      page_bytes=page_bytes)
+        run.play(arrivals)
         ttfts = [r["ttft_s"] for r in (q.record() for q in arrivals)
                  if r["ttft_s"] is not None]
         queues = [max(0.0, q.admit_s - q.submit_s) for q in arrivals
@@ -271,6 +283,9 @@ class TickSimulator:
             "makespan_s": makespan,
             "sim_ticks": float(run.ticks),
             "sim_preemptions": float(run.preemptions),
+            "sim_spilled_pages": float(run.spills),
+            "sim_fetched_pages": float(run.fetches),
+            "sim_host_fetch_s": run.fetch_cost_total_s,
         })
         return SimResult(records=[q.record() for q in arrivals],
                          metrics=metrics, ticks=run.ticks,
@@ -283,7 +298,8 @@ class _SimRun:
     PagedGenerationServer._loop_body over priced seconds."""
 
     def __init__(self, strategy, tick, *, slots: int, max_len: int,
-                 acceptance_rate: float, seed: int):
+                 acceptance_rate: float, seed: int,
+                 page_bytes: float = 0.0):
         kw = strategy.to_server_kwargs(slots=slots, max_len=max_len)
         self.page = int(kw["page_size"])
         self.chunk = int(kw["prefill_chunk"])
@@ -310,6 +326,15 @@ class _SimRun:
         self.prefill_rr = 0
         # resident published prefixes: group -> (pages, attach_count)
         self.resident: Dict[str, List[int]] = {}
+        # host-RAM KV tier: group -> pages, insertion order = LRU (the
+        # HostTier's OrderedDict). 0 capacity = no tier, reclaims drop.
+        self.tier_capacity = int(kw.get("host_tier") or 0)
+        self.page_bytes = float(page_bytes)
+        self.spilled: Dict[str, int] = {}
+        self.spills = 0
+        self.fetches = 0
+        self.fetch_cost_total_s = 0.0
+        self._pending_fetch_s = 0.0  # charged to the admitting tick
 
     def _pages_for(self, tokens: int) -> int:
         return -(-max(1, tokens) // self.page)
@@ -326,17 +351,31 @@ class _SimRun:
         return self.capacity - self._held()
 
     def _reclaim(self, needed: int) -> int:
-        """Drop unattached resident prefixes (the pool's LRU dead list)
-        until `needed` pages are free; returns the free count."""
+        """Evict unattached resident prefixes (the pool's LRU dead list)
+        until `needed` pages are free; returns the free count. With a
+        host tier the eviction SPILLS (the prefix stays fetchable);
+        without one it drops (the next reuse recomputes)."""
         if self._free() >= needed:
             return self._free()
         for group in list(self.resident):
             pages, attach = self.resident[group]
             if attach <= 0:
                 del self.resident[group]
+                self._spill(group, pages)
                 if self._free() >= needed:
                     break
         return self._free()
+
+    def _spill(self, group: str, pages: int) -> None:
+        """Move an evicted prefix into the host tier (latest-wins
+        re-append, capacity evicts oldest-first — HostTier.spill)."""
+        if self.tier_capacity <= 0 or pages <= 0:
+            return
+        self.spilled.pop(group, None)
+        self.spilled[group] = pages
+        self.spills += pages
+        while sum(self.spilled.values()) > self.tier_capacity:
+            self.spilled.pop(next(iter(self.spilled)))
 
     def _publish(self, req: SimRequest) -> None:
         """Park a request's page-aligned progress in the prefix store —
@@ -349,6 +388,9 @@ class _SimRun:
         have = self.resident.get(group)
         if pages and (have is None or have[0] < pages):
             self.resident[group] = [pages, have[1] if have else 0]
+            # a republished prefix supersedes its spilled copy — the
+            # pool's register_full drops the tier duplicate the same way
+            self.spilled.pop(group, None)
 
     def _detach(self, req: SimRequest) -> None:
         if req.attached_pages:
@@ -360,13 +402,16 @@ class _SimRun:
 
     # -- admission ------------------------------------------------------
 
-    def _cached_for(self, req: SimRequest) -> int:
+    def _cached_for(self, req: SimRequest, assume_pages: int = 0) -> int:
         """Tokens of this prompt re-attachable from the resident store:
         the published group prefix, capped by the recorded cache hint
-        (first arrival of a group recorded a miss) and page-aligned."""
+        (first arrival of a group recorded a miss) and page-aligned.
+        `assume_pages` prices a prefix still in the host tier as if
+        already fetched — the admission decides fetch-vs-recompute
+        BEFORE paying for either."""
         group = req.prefix_group or f"own:{req.rid}"
         have = self.resident.get(group)
-        resident_tokens = have[0] * self.page if have else 0
+        resident_tokens = (have[0] if have else assume_pages) * self.page
         cap = max(req.cached_hint, req.parked_tokens)
         cached = min(resident_tokens, cap, req.prompt_tokens - 1)
         return (cached // self.page) * self.page
@@ -376,10 +421,31 @@ class _SimRun:
             slot = self.active.index(None)
         except ValueError:
             return False
-        cached = self._cached_for(req)
+        group = req.prefix_group or f"own:{req.rid}"
+        tiered = 0
+        if group not in self.resident:
+            tiered = self.spilled.get(group, 0)
+        cached = self._cached_for(req, assume_pages=tiered)
+        # fetch only the prefix pages this request can attach — the
+        # real pool's lookup walk fetches per matched page, never a
+        # whole spilled chain it has no use for
+        fetch_pages = min(tiered, cached // self.page)
         need = self._pages_for(req.prompt_tokens + 1) - cached // self.page
-        if self._reclaim(need) < need:
+        if self._reclaim(need + fetch_pages) < need + fetch_pages:
             return False
+        if fetch_pages:
+            # pull the spilled prefix back on-device: it becomes a
+            # resident group this admission attaches, and the tick that
+            # admitted it pays the PCIe transfer (fetches gate prefill)
+            if fetch_pages >= self.spilled[group]:
+                self.spilled.pop(group)
+            else:
+                self.spilled[group] -= fetch_pages
+            self.resident[group] = [fetch_pages, 0]
+            self.fetches += fetch_pages
+            cost = self.tick.fetch_seconds(self.page_bytes, fetch_pages)
+            self.fetch_cost_total_s += cost
+            self._pending_fetch_s += cost
         req.cached_tokens = cached
         req.private_pages = need
         if cached:
@@ -554,7 +620,10 @@ class _SimRun:
             pre = [s for s in live if self.active[s].prefill_pos
                    < self.active[s].prefill_target]
             dec = [s for s in live if s not in pre]
-            cost = 0.0
+            # host-tier fetches issued by this tick's admissions gate
+            # the prefills they feed — the transfer is simulated time
+            cost = self._pending_fetch_s
+            self._pending_fetch_s = 0.0
             if pre:
                 cost += self._prefill_tick(pre)
             cost += self._decode_tick(dec, mixed=bool(pre))

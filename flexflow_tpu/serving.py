@@ -417,7 +417,8 @@ class _GenRequest:
                  "spec_accepted", "spec_emitted", "first_token_t",
                  "cached_prefill_tokens", "prefill_pos", "prefill_target",
                  "prefill_seq", "hashed_blocks", "decode_overlap_ticks",
-                 "compile_s_at_submit", "first_compile_s")
+                 "compile_s_at_submit", "first_compile_s",
+                 "spilled_pages", "fetched_pages", "routed_to")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -456,6 +457,12 @@ class _GenRequest:
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.spec_emitted = 0
+        # disaggregated serving (flexflow_tpu.disagg): pages this request
+        # spilled into / fetched out of the host KV tier, and which
+        # router instance served it (None when unrouted)
+        self.spilled_pages = 0
+        self.fetched_pages = 0
+        self.routed_to: Optional[str] = None
 
     def seq_tokens(self) -> np.ndarray:
         """prompt + generated-so-far: what a (re-)prefill must feed. For a
@@ -672,11 +679,29 @@ class _GenerationServerBase:
             self._queue.put(req)
         return req.future
 
+    def submit_request(self, req: _GenRequest) -> Future:
+        """Enqueue an ALREADY-BUILT request — the disagg handoff path
+        (disagg/workers.py): the prefill worker hands its finished
+        _GenRequest (future, tokens-so-far, tier counters intact) to the
+        decode worker, whose admission re-attaches the spilled pages
+        through the shared host tier. Stamps the compile-clock baseline
+        only for a fresh request, so a handed-off request keeps charging
+        compile time against its ORIGINAL submit."""
+        self._check_capacity(req.prompt, req.max_new)
+        if req.compile_s_at_submit == 0.0 and not req.tokens:
+            req.compile_s_at_submit = (
+                self._compile_tracker.compile_seconds_total)
+        with self._lock:
+            if not self._running:
+                raise RuntimeError(f"{type(self).__name__} is stopped")
+            self._queue.put(req)
+        return req.future
+
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  temperature: float = 0.0) -> np.ndarray:
         return self.submit(prompt_ids, max_new_tokens, temperature).result()
 
-    def stop(self):  # fflint: lock-ok (_thread is written once at start(), before any stop() can race)
+    def stop(self):
         with self._lock:
             self._running = False
             self._stop.set()
@@ -929,6 +954,11 @@ class _GenerationServerBase:
             "spec_steps": m.get("spec_steps", 0),
             "spec_draft_tokens": m.get("spec_draft_tokens", 0),
             "spec_accepted_tokens": m.get("spec_accepted_tokens", 0),
+            # disagg fields — additive, so the schema stays
+            # ff.reqlog/v1-compatible (readers ignore unknown keys)
+            "spilled_pages": req.spilled_pages,
+            "fetched_pages": req.fetched_pages,
+            "routed_to": req.routed_to,
             "phases": {
                 "queue_s": max(0.0, admit_t - req.submit_t),
                 "prefill_s": max(0.0, first_t - admit_t),
@@ -1060,7 +1090,7 @@ class _GenerationServerBase:
         after join, so a submit racing stop() still gets resolved.
         During a drain-and-swap detach the successor server owns every
         pending future, so cancellation stands down."""
-        if self._detaching:  # fflint: lock-ok (set before _stop under _lock; the loop observes it only after the stop event)
+        if self._detaching:
             return
         for s in range(self.slots):
             req = self._active[s]
@@ -1216,7 +1246,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                      slo=None,
                      slo_dump_dir: Optional[str] = None,
                      kv_quant_canary: Optional[int] = None,
-                     defer_start: bool = False
+                     defer_start: bool = False,
+                     host_tier=None
                      ) -> "_GenerationServerBase":
     """Continuous-batching generation endpoint over a compiled causal-LM
     FFModel (KV-cache decode path required — see FFModel.generate).
@@ -1307,7 +1338,15 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
     `kv_quant_error` gauge tracks quantization drift in production at
     1/N cost instead of requiring the all-requests
     FF_TPU_KV_QUANT_DEBUG mode (docs/paged.md). 0/None disables; env
-    FF_TPU_KV_QUANT_CANARY supplies a default."""
+    FF_TPU_KV_QUANT_CANARY supplies a default.
+
+    `host_tier` (paged only) attaches a host-memory KV tier
+    (flexflow_tpu.disagg, docs/disaggregation.md): pass a page capacity
+    (int) or a `HostTier` instance — SHARING one instance between two
+    servers is the prefill/decode KV-transfer channel. Pool evictions
+    spill full pages to host RAM instead of dropping them, and prefix
+    lookups transparently fetch them back; greedy output stays
+    token-identical."""
     if search_budget is not None and serve_strategy is None:
         from flexflow_tpu.search.servesearch import search_serve_strategy
 
@@ -1333,6 +1372,10 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
         kv_dtype = kw["kv_dtype"]
         if kw["num_pages"] is not None:
             num_pages = kw["num_pages"]
+        # the strategy's host-tier capacity applies only when the caller
+        # did not hand us a tier of their own (a shared disagg tier wins)
+        if host_tier is None and kw["host_tier"] is not None:
+            host_tier = kw["host_tier"]
     megastep_ticks = int(megastep_ticks)
     if megastep_ticks < 1:
         raise ValueError(
@@ -1358,7 +1401,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             kv_dtype=kv_dtype, reqlog_capacity=reqlog_capacity,
             slo=slo, slo_dump_dir=slo_dump_dir,
             kv_quant_canary=kv_quant_canary,
-            serve_strategy=serve_strategy, defer_start=defer_start)
+            serve_strategy=serve_strategy, defer_start=defer_start,
+            host_tier=host_tier)
     if paged:
         from flexflow_tpu.paged.scheduler import PagedGenerationServer
 
@@ -1371,7 +1415,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             kv_dtype=kv_dtype, reqlog_capacity=reqlog_capacity,
             slo=slo, slo_dump_dir=slo_dump_dir,
             kv_quant_canary=kv_quant_canary,
-            serve_strategy=serve_strategy, defer_start=defer_start)
+            serve_strategy=serve_strategy, defer_start=defer_start,
+            host_tier=host_tier)
     if kv_dtype != "auto":
         raise ValueError(
             "kv_dtype rides the paged KV pool; pass paged=True")
@@ -1379,6 +1424,10 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
         raise ValueError(
             "kv_quant_canary probes the paged KV pool's quantization "
             "error; pass paged=True")
+    if host_tier is not None and host_tier != 0:
+        raise ValueError(
+            "host_tier spills the paged KV pool's content-addressed "
+            "pages; pass paged=True")
     return GenerationServer(ff, slots=slots, max_len=max_len, eos_id=eos_id,
                             seed=seed,
                             request_record_limit=request_record_limit,

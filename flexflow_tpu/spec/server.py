@@ -60,7 +60,8 @@ class SpeculativePagedServer(PagedGenerationServer):
                  reqlog_capacity: Optional[int] = None,
                  slo=None, slo_dump_dir: Optional[str] = None,
                  kv_quant_canary: Optional[int] = None,
-                 serve_strategy=None, defer_start: bool = False):
+                 serve_strategy=None, defer_start: bool = False,
+                 host_tier=None):
         if not isinstance(spec, SpecConfig):
             raise TypeError(
                 f"speculate must be a SpecConfig, got {type(spec).__name__}")
@@ -89,7 +90,8 @@ class SpeculativePagedServer(PagedGenerationServer):
                          slo=slo, slo_dump_dir=slo_dump_dir,
                          kv_quant_canary=kv_quant_canary,
                          serve_strategy=serve_strategy,
-                         defer_start=defer_start)
+                         defer_start=defer_start,
+                         host_tier=host_tier)
         # per-tick draft acceptance rate (accepted / drafted this tick)
         self._h_accept = self.registry.histogram("spec_acceptance",
                                                  obs.RATIO_BUCKETS)

@@ -1022,7 +1022,7 @@ def test_fflint_cli_strict_clean_on_baselines_and_corpus():
     # warning and fail above)
     mc = payload["stats"]["poolcheck"]["model_check"]
     assert mc["explored_states"] > 1000
-    assert set(mc["configs"]) == {"base", "spec"}
+    assert set(mc["configs"]) == {"base", "spec", "tiered"}
     subjects = payload["stats"]["consistency"]["subjects"]
     for cfg_name in ("alexnet_cifar10", "resnet50", "bert_base",
                      "llama_tp_dp", "mixtral_ep", "inception_v3",
@@ -1083,11 +1083,11 @@ def test_poolcheck_model_clean_and_fully_explored_on_real_pool():
     (ragged kernel, KV tiering, quantized pages) must keep green."""
     from flexflow_tpu.analysis import poolcheck
 
-    for config in ("base", "spec"):
+    for config in ("base", "spec", "tiered"):
         res = poolcheck.model_check(config)
         assert res.hits == [], res.hits
         assert not res.truncated
-        floor = 2000 if config == "base" else 800
+        floor = {"base": 2000, "spec": 800, "tiered": 1500}[config]
         assert res.explored >= floor, (config, res.explored)
 
 
@@ -1201,6 +1201,68 @@ def test_poolcheck_flags_swap_that_skips_freeing_detached_pages():
                                 mutations=("swap_free_skip",))
     assert any(v.split(":")[0] == name for v in replayed), (trace,
                                                            replayed)
+
+
+def test_poolcheck_tiered_reaches_spill_fetch_adopt():
+    """The tiered config's new ops are all REACHABLE: BFS from the
+    initial state enables spill (proactive spill_oldest), fetch
+    (prefetch of a spilled hash), and adopt (the prefill->decode
+    handoff through the tier) — plus alloc-pressure spills inside
+    admit. A disabled op would make the clean sweep above vacuous for
+    the tier."""
+    from collections import deque
+
+    from flexflow_tpu.analysis import poolcheck
+
+    root = poolcheck.PoolModel(**poolcheck.CONFIGS["tiered"])
+    assert root.tier is not None
+    seen = {root.key()}
+    frontier = deque([root])
+    enabled = set()
+    want = {"spill", "fetch", "adopt", "admit", "step"}
+    while frontier and not want <= enabled:
+        state = frontier.popleft()
+        for label in state.enabled_ops():
+            enabled.add(label.split("(")[0])
+            child = state.clone()
+            child.violations = []
+            child.apply(label)
+            k = child.key()
+            if k not in seen:
+                seen.add(k)
+                frontier.append(child)
+    assert want <= enabled, enabled
+    # and a concrete spill -> handoff -> refetch walk replays clean on
+    # the REAL pool: admit + finish parks pages dead-cached, spill
+    # moves the oldest to the tier, the re-admission of the SAME
+    # prefix transparently fetches it back
+    trace = ["admit(0)", "step(0)", "step(0)", "step(0)", "step(0)",
+             "spill", "admit(0)"]
+    assert poolcheck.replay(trace, "tiered") == [], trace
+
+
+def test_poolcheck_flags_spill_that_drops_the_scale_sidecar():
+    """Seeded defect: the spill payload packs the page's rows but
+    ZEROES its scale state — a fetch (possibly on another server's
+    pool) would dequantize the int8 rows under the wrong scale. The
+    tier-scales invariant must catch it at the spill itself with a
+    minimal replayable counterexample."""
+    from flexflow_tpu.analysis import poolcheck
+
+    res = poolcheck.model_check("tiered",
+                                mutations=("spill_scale_drop",))
+    assert any(h[0] == "tier-scales" for h in res.hits), res.hits
+    _n, msg, trace = next(h for h in res.hits if h[0] == "tier-scales")
+    assert "does not match its content state" in msg
+    # the defect fires the moment a page spills: the minimal trace ends
+    # in one of the three spill-capable ops
+    assert trace[-1] == "spill" or trace[-1].startswith(("adopt(",
+                                                         "admit(",
+                                                         "step(")), trace
+    replayed = poolcheck.replay(trace, "tiered",
+                                mutations=("spill_scale_drop",))
+    assert any(v.split(":")[0] == "tier-scales" for v in replayed), \
+        (trace, replayed)
 
 
 def test_kv_pricing_dtype_misprice_fixture():

@@ -1,0 +1,363 @@
+"""Disaggregated serving (ISSUE 17): host-RAM KV tier, prefill/decode
+split, prefix-affinity router.
+
+Contracts under test: pages spilled to the host tier come back
+token-identical when a later request's lookup fetches them (the tier
+moves payloads, never re-derives them — the int8 scale sidecar rides
+along); a PrefillWorker -> decode-server handoff through a shared tier
+is greedy token-identical to the monolithic server (the decode side IS
+the proven preempt-resume path); preempt-resume keeps working when the
+preempted pages detour through the tier; the prefix-affinity router
+pins a prefix to one instance and beats round-robin on cache reuse for
+repeat-prefix traffic; and the reqlog records carry the disagg fields
+(spilled_pages / fetched_pages / routed_to) so routing decisions
+reconstruct offline.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+from flexflow_tpu.disagg import DisaggPair, HostTier, PrefixAffinityRouter
+from flexflow_tpu.ffconst import DataType
+from flexflow_tpu.models.llama import LlamaConfig, build_llama
+
+
+def _causal_lm(seed=7):
+    lcfg = LlamaConfig(vocab_size=512, dim=64, layers=2, heads=4,
+                       kv_heads=2, hidden=128, rope_theta=10000.0)
+    ff = FFModel(FFConfig(batch_size=1, seed=seed))
+    build_llama(ff, lcfg, batch_size=1, seq_len=8, dtype=DataType.FLOAT)
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff, lcfg
+
+
+# ---------------------------------------------------------------------------
+# HostTier unit behavior (no model)
+
+
+def test_host_tier_spill_fetch_move_semantics():
+    t = HostTier(capacity_pages=3)
+    t.spill("a", "payload-a")
+    t.spill("b", "payload-b")
+    assert t.contains("a") and len(t) == 2
+    assert t.peek("a") == "payload-a"      # peek never pops
+    assert t.contains("a")
+    assert t.fetch("a") == "payload-a"     # fetch is a move
+    assert not t.contains("a") and len(t) == 1
+    assert t.fetch("a") is None            # absent -> None, not raise
+    m = t.metrics()
+    assert m["spilled_pages_total"] == 2
+    assert m["fetched_pages_total"] == 1
+
+
+def test_host_tier_capacity_evicts_oldest_and_counts_drops():
+    t = HostTier(capacity_pages=2)
+    t.spill("a", 1)
+    t.spill("b", 2)
+    t.spill("c", 3)                        # capacity 2: oldest (a) drops
+    assert not t.contains("a")
+    assert t.contains("b") and t.contains("c")
+    assert t.metrics()["dropped_pages_total"] == 1
+    # latest-wins re-spill refreshes recency instead of duplicating
+    t.spill("b", 20)
+    t.spill("d", 4)                        # now c is oldest -> drops
+    assert t.contains("b") and t.peek("b") == 20
+    assert not t.contains("c")
+
+
+def test_host_tier_unfetch_rolls_back_to_lru_front():
+    """A fetch whose device-side alloc fails must roll back: unfetch
+    re-inserts at the LRU FRONT (oldest), so a rolled-back page is the
+    first capacity victim, not the freshest entry."""
+    t = HostTier(capacity_pages=2)
+    t.spill("a", 1)
+    t.spill("b", 2)
+    got = t.fetch("a")
+    t.unfetch("a", got)
+    assert t.contains("a")
+    assert t.metrics()["fetched_pages_total"] == 0  # rollback undoes it
+    t.spill("c", 3)                        # a is oldest again -> drops
+    assert not t.contains("a") and t.contains("b") and t.contains("c")
+
+
+def test_host_tier_survives_deepcopy():
+    """poolcheck clones whole models with copy.deepcopy — the tier's
+    lock must not break that, and the clone must be independent."""
+    t = HostTier(capacity_pages=4)
+    t.spill("a", (1, 2))
+    c = copy.deepcopy(t)
+    assert c.peek("a") == (1, 2)
+    c.spill("b", 3)
+    assert not t.contains("b")
+
+
+# ---------------------------------------------------------------------------
+# spill -> fetch token identity on a live server
+
+
+def test_spill_then_fetch_is_token_identical():
+    """A pool too small to keep every finished prefix resident spills
+    evictions to the tier; resubmitting an old prompt fetches its pages
+    back — and the continuation is greedy-identical to dense generate,
+    i.e. the fetched KV is bit-for-bit the KV that was spilled."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(1, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (7, 9, 12)]
+    want = [ff.generate(p[None, :], max_new_tokens=6)[0] for p in prompts]
+    tier = HostTier(capacity_pages=64)
+    server = ff.serve_generation(slots=2, max_len=32, paged=True,
+                                 page_size=4, num_pages=10, host_tier=tier)
+    try:
+        got = [server.submit(p, max_new_tokens=6).result(timeout=120)
+               for p in prompts]
+        assert server.pool.spilled_pages > 0, (
+            "pool never spilled — shrink num_pages so the LRU evicts")
+        # resubmit the FIRST prompt: its pages left the pool long ago
+        again = server.submit(prompts[0], max_new_tokens=6).result(
+            timeout=120)
+        assert server.pool.fetched_pages > 0, (
+            "re-lookup never fetched from the tier")
+        m = server.metrics()
+        records = server.request_log.records()
+        server.pool.check_invariants(owners={})
+    finally:
+        server.stop()
+    for w, g in zip(want + [want[0]], got + [again]):
+        np.testing.assert_array_equal(w, np.asarray(g))
+    # the /v2 host_tier block and the reqlog fields tell the same story
+    assert m["host_tier"]["enabled"] is True
+    assert m["host_tier"]["spilled_pages"] == server.pool.spilled_pages
+    assert m["host_tier"]["fetched_pages"] == server.pool.fetched_pages
+    assert sum(r["fetched_pages"] for r in records) > 0
+    assert all("spilled_pages" in r and "routed_to" in r for r in records)
+
+
+def test_preempt_resume_through_the_tier():
+    """The preemption path under a tier: evicted pages SPILL instead of
+    dropping, and the preempted request's resume fetches its own prefix
+    back — still dense-identical, with both counters moving."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(2)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 6, 4, 7, 5, 6)]
+    want = [ff.generate(p[None, :], max_new_tokens=8)[0] for p in prompts]
+    server = ff.serve_generation(slots=2, max_len=16, paged=True,
+                                 page_size=4, num_pages=5,
+                                 host_tier=HostTier(64))
+    try:
+        futs = [server.submit(p, max_new_tokens=8) for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+        m = server.metrics()
+        server.pool.check_invariants(owners={})
+    finally:
+        server.stop()
+    for i, (w, g) in enumerate(zip(want, got)):
+        np.testing.assert_array_equal(w, g, err_msg=f"request {i}")
+    assert m["preemptions"] > 0, "pool pressure never preempted"
+    assert m["host_tier"]["spilled_pages"] > 0
+    assert m["host_tier"]["fetched_pages"] > 0
+
+
+def test_dense_server_rejects_host_tier():
+    ff, _ = _causal_lm()
+    with pytest.raises(ValueError, match="paged"):
+        ff.serve_generation(slots=1, max_len=16, paged=False,
+                            host_tier=HostTier(8))
+
+
+# ---------------------------------------------------------------------------
+# prefill/decode split
+
+
+def test_disagg_handoff_token_identical_to_monolithic():
+    """THE disaggregation acceptance: requests served by the
+    PrefillWorker -> decode-server pair (KV crossing through the shared
+    host tier) are greedy token-identical to the monolithic server and
+    to dense generate; every handoff moves pages through the tier; both
+    pools end invariant-clean."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(1)
+    prompts = [rs.randint(1, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (7, 9, 12)]
+    want = [ff.generate(p[None, :], max_new_tokens=6)[0] for p in prompts]
+    pair = DisaggPair(ff, tier_pages=64, page_size=4, num_pages=24,
+                      max_len=32, slots=2)
+    try:
+        got = [pair.submit(p, max_new_tokens=6).result(timeout=120)
+               for p in prompts]
+        m = pair.metrics()
+        pair.prefill.pool.check_invariants(owners={})
+        pair.decode.pool.check_invariants(owners={})
+    finally:
+        pair.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, np.asarray(g))
+    assert m["handoffs"] == len(prompts)
+    assert m["host_tier"]["spilled_pages_total"] > 0
+    assert m["host_tier"]["fetched_pages_total"] > 0
+    # the prefill worker never decoded: its reqlog has no completions,
+    # the decode side completed everything
+    assert len(pair.decode.request_log.records()) == len(prompts)
+
+
+def test_disagg_pair_concurrent_submissions():
+    """Overlapped submissions: prefill admits the next request while
+    the decode worker streams earlier ones — all futures resolve
+    dense-identical (no lost handoffs, no cross-request KV mixups)."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(1, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 11, 8, 6)]
+    want = [ff.generate(p[None, :], max_new_tokens=5)[0] for p in prompts]
+    pair = DisaggPair(ff, tier_pages=64, page_size=4, num_pages=24,
+                      max_len=32, slots=2)
+    try:
+        futs = [pair.submit(p, max_new_tokens=5) for p in prompts]
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        pair.stop()
+    for i, (w, g) in enumerate(zip(want, got)):
+        np.testing.assert_array_equal(w, np.asarray(g),
+                                      err_msg=f"request {i}")
+    assert pair.handoffs == len(prompts)
+
+
+def test_prefill_worker_requires_tier_and_prefix_cache():
+    ff, _ = _causal_lm()
+    from flexflow_tpu.disagg.workers import PrefillWorker
+
+    with pytest.raises(ValueError, match="host_tier"):
+        PrefillWorker(ff, handoff=lambda r: None, host_tier=None,
+                      slots=1, max_len=16, page_size=4)
+    with pytest.raises(ValueError, match="prefix_cache"):
+        PrefillWorker(ff, handoff=lambda r: None, host_tier=HostTier(8),
+                      prefix_cache=False, slots=1, max_len=16, page_size=4)
+
+
+# ---------------------------------------------------------------------------
+# prefix-affinity router
+
+
+def _two_servers(ff):
+    mk = lambda: ff.serve_generation(  # noqa: E731
+        slots=2, max_len=32, paged=True, page_size=4, num_pages=24)
+    return mk(), mk()
+
+
+def test_router_pins_prefixes_and_beats_round_robin_on_reuse():
+    """Affinity acceptance: the same prompt always routes to the same
+    instance (sticky map), and on repeat-prefix traffic the router's
+    cache reuse is at least round-robin's — round-robin scatters a
+    prefix across pools, so each pool recomputes it."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(4)
+    base = [rs.randint(1, lcfg.vocab_size, (9,)).astype(np.int32)
+            for _ in range(2)]
+    # two prefix groups, each served three times back-to-back
+    traffic = [base[0]] * 3 + [base[1]] * 3
+    want = {i: ff.generate(p[None, :], max_new_tokens=4)[0]
+            for i, p in enumerate(traffic)}
+
+    # round-robin baseline: alternate instances, serially
+    s0, s1 = _two_servers(ff)
+    try:
+        for i, p in enumerate(traffic):
+            got = [s0, s1][i % 2].submit(p, max_new_tokens=4).result(
+                timeout=120)
+            np.testing.assert_array_equal(want[i], np.asarray(got))
+        rr_cached = sum(r["cached_prefill_tokens"]
+                        for s in (s0, s1)
+                        for r in s.request_log.records())
+    finally:
+        s0.stop()
+        s1.stop()
+
+    s0, s1 = _two_servers(ff)
+    router = PrefixAffinityRouter([s0, s1], names=["a", "b"])
+    try:
+        homes = []
+        for i, p in enumerate(traffic):
+            got = router.submit(p, max_new_tokens=4).result(timeout=120)
+            np.testing.assert_array_equal(want[i], np.asarray(got))
+            homes.append(router.route_index(p))
+        rt_cached = sum(r["cached_prefill_tokens"]
+                        for s in (s0, s1)
+                        for r in s.request_log.records())
+        records = [r for s in (s0, s1)
+                   for r in s.request_log.records()]
+        m = router.metrics()
+    finally:
+        router.stop()
+    # sticky: each group landed on ONE instance, all six runs
+    assert homes[0] == homes[1] == homes[2]
+    assert homes[3] == homes[4] == homes[5]
+    # 2 misses (first sight of each group) + 4 hits + 6 probe re-routes
+    assert m["affinity_misses"] == 2
+    assert m["affinity_hits"] >= 4
+    assert sum(m["routed_total"]) == 6
+    # the reuse win the router exists for
+    assert rt_cached >= rr_cached
+    assert rt_cached > 0
+    # every record names its instance (ff.reqlog/v1 additive field)
+    assert {r["routed_to"] for r in records} <= {"a", "b"}
+    assert all(r["routed_to"] is not None for r in records)
+
+
+def test_router_load_balances_fresh_prefixes():
+    """Never-seen prefixes spread by load: a burst of distinct prompts
+    submitted without waiting raises the chosen instance's in-flight
+    count, so the next fresh prefix goes to the other instance instead
+    of piling onto one."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(6)
+    prompts = [rs.randint(1, lcfg.vocab_size, (9,)).astype(np.int32)
+               for _ in range(4)]
+    s0, s1 = _two_servers(ff)
+    router = PrefixAffinityRouter([s0, s1])
+    try:
+        futs = [router.submit(p, max_new_tokens=3) for p in prompts]
+        m = router.metrics()  # snapshot BEFORE completions drain it
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        router.stop()
+    assert m["routed_total"][0] > 0 and m["routed_total"][1] > 0
+    assert m["affinity_misses"] == 4  # four distinct prefixes
+
+
+def test_router_rejects_mismatched_page_sizes():
+    ff, _ = _causal_lm()
+    s0 = ff.serve_generation(slots=1, max_len=16, paged=True, page_size=4)
+    s1 = ff.serve_generation(slots=1, max_len=16, paged=True, page_size=8)
+    try:
+        with pytest.raises(ValueError, match="page_size"):
+            PrefixAffinityRouter([s0, s1])
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_router_fronts_disagg_pairs():
+    """The router's instance contract (pool / submit_request / stop) is
+    satisfied by DisaggPair too — routed disaggregated serving stays
+    token-identical."""
+    ff, lcfg = _causal_lm()
+    rs = np.random.RandomState(8)
+    prompts = [rs.randint(1, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (7, 9)]
+    want = [ff.generate(p[None, :], max_new_tokens=4)[0] for p in prompts]
+    pairs = [DisaggPair(ff, tier_pages=64, page_size=4, num_pages=24,
+                        max_len=32, slots=2) for _ in range(2)]
+    router = PrefixAffinityRouter(pairs)
+    try:
+        got = [router.submit(p, max_new_tokens=4).result(timeout=120)
+               for p in prompts]
+    finally:
+        router.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, np.asarray(g))
+    assert sum(p.handoffs for p in pairs) == len(prompts)
