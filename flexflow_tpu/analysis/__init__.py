@@ -5,7 +5,7 @@ Unity-style search is only trustworthy while its invariants hold; round-5
 review enforced them by human advisor (two cost-model/lowering pricing
 divergences shipped, 377/408 corpus rules silently inert with no tool to
 say why). This subsystem turns those recurring review findings into a CI
-gate. Six passes ship (registered like op lowerings, so future PRs add
+gate. Seven passes ship (registered like op lowerings, so future PRs add
 passes, not frameworks):
 
   consistency — strategy/sharding algebra per node: degrees divide dims,
@@ -32,6 +32,14 @@ passes, not frameworks):
       minimal counterexample traces; plus an AST lint arm for
       write-after-share, page-table, pool-encapsulation, and
       lock-discipline hazards (pragma-annotatable like hostsync).
+  racecheck   — lock-discipline + interleaving checking for the threaded
+      serving protocols: a whole-repo lock model inferring which locks
+      guard which fields (race-unguarded-write, lock-order-cycle,
+      lock-held-device-sync, atomicity-split, with race-ok pragmas), and
+      a bounded interleaving model checker over abstract LTS models of
+      the prefill→decode handoff, tier spill/fetch, and drain-and-swap
+      protocols with DPOR-style sleep-set pruning and minimal replayable
+      counterexample traces. poolcheck's lock lint delegates here.
   shapecheck  — the launch-shape-space auditor: a taint arm classifying
       every symbolic width feeding a jit launch as clamped/unbounded, an
       enumeration arm computing the closed per-config catalog of
@@ -115,6 +123,17 @@ class AnalysisContext:
     shapecheck_configs: Optional[Dict] = None
     # shape catalogs + jit entry-point inventory, filled by the pass
     shapecheck_summary: Optional[Dict] = None
+    # racecheck controls: lint arm only (--since mode), explicit lint
+    # paths (fixtures), protocol-model mutation labels, interleaving
+    # trace dir, and the context-switch bound (None = default)
+    racecheck_lint_only: bool = False
+    racecheck_paths: Optional[List[str]] = None
+    racecheck_mutations: Optional[List[str]] = None
+    racecheck_trace_dir: Optional[str] = None
+    racecheck_switch_bound: Optional[int] = None
+    # interleaving-exploration summary (explored/distinct states per
+    # model), filled by the pass
+    racecheck_summary: Optional[Dict] = None
 
 
 @dataclasses.dataclass
@@ -191,6 +210,7 @@ def _ensure_registered() -> None:
         hloaudit,
         hostsync,
         poolcheck,
+        racecheck,
         rulesat,
         shapecheck,
     )

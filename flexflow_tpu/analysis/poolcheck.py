@@ -912,63 +912,18 @@ def _lint_pool_private(fl: _FileLint):
 # -- lock discipline ---------------------------------------------------------
 
 
-class _ClassInfo:
-    def __init__(self, fl: _FileLint, node: ast.ClassDef):
-        self.fl = fl
-        self.node = node
-        self.name = node.name
-        self.bases = [d[-1] for d in
-                      (_dotted(b) for b in node.bases) if d]
-        self.threaded = False
-        self.owned: Set[str] = set()
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.With):
-                for item in sub.items:
-                    if _dotted(item.context_expr) == ("self", "_lock"):
-                        self.threaded = True
-            elif isinstance(sub, ast.Call):
-                d = _dotted(sub.func)
-                if d and d[-2:] == ("threading", "Thread")[-2:] \
-                        and d[-1] == "Thread":
-                    self.threaded = True
-        for meth in node.body:
-            if not isinstance(meth, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                continue
-            private = meth.name.startswith("_") \
-                and not meth.name.startswith("__")
-            if not private:
-                continue
-            for sub in ast.walk(meth):
-                if isinstance(sub, (ast.Assign, ast.AugAssign,
-                                    ast.AnnAssign)):
-                    targets = sub.targets if isinstance(sub, ast.Assign) \
-                        else [sub.target]
-                    for t in targets:
-                        for el in (t.elts if isinstance(
-                                t, (ast.Tuple, ast.List)) else [t]):
-                            base = el.value if isinstance(
-                                el, ast.Subscript) else el
-                            d = _dotted(base)
-                            if d and len(d) == 2 and d[0] == "self":
-                                self.owned.add(d[1])
-
-    def public_methods(self):
-        for meth in self.node.body:
-            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and not meth.name.startswith("_"):
-                yield meth
-
-
 class _LockScanner(ast.NodeVisitor):
     """Flag unlocked reads of loop-owned fields (and pool state) in ONE
-    public method of a threaded server class."""
+    public method of a threaded server class. `owned` and `lock_attrs`
+    come from racecheck's whole-repo lock model (see _lint_locks)."""
 
-    def __init__(self, fl: _FileLint, cls: str, meth, owned: Set[str]):
+    def __init__(self, fl: _FileLint, cls: str, meth, owned: Set[str],
+                 lock_attrs: Optional[Set[str]] = None):
         self.fl = fl
         self.cls = cls
         self.meth = meth
         self.owned = owned
+        self.lock_attrs = lock_attrs or {"_lock"}
         self.lock_depth = 0
         self.pool_aliases: Set[str] = set()
 
@@ -987,8 +942,10 @@ class _LockScanner(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_With(self, node):
-        locked = any(_dotted(i.context_expr) == ("self", "_lock")
-                     for i in node.items)
+        locked = any(
+            d is not None and len(d) == 2 and d[0] == "self"
+            and d[1] in self.lock_attrs
+            for d in (_dotted(i.context_expr) for i in node.items))
         if locked:
             self.lock_depth += 1
             for stmt in node.body:
@@ -1024,49 +981,32 @@ class _LockScanner(ast.NodeVisitor):
 
 
 def _lint_locks(file_lints: List[_FileLint]):
-    """Two-phase, cross-file: collect every class (with textual base
-    names), close `threaded` and loop-owned fields over the hierarchy,
-    then scan public methods of threaded classes. Non-transitive within
-    a method, like hostsync: each method's own AST only."""
-    infos: Dict[str, _ClassInfo] = {}
-    for fl in file_lints:
-        for node in ast.walk(fl.tree):
-            if isinstance(node, ast.ClassDef):
-                infos[node.name] = _ClassInfo(fl, node)
+    """Delegates to racecheck's whole-repo lock model (ONE lock model in
+    the tree): racecheck closes the class hierarchy both ways and infers
+    threadedness, loop-owned fields, and lock-guarded fields; this arm
+    keeps poolcheck's historical public-surface unlocked-read scan over
+    that model. Non-transitive within a method, like hostsync: each
+    method's own AST only."""
+    from flexflow_tpu.analysis import racecheck
 
-    def ancestors(name: str, seen=None) -> Set[str]:
-        seen = seen or set()
-        for b in infos.get(name, _Empty).bases if name in infos else ():
-            if b in infos and b not in seen:
-                seen.add(b)
-                ancestors(b, seen)
-        return seen
-
-    class _Empty:
-        bases = ()
-
-    family: Dict[str, Set[str]] = {}
-    for name in infos:
-        family[name] = {name} | ancestors(name)
-    for name, fam in family.items():
-        for anc in list(fam):
-            # descendants share the chassis: a field the subclass's loop
-            # thread mutates is cross-thread state for the base's public
-            # readers too
-            family.setdefault(anc, {anc}).add(name)
-    for name, ci in infos.items():
-        group = set()
-        for member in family.get(name, {name}):
-            group |= family.get(member, {member})
-        threaded = any(infos[m].threaded for m in group if m in infos)
-        if not threaded:
+    units = [(fl.rel, fl.tree) for fl in file_lints]
+    model = racecheck.build_lock_model(units)
+    fl_by_rel = {fl.rel: fl for fl in file_lints}
+    for name in sorted(model.classes):
+        cm = model.classes[name]
+        fl = fl_by_rel.get(cm.rel)
+        if fl is None:
             continue
-        owned = set()
-        for m in group:
-            if m in infos:
-                owned |= infos[m].owned
-        for meth in ci.public_methods():
-            scanner = _LockScanner(ci.fl, name, meth, owned)
+        if not model.family_threaded(name):
+            continue
+        # cross-thread state = poolcheck's historical loop-owned fields
+        # UNION racecheck's lock-guarded fields (a field someone takes a
+        # lock to write is cross-thread by that very act)
+        owned = model.family_owned(name) \
+            | set(model.family_guarded(name))
+        lock_attrs = model.family_lock_attrs(name) | {"_lock"}
+        for meth in cm.public_method_nodes():
+            scanner = _LockScanner(fl, name, meth, owned, lock_attrs)
             for stmt in meth.body:
                 scanner.visit(stmt)
 

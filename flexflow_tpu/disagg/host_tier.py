@@ -76,14 +76,17 @@ class HostTier:
     # -- query ------------------------------------------------------------
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     @property
     def occupancy_pages(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def contains(self, chain_hash: str) -> bool:
-        return chain_hash in self._entries
+        with self._lock:
+            return chain_hash in self._entries
 
     def hashes(self) -> List[str]:
         with self._lock:
@@ -142,10 +145,14 @@ class HostTier:
             self.fetch_seconds_total += max(0.0, float(dt))
 
     # locks don't survive copy/pickle — the poolcheck model deep-copies
-    # its tier at every BFS expansion, so rebuild the lock on the copy
+    # its tier at every BFS expansion, so rebuild the lock on the copy.
+    # _entries is snapshotted INSIDE the lock: deepcopy walks the
+    # returned state after this method exits, and a concurrent spill
+    # mutating the live OrderedDict mid-walk is a crash, not a copy
     def __getstate__(self):
         with self._lock:
             d = self.__dict__.copy()
+            d["_entries"] = OrderedDict(self._entries)
         del d["_lock"]
         return d
 

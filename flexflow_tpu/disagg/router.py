@@ -114,6 +114,21 @@ class PrefixAffinityRouter:
         tier = pool.tier
         return tier is None or len(tier) >= tier.capacity_pages
 
+    def _route_locked(self, key: str) -> int:
+        """Routing policy body; caller holds self._lock."""
+        i = self._affinity.get(key)
+        if i is not None:
+            self.affinity_hits += 1
+            return i
+        self.affinity_misses += 1
+        candidates = [j for j in range(len(self._instances))
+                      if not self._pressured(j)]
+        if not candidates:
+            candidates = list(range(len(self._instances)))
+        i = min(candidates, key=lambda j: (self._load(j), j))
+        self._affinity[key] = i
+        return i
+
     def route_index(self, prompt) -> int:
         """Pick (and pin) the instance for `prompt`. Deterministic:
         sticky map first, then min (load, index) over unpressured
@@ -121,18 +136,7 @@ class PrefixAffinityRouter:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         key = self._prefix_key(prompt)
         with self._lock:
-            i = self._affinity.get(key)
-            if i is not None:
-                self.affinity_hits += 1
-                return i
-            self.affinity_misses += 1
-            candidates = [j for j in range(len(self._instances))
-                          if not self._pressured(j)]
-            if not candidates:
-                candidates = list(range(len(self._instances)))
-            i = min(candidates, key=lambda j: (self._load(j), j))
-            self._affinity[key] = i
-            return i
+            return self._route_locked(key)
 
     # -- serving surface ----------------------------------------------------
 
@@ -146,17 +150,23 @@ class PrefixAffinityRouter:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("prompt must contain at least one token")
-        i = self.route_index(prompt)
         req = _GenRequest(prompt, max_new_tokens, temperature)
-        req.routed_to = self._names[i]
+        key = self._prefix_key(prompt)
+        # route + stamp + count under ONE acquisition: a concurrent
+        # submit must never observe the routing decision without the
+        # load bump that goes with it (stale-load window)
         with self._lock:
+            i = self._route_locked(key)
+            req.routed_to = self._names[i]
             self._inflight[i] += 1
             self.routed_total[i] += 1
         req.future.add_done_callback(lambda _f, i=i: self._done(i))
         try:
             self._instances[i].submit_request(req)
         except BaseException:
-            self._done(i)
+            # compensating decrement for a request that never enqueued —
+            # no decision spans the lock release, so the split is benign
+            self._done(i)  # fflint: race-ok (compensating decrement)
             raise
         return req.future
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import gzip
 import json
+import threading
 from collections import deque
 from typing import Iterable, Iterator, List, Optional
 
@@ -41,15 +42,21 @@ class BoundedRing:
     """THE bounded-retention code path: a keep-newest ring that COUNTS
     what it drops. Shared by the server's per-request metric records
     (`request_record_limit`) and the reqlog ring, and the drop counters
-    ride the /v2 metrics payload — silent truncation is visible."""
+    ride the /v2 metrics payload — silent truncation is visible.
 
-    __slots__ = ("_ring", "dropped")
+    Internally locked: appends happen on the serving loop thread while
+    snapshots run on scrape/router threads, and iterating a deque that
+    another thread is appending to raises RuntimeError (racecheck's
+    router-vs-reqlog finding). Readers get a consistent list copy."""
+
+    __slots__ = ("_ring", "_lock", "dropped")
 
     def __init__(self, capacity: int):
         capacity = int(capacity)
         if capacity < 1:
             raise ValueError(f"ring capacity must be >= 1, got {capacity}")
         self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
         self.dropped = 0
 
     @property
@@ -57,23 +64,27 @@ class BoundedRing:
         return self._ring.maxlen
 
     def append(self, item) -> None:
-        if len(self._ring) == self._ring.maxlen:
-            self.dropped += 1
-        self._ring.append(item)
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(item)
 
     def __len__(self) -> int:
-        return len(self._ring)
+        with self._lock:
+            return len(self._ring)
 
     def __iter__(self) -> Iterator:
-        return iter(self._ring)
+        return iter(self.snapshot())
 
     def snapshot(self) -> List:
-        return list(self._ring)
+        with self._lock:
+            return list(self._ring)
 
     def tail(self, n: int) -> List:
         if n <= 0:
             return []
-        return list(self._ring)[-n:]
+        with self._lock:
+            return list(self._ring)[-n:]
 
 
 class _NullRequestLog:
@@ -116,8 +127,8 @@ NULL_REQLOG = _NullRequestLog()
 class RequestLog:
     """Bounded flight recorder of completed-request records. Appends
     happen on the serving loop thread; snapshots/export may run on any
-    thread (deque append/iterate are GIL-atomic enough for a metrics
-    read, same relaxed discipline as the server counters)."""
+    thread — the BoundedRing is internally locked, so readers always
+    see a consistent list copy."""
 
     __slots__ = ("_ring",)
 

@@ -1055,7 +1055,8 @@ class _GenerationServerBase:
         if self._thread is not None:
             self._thread.join(timeout=30)
             if self._thread.is_alive():
-                self._detaching = False
+                with self._lock:
+                    self._detaching = False
                 raise RuntimeError(
                     "serving loop did not pause within 30s — refusing to "
                     "detach requests from a live loop")

@@ -147,24 +147,27 @@ class ServingAutopilot:
 
     @property
     def server(self):
-        """The inner generation server currently taking traffic."""
-        return self._inner
+        """The inner generation server currently taking traffic —
+        snapshotted under the swap lock, so a caller holds a coherent
+        reference even if a swap lands the next instant."""
+        with self._swap_lock:
+            return self._inner
 
     @property
     def strategy(self):
-        return self._inner.serve_strategy
+        return self.server.serve_strategy
 
     @property
     def strategy_fingerprint(self) -> Optional[str]:
-        return self._inner.strategy_fingerprint
+        return self.server.strategy_fingerprint
 
     @property
     def request_log(self):
-        return self._inner.request_log
+        return self.server.request_log
 
     @property
     def registry(self):
-        return self._inner.registry
+        return self.server.registry
 
     def submit(self, prompt_ids, max_new_tokens, temperature: float = 0.0):
         # under the swap lock: a submit either reaches the old server
@@ -181,16 +184,19 @@ class ServingAutopilot:
                            temperature).result()
 
     def metrics(self) -> dict:
-        out = self._inner.metrics()
+        out = self.server.metrics()
         window = self._window_records()
         measured = self._measured_ttft_p95(window)
+        with self._swap_lock:
+            decisions = self.decisions[-DECISION_LOG_LIMIT:]
+            holds = self.holds
         # deliberate relaxed reads: the counters are monotonic ints
         # mutated only by the controller thread, and a metrics scrape
         # that races a step by one tick is harmless
         out["autopilot"] = {
             "steps": self.steps,
-            "swaps": self.swaps,
-            "holds": self.holds,  # fflint: lock-ok (relaxed scrape)
+            "swaps": self.swaps,  # fflint: lock-ok (relaxed scrape)
+            "holds": holds,
             "last_improvement": self.last_improvement,
             "window_records": len(window),
             "sim_backend": 1.0 if self.sim else 0.0,
@@ -198,16 +204,18 @@ class ServingAutopilot:
             "measured_ttft_p95_s": measured,
             # decisions are dicts-with-strings: JSON payload only, the
             # Prometheus flattener (obs.flatten_scalars) skips them
-            "decisions": self.decisions[-DECISION_LOG_LIMIT:],
+            "decisions": decisions,
         }
         return out
 
     def stop(self):
         self._stop_evt.set()
         if self._thread is not None:
+            # join OUTSIDE the swap lock: the controller thread takes it
+            # inside swap_to, and joining while holding it would deadlock
             self._thread.join(timeout=30)
             self._thread = None
-        self._inner.stop()
+        self.server.stop()
 
     # -- controller -------------------------------------------------------
 
@@ -236,7 +244,8 @@ class ServingAutopilot:
         skips the drift gate — the search still has to show the
         improvement before anything swaps."""
         self.steps += 1
-        fp = self._inner.strategy_fingerprint
+        inner = self.server  # one coherent snapshot for this evaluation
+        fp = inner.strategy_fingerprint
         window = self._window_records()
         decision = {"step": self.steps, "fingerprint": fp,
                     "window": len(window), "action": "hold"}
@@ -248,7 +257,7 @@ class ServingAutopilot:
 
         profile = RecordedProfile(window, name=f"autopilot-{fp}")
         moments = _traffic_moments(profile)
-        slo = getattr(self._inner, "_slo", None)
+        slo = getattr(inner, "_slo", None)
         breached = bool(slo is not None and slo.breached)
         drift = _drift(self._tuned_moments, moments)
         decision["drift"] = None if drift == float("inf") else drift
@@ -264,7 +273,7 @@ class ServingAutopilot:
             self._ff, traffic=profile, budget=self.budget,
             slots=self._server_kwargs["slots"],
             max_len=self._server_kwargs["max_len"],
-            default=self._inner.serve_strategy,
+            default=inner.serve_strategy,
             sim=self.sim, seed=self.search_seed)
         self._tuned_moments = moments
         self.last_improvement = result.improvement
@@ -284,10 +293,15 @@ class ServingAutopilot:
         return self._record(decision)
 
     def _record(self, decision: dict) -> dict:
-        if decision["action"] != "swap":
-            self.holds += 1
-        self.decisions.append(decision)
-        del self.decisions[:-DECISION_LOG_LIMIT]
+        # the decision log is swap-lock-guarded: the /v2 scrape slices
+        # it from other threads while the controller appends + trims,
+        # and a trim mid-slice must not hand the scrape a torn tail
+        # (never called with the lock held — swap_to releases first)
+        with self._swap_lock:
+            if decision["action"] != "swap":
+                self.holds += 1
+            self.decisions.append(decision)
+            del self.decisions[:-DECISION_LOG_LIMIT]
         logger.info("autopilot step %d: %s (%s)", decision["step"],
                     decision["action"], decision.get("reason", ""))
         return decision

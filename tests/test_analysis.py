@@ -1,6 +1,7 @@
 """fflint static-analysis subsystem (flexflow_tpu.analysis): pass
-registry, the six passes (consistency / rulesat / hostsync / hloaudit /
-poolcheck / shapecheck), the seeded-defect regression fixtures from
+registry, the seven passes (consistency / rulesat / hostsync /
+hloaudit / poolcheck / shapecheck / racecheck), the seeded-defect
+regression fixtures from
 ISSUE 3 (a misdeclared cost-model comm-spec reintroducing the ulysses
 h_deg bug shape, an unsatisfiable corpus rule, a host-sync in a decode
 loop), ISSUE 4 (a zeroed priced comm event the lowered-HLO diff must
@@ -13,8 +14,14 @@ replayable minimal counterexample trace) and ISSUE 14 (an unclamped
 launch width that must produce shape-space-unbounded with its taint
 chain, plus a deliberately shrunk catalog check_soundness must fail —
 the live-serving half of that gate runs in
-tests/test_shapecheck_gate.py), strategy-file import validation, and
-the CLI strict gate tier-1 rides on."""
+tests/test_shapecheck_gate.py) and ISSUE 18 (three injected
+concurrency defects — a dropped-lock host-tier mutation, an inverted
+tier-vs-scheduler lock acquisition order, a prefill->decode handoff
+that submits the same request twice — which racecheck's lint arm and
+bounded interleaving model checker must each catch with a named
+finding, the dynamic ones with minimal replayable interleaving
+traces), strategy-file import validation, and the CLI strict gate
+tier-1 rides on."""
 
 import json
 import os
@@ -1689,3 +1696,198 @@ def test_shapecheck_union_catalog_spans_a_strategy_swap():
               "steady_state": True}]
     assert [f.code for f in check_soundness(union, rogue)] == \
         ["shape-catalog-unsound"]
+
+
+# ---------------------------------------------------------------------------
+# racecheck: lock-discipline lint + bounded interleaving model checking
+# over the threaded serving protocols (ISSUE 18)
+
+
+def test_racecheck_registered_and_in_default_gate():
+    assert "racecheck" in available_passes()
+    # the CLI default gate includes racecheck (before poolcheck, which
+    # delegates its lock lint to racecheck's inferred model)
+    with open(os.path.join(REPO, "tools", "fflint.py")) as f:
+        src = f.read()
+    head = src.split("DEFAULT_PASSES")[1][:200]
+    assert '"racecheck"' in head and '"poolcheck")' in head
+
+
+def test_racecheck_lint_flags_dropped_lock_tier_mutation(tmp_path):
+    """Seeded defect 1: a tier class whose spill loop writes _entries
+    under the lock, while a public drop() mutates it lock-free — the
+    field is inferred lock-guarded and the bare write is an error.
+    Locked writes and inline race-ok pragmas are silent; a pragma
+    suppressing nothing is stale."""
+    from flexflow_tpu.analysis import racecheck
+
+    bad = tmp_path / "tier.py"
+    bad.write_text(textwrap.dedent("""\
+        import threading
+
+        class Tier:
+            def start(self):
+                self._spiller = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                with self._lock:
+                    self._entries["h"] = "payload"
+
+            def drop(self, h):
+                del self._entries[h]
+
+            def locked_drop(self, h):
+                with self._lock:
+                    del self._entries[h]
+
+            def relaxed(self, h):
+                self._entries[h] = None  # fflint: race-ok (test relaxed)
+
+        def free_fn():  # fflint: race-ok (suppresses nothing)
+            return 0
+    """))
+    fs = racecheck.lint_file(str(bad), rel="disagg/host_tier.py")
+    codes = [(f.code, f.where) for f in fs]
+    assert ("race-unguarded-write", "disagg/host_tier.py:12") in codes
+    err = next(f for f in fs if f.code == "race-unguarded-write")
+    assert err.severity == "error"
+    assert "_entries" in err.message and "_lock" in err.message
+    assert ("stale-pragma", "disagg/host_tier.py:21") in codes
+    # locked_drop and the pragma'd relaxed write are silent
+    assert len(codes) == 2, fs
+
+
+def test_racecheck_lint_flags_inverted_tier_scheduler_lock_order(
+        tmp_path):
+    """Seeded defect 2: spill holds the tier lock and calls into the
+    scheduler (which takes its own lock) while evict holds the
+    scheduler lock and calls back into the tier — a cross-thread
+    deadlock cycle the one-level call-resolved order graph must name
+    with both locks and a witness site per edge."""
+    from flexflow_tpu.analysis import racecheck
+
+    bad = tmp_path / "sched.py"
+    bad.write_text(textwrap.dedent("""\
+        import threading
+
+        class HostTierX:
+            def __init__(self):
+                self._tier_lock = threading.Lock()
+
+            def spill(self, sched):
+                with self._tier_lock:
+                    sched.admit_page()
+
+        class SchedX:
+            def __init__(self):
+                self._sched_lock = threading.Lock()
+
+            def admit_page(self):
+                with self._sched_lock:
+                    self.admitted = 1
+
+            def evict(self, tier):
+                with self._sched_lock:
+                    tier.spill(self)
+    """))
+    fs = racecheck.lint_file(str(bad), rel="paged/scheduler.py")
+    assert [f.code for f in fs] == ["lock-order-cycle"], fs
+    f = fs[0]
+    assert f.severity == "error"
+    assert "HostTierX._tier_lock" in f.message
+    assert "SchedX._sched_lock" in f.message
+    assert "deadlock" in f.message
+
+
+def test_racecheck_flags_handoff_double_submit_interleaving():
+    """Seeded defect 3 (dynamic): a prefill worker that enqueues the
+    same request twice hands two owners the same KV — the explorer
+    finds the single-owner violation and the minimal trace replays to
+    the same violation from the initial state."""
+    from flexflow_tpu.analysis import racecheck
+
+    def factory():
+        return racecheck.HandoffModel(mutations=("double_submit",))
+
+    res = racecheck.explore_interleavings(factory)
+    assert any(h[0] == "single-owner" for h in res.hits), res.hits
+    inv, msg, trace = next(h for h in res.hits
+                           if h[0] == "single-owner")
+    assert trace, "counterexample must carry a non-empty trace"
+    # every step is a replayable 'tid:label' action
+    assert all(":" in step for step in trace)
+    replayed = racecheck.replay_interleaving(factory, trace)
+    assert any(v.split(":")[0] == "single-owner" for v in replayed), \
+        (trace, replayed)
+    # the clean model explores the same space violation-free
+    clean = racecheck.explore_interleavings(racecheck.HandoffModel)
+    assert clean.hits == [] and not clean.truncated
+
+
+def test_racecheck_pass_reports_findings_summary_and_traces(tmp_path):
+    """Pass-function level: a seeded interleaving defect surfaces as an
+    ilv-* error Finding with the minimal schedule in the message, the
+    trace lands as a replayable JSON artifact, and the explored-state
+    summary is filled for the CLI/CI."""
+    from flexflow_tpu.analysis import racecheck
+
+    ctx = AnalysisContext(subject="races",
+                          racecheck_mutations=["unlocked_submit"],
+                          racecheck_trace_dir=str(tmp_path))
+    report = run_passes(["racecheck"], ctx)
+    errs = [f for f in report.findings if f.severity == "error"]
+    assert any(f.code == "ilv-future-dropped" for f in errs), \
+        report.findings
+    f = next(f for f in errs if f.code == "ilv-future-dropped")
+    assert f.where == "racecheck:model/swap"
+    assert "Minimal interleaving" in f.message
+    assert ctx.racecheck_summary["explored"] > 0
+    assert set(ctx.racecheck_summary["models"]) == \
+        {"handoff", "tierpool", "swap"}
+    traces = list(tmp_path.glob("interleave-swap-future-dropped.json"))
+    assert traces, list(tmp_path.iterdir())
+    with open(traces[0]) as fh:
+        blob = json.load(fh)
+    replayed = racecheck.replay_interleaving(
+        lambda: racecheck.SwapModel(mutations=("unlocked_submit",)),
+        blob["trace"])
+    assert any(v.split(":")[0] == blob["invariant"] for v in replayed)
+
+
+def test_racecheck_repo_lint_clean_with_zero_suppression_debt():
+    """The shipped threaded serving sources pass the lint arm with no
+    findings at all — no unguarded writes, no order cycles, no stale
+    pragmas, so every race-ok in the tree is load-bearing (the ISSUE-18
+    hygiene-sweep bar)."""
+    from flexflow_tpu.analysis import racecheck
+
+    fs = racecheck.lint_paths(racecheck.default_lint_paths())
+    assert fs == [], [(f.code, f.where) for f in fs]
+
+
+def test_fflint_since_selects_racecheck_and_demotes_to_lint_arm():
+    """--since maps diffs touching the threaded serving roots (disagg/,
+    obs/, serving.py) onto racecheck, and demotes it to lint-only so
+    the pre-commit hook never pays for interleaving exploration."""
+    proc = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(f"""\
+            import importlib.util as u
+            spec = u.spec_from_file_location(
+                "ff_lint", {os.path.join(REPO, 'tools', 'fflint.py')!r})
+            m = u.module_from_spec(spec)
+            spec.loader.exec_module(m)
+            sel = m.passes_for_changes
+            cand = list(m.DEFAULT_PASSES)
+            for path in ("flexflow_tpu/disagg/router.py",
+                         "flexflow_tpu/obs/reqlog.py",
+                         "flexflow_tpu/serving.py"):
+                got = sel([path], cand)
+                assert "racecheck" in got, (path, got)
+            assert sel(["docs/serving.md"], cand) == []
+            print("OK")
+        """)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

@@ -20,7 +20,15 @@ Default run (what tier-1 gates on through tests/test_analysis.py):
     bounded serving scenarios asserting the pool invariant catalog at
     every reachable state (explored-state counts land in the pass
     summary; counterexample traces become error findings and, with
-    --trace-dir, replayable JSON artifacts).
+    --trace-dir, replayable JSON artifacts);
+  - racecheck: the lock-discipline lint (race-unguarded-write /
+    lock-order-cycle / lock-held-device-sync / atomicity-split, over a
+    whole-repo inferred lock model of the threaded serving surface)
+    plus the bounded interleaving model checker over the three
+    cross-thread protocols (prefill→decode handoff, tier spill/fetch,
+    drain-and-swap) — interleaving counterexamples become error
+    findings with minimal replayable schedules (also JSON artifacts
+    under --trace-dir).
 
 The hloaudit pass — AOT-compile every BASELINE config's real entry
 points (train/eval/paged-decode/verify) and diff the optimized HLO's
@@ -178,7 +186,7 @@ def write_coverage_classification(classification):
 # hloaudit XLA-compiles every config (minutes) — selected explicitly,
 # never part of the default invocation tier-1 rides on
 DEFAULT_PASSES = ("consistency", "rulesat", "hostsync", "shapecheck",
-                  "poolcheck")
+                  "racecheck", "poolcheck")
 
 # source roots per pass, for --since REV changed-files selection: a pass
 # runs only when the diff touches one of its roots (repo-relative file
@@ -200,6 +208,11 @@ PASS_ROOTS = {
     "poolcheck": ("flexflow_tpu/paged", "flexflow_tpu/spec",
                   "flexflow_tpu/serving.py", "flexflow_tpu/analysis",
                   "flexflow_tpu/serving_autopilot.py",
+                  "flexflow_tpu/disagg", "tools/fflint.py"),
+    "racecheck": ("flexflow_tpu/paged", "flexflow_tpu/spec",
+                  "flexflow_tpu/serving.py", "flexflow_tpu/analysis",
+                  "flexflow_tpu/serving_autopilot.py",
+                  "flexflow_tpu/disagg", "flexflow_tpu/obs",
                   "tools/fflint.py"),
     "shapecheck": ("flexflow_tpu/paged", "flexflow_tpu/spec",
                    "flexflow_tpu/serving.py", "flexflow_tpu/runtime",
@@ -277,9 +290,10 @@ def main(argv=None):
                          "poolcheck runs lint-arm only (model checking "
                          "and hloaudit stay opt-in)")
     ap.add_argument("--trace-dir", default=None, dest="trace_dir",
-                    help="(poolcheck) write counterexample traces as "
-                         "replayable JSON files into this directory "
-                         "(CI uploads them as artifacts)")
+                    help="(poolcheck/racecheck) write counterexample "
+                         "traces — pool op sequences and interleaving "
+                         "schedules — as replayable JSON files into "
+                         "this directory (CI uploads them as artifacts)")
     ap.add_argument("--shape-budget", default=None, type=int,
                     dest="shape_budget",
                     help="(shapecheck) per-config compile budget: a "
@@ -361,6 +375,17 @@ def main(argv=None):
         if ctx.poolcheck_summary:
             report.stats.setdefault("poolcheck", {})["model_check"] = \
                 ctx.poolcheck_summary
+    if "racecheck" in passes:
+        from flexflow_tpu.analysis import AnalysisContext, run_passes
+
+        ctx = AnalysisContext(
+            subject="races",
+            racecheck_lint_only=bool(args.since),
+            racecheck_trace_dir=args.trace_dir)
+        run_passes(["racecheck"], ctx, report)
+        if ctx.racecheck_summary:
+            report.stats.setdefault("racecheck", {})["interleavings"] = \
+                ctx.racecheck_summary
     if "shapecheck" in passes:
         from flexflow_tpu.analysis import AnalysisContext, run_passes
 
