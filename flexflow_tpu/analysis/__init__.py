@@ -5,7 +5,7 @@ Unity-style search is only trustworthy while its invariants hold; round-5
 review enforced them by human advisor (two cost-model/lowering pricing
 divergences shipped, 377/408 corpus rules silently inert with no tool to
 say why). This subsystem turns those recurring review findings into a CI
-gate. Seven passes ship (registered like op lowerings, so future PRs add
+gate. Eight passes ship (registered like op lowerings, so future PRs add
 passes, not frameworks):
 
   consistency — strategy/sharding algebra per node: degrees divide dims,
@@ -40,6 +40,16 @@ passes, not frameworks):
       the prefill→decode handoff, tier spill/fetch, and drain-and-swap
       protocols with DPOR-style sleep-set pruning and minimal replayable
       counterexample traces. poolcheck's lock lint delegates here.
+  numcheck    — the low-precision gate: an AST dtype-flow arm tracking
+      array dtype provenance through the serving hot paths
+      (dtype-silent-promotion, scale-unpaired-access,
+      dtype-accum-unspecified, with dtype-ok pragmas), an HLO numerics
+      arm diffing each lowered entry's convert/dot-accumulation dtypes
+      against the Executor's declared dtype plan (hlo-unexpected-f64,
+      hlo-accum-downgrade, hlo-unplanned-convert; pairs with
+      hloaudit's lowering driver), and a tolerance-budget arm
+      validating the declarative numerics band catalog
+      (num_budgets.py) that the tests and the kv_quant_canary consume.
   shapecheck  — the launch-shape-space auditor: a taint arm classifying
       every symbolic width feeding a jit launch as clamped/unbounded, an
       enumeration arm computing the closed per-config catalog of
@@ -134,6 +144,13 @@ class AnalysisContext:
     # interleaving-exploration summary (explored/distinct states per
     # model), filled by the pass
     racecheck_summary: Optional[Dict] = None
+    # numcheck controls: the per-entry dtype plan for the HLO numerics
+    # arm (Executor.dtype_plan(); arm skips when absent) and the
+    # tolerated out-of-plan float-convert count per dtype pair
+    numcheck_dtype_plan: Optional[Dict] = None
+    numcheck_convert_band: Optional[int] = None
+    # AST-arm scan inventory / per-subject HLO numerics, filled by the pass
+    numcheck_summary: Optional[Dict] = None
 
 
 @dataclasses.dataclass
@@ -209,6 +226,7 @@ def _ensure_registered() -> None:
         consistency,
         hloaudit,
         hostsync,
+        numcheck,
         poolcheck,
         racecheck,
         rulesat,

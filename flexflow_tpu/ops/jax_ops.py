@@ -952,9 +952,11 @@ def _pipeline(attrs, inputs, params, ctx):
             h, kc, vc = _decoder_block(p, carry, attrs, cache=(ck, cv, pos))
             return h, (kc, vc)
 
-        h, (kcs, vcs) = lax.scan(
-            body, x, (params, ctx.kv_cache["k"], ctx.kv_cache["v"])
-        )
+        # the layered decode cache shares the "k"/"v" key convention with
+        # the paged pool but is never quantized — no scale sidecar exists
+        ck_all = ctx.kv_cache["k"]  # fflint: dtype-ok (fp layered cache)
+        cv_all = ctx.kv_cache["v"]  # fflint: dtype-ok (fp layered cache)
+        h, (kcs, vcs) = lax.scan(body, x, (params, ck_all, cv_all))
         ctx.cache_updates["k"] = kcs
         ctx.cache_updates["v"] = vcs
         return [h]

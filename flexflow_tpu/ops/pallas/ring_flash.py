@@ -158,7 +158,9 @@ def _ring_bwd(axis_name, n_shards, causal, scale, blk, interpret, rep, res,
     dk_acc = jnp.zeros(k.shape, jnp.float32)
     dv_acc = jnp.zeros(v.shape, jnp.float32)
     k_blk, v_blk = k, v
-    for i in range(n_shards):
+    # per-step grads arrive in op dtype; upcasting feeds the f32 ring
+    # accumulators below — loop-variant, cannot hoist
+    for i in range(n_shards):  # fflint: dtype-ok (f32 grad accumulate)
         src = (my - i) % n_shards
         if causal:
             dq_c, dk_c, dv_c = lax.switch(

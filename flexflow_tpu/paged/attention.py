@@ -192,6 +192,15 @@ def ragged_gather_attention(q, kc_pages, vc_pages, page_tables, pos,
     mask = ragged_visibility_mask(page_tables, pos, q_lens, anc_mask, P)
     from flexflow_tpu.ops.jax_ops import _dot_product_attention
 
+    if k_scales is not None:
+        # match the Pallas kernel's quantized discipline: compute the
+        # whole attention in f32 (dequantized pages stay f32, q is
+        # upcast) and cast only the output back — downcasting the
+        # dequantized gather to a bf16 q dtype would re-quantize it
+        out = _dot_product_attention(q.astype(jnp.float32), kg, vg,
+                                     causal=False, scale=scale,
+                                     mask=mask)
+        return out.astype(dt)
     return _dot_product_attention(q, kg.astype(dt), vg.astype(dt),
                                   causal=False, scale=scale, mask=mask)
 

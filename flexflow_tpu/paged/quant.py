@@ -45,6 +45,11 @@ from typing import Optional, Tuple
 
 QMAX = 127.0  # symmetric int8 grid: round(x / scale) in [-127, 127]
 
+# Floor for the rescale ratio's divisor: far below any real scale but
+# large enough that old_scale / SCALE_EPS stays finite in f32. Shared
+# with the executor's scale-aware commit copy so both grids agree.
+SCALE_EPS = 1e-30
+
 # Canonical kv_dtype knob values -> (jnp dtype name, itemsize bytes,
 # quantized?). "auto" (the default everywhere) means "the model's own
 # dtype, no scale sidecar" and is deliberately absent here — callers
@@ -122,17 +127,22 @@ def quantized_append(pool, scales, x, page, off, live):
 
     f32 = jnp.float32
     xf = x.astype(f32)
+    # typed scalar constants: a bare Python float in jnp.where/maximum
+    # weak-type-promotes the whole scale pipeline (numcheck's
+    # dtype-silent-promotion territory); pin them at f32
+    zero = f32(0.0)
     amax = jnp.max(jnp.abs(xf), axis=-1)                     # (B, S, Hkv)
-    need = jnp.where(live[..., None], amax / QMAX, 0.0)
+    need = jnp.where(live[..., None], amax / f32(QMAX), zero)
     new_scales = scales.at[page].max(need)
     old_t = scales[page]                                     # (B, S, Hkv)
     new_t = new_scales[page]
-    ratio = jnp.where(new_t > 0, old_t / jnp.maximum(new_t, 1e-30), 0.0)
+    ratio = jnp.where(new_t > 0, old_t / jnp.maximum(new_t, f32(SCALE_EPS)),
+                      zero)
     blk = pool[page].astype(f32)                    # (B, S, P, Hkv, D)
     blk = blk * ratio[:, :, None, :, None]
     pool = pool.at[page].set(
         jnp.clip(jnp.round(blk), -QMAX, QMAX).astype(pool.dtype))
-    s_rows = jnp.where(new_t > 0, new_t, 1.0)[..., None]     # (B, S, Hkv, 1)
+    s_rows = jnp.where(new_t > 0, new_t, f32(1.0))[..., None]  # (B,S,Hkv,1)
     qx = jnp.clip(jnp.round(xf / s_rows), -QMAX, QMAX).astype(pool.dtype)
     pool = pool.at[page, off].set(qx)
     return pool, new_scales

@@ -282,6 +282,28 @@ class PagedGenerationServer(_GenerationServerBase):
         self._g_kv_dtype.set(kbuf.dtype.itemsize * 8)
         self._g_qerr = self.registry.gauge("kv_quant_error")
         self._g_qerr.set(0.0)
+        # the canary is a WATCHDOG, not just a gauge: its alert
+        # threshold is the "kv-canary-shadow-delta" band from the
+        # numerics budget catalog (analysis/num_budgets.py — numcheck's
+        # budget arm errors if the band is edited out from under us);
+        # the running max crossing it counts a breach and logs once
+        from flexflow_tpu.analysis.num_budgets import tolerance
+
+        self.kv_quant_threshold = float(
+            tolerance("kv-canary-shadow-delta"))
+        self._quant_breached = False
+        self._c_qbreach = self.registry.counter(
+            "kv_quant_canary_breaches_total")
+        # the DECLARED numerics plan this server serves (the paged
+        # entries, at the pool's kv_dtype) — the same plan numcheck's
+        # HLO arm audits against the lowered modules. The /v2 model
+        # block + ff_dtype_plan_ok gauge report whether the live pool
+        # still matches it, closing the audited-vs-served loop.
+        self._dtype_plan = ex.dtype_plan(
+            entries=["paged_decode", "verify"],
+            kv_dtype=None if self.kv_dtype == "auto" else self.kv_dtype)
+        self._g_plan_ok = self.registry.gauge("dtype_plan_ok")
+        self._g_plan_ok.set(1.0 if self._dtype_plan_ok() else 0.0)
 
         @jax.jit
         def copy_page(caches, src, dst):
@@ -424,7 +446,10 @@ class PagedGenerationServer(_GenerationServerBase):
                 "windows": int(self._c_canary.value),
                 "window_open": (self._canary_req is not None
                                 or self._kv_quant_debug),
+                "threshold": self.kv_quant_threshold,
+                "breaches": int(self._c_qbreach.value),
             },
+            "model": self._model_block(),
             "launch_rows": int(self._c_rows.value),
             "padded_rows": int(self._c_pad.value),
             "padding_waste_ratio": (
@@ -474,6 +499,28 @@ class PagedGenerationServer(_GenerationServerBase):
         pool) — what the kv_cache_dtype gauge reports in bits."""
         return str(next(iter(self._caches.values()))["k"].dtype)
 
+    def _dtype_plan_ok(self) -> bool:
+        """True while the live pool's storage dtype matches the declared
+        plan's kv dtype — i.e. the server is serving the numerics it
+        was audited against (numcheck HLO arm / --dtype-plan)."""
+        from flexflow_tpu.runtime.executor import _HLO_DTYPE_NAMES
+
+        pool = _HLO_DTYPE_NAMES.get(self._kv_pool_dtype_name())
+        return pool == self._dtype_plan["paged_decode"]["kv"]
+
+    def _model_block(self) -> dict:
+        """The /v2 metrics "model" block: per-entry compute/accum/kv
+        dtype names of the declared plan + whether the live pool still
+        matches it (also the ff_dtype_plan_ok gauge)."""
+        ok = self._dtype_plan_ok()
+        self._g_plan_ok.set(1.0 if ok else 0.0)
+        return {
+            "dtype_plan": {e: {"compute": p["compute"],
+                               "accum": p["accum"], "kv": p["kv"]}
+                           for e, p in self._dtype_plan.items()},
+            "dtype_plan_ok": ok,
+        }
+
     # -- request log (obs.reqlog) ----------------------------------------
 
     def _prefix_chain(self, req: _GenRequest) -> tuple:
@@ -499,6 +546,19 @@ class PagedGenerationServer(_GenerationServerBase):
         the serving loop never pays a host sync for it."""
         err = float(self._quant_err_dev)
         self._g_qerr.set(err)
+        if err > self.kv_quant_threshold and not self._quant_breached:
+            # the running max only grows, so this fires once per
+            # crossing — a breach is an alert, not a page of log spam
+            self._quant_breached = True
+            self._c_qbreach.inc()
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "kv_quant_error %.3g breached the "
+                "kv-canary-shadow-delta budget %.3g "
+                "(analysis/num_budgets.py): the quantized pool has "
+                "drifted past its declared band vs the fp32 shadow",
+                err, self.kv_quant_threshold)
         return err
 
     def request_defrag(self):

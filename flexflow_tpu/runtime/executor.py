@@ -55,6 +55,15 @@ _WEIGHT_DTYPE_NAMES = {
     "fp8": "float8_e4m3fn", "float8_e4m3fn": "float8_e4m3fn",
 }
 
+# jnp dtype name -> the short HLO dtype name the lowered text prints
+# (dtype_plan() speaks HLO names so numcheck diffs it against modules
+# without a translation layer)
+_HLO_DTYPE_NAMES = {
+    "float32": "f32", "bfloat16": "bf16", "float16": "f16",
+    "float8_e4m3fn": "f8e4m3fn", "float8_e5m2": "f8e5m2",
+    "float64": "f64", "int8": "s8", "int32": "s32", "bool": "pred",
+}
+
 
 def _cast_weight_leaf(arr, weight_dtype: str):
     """Storage cast for one initialized weight leaf
@@ -1060,19 +1069,25 @@ class Executor:
         if self._paged_commit_fn is not None:
             return self._paged_commit_fn
 
+        # grid and eps shared with quantized_append — one definition of
+        # the int8 grid, one floor under scale ratios
+        from flexflow_tpu.paged.quant import QMAX, SCALE_EPS
+
         def _copy_rows_quant(buf, sc, sp, so, dp, do):
             f32 = jnp.float32
+            zero = f32(0.0)
             sc2 = sc.at[dp].max(sc[sp])
             old_d, new_d = sc[dp], sc2[dp]            # (slots, C, Hkv)
             ratio = jnp.where(new_d > 0,
-                              old_d / jnp.maximum(new_d, 1e-30), 0.0)
+                              old_d / jnp.maximum(new_d, f32(SCALE_EPS)),
+                              zero)
             blk = buf[dp].astype(f32) * ratio[:, :, None, :, None]
             buf = buf.at[dp].set(
-                jnp.clip(jnp.round(blk), -127, 127).astype(buf.dtype))
-            den = jnp.where(new_d > 0, new_d, 1.0)[..., None]
+                jnp.clip(jnp.round(blk), -QMAX, QMAX).astype(buf.dtype))
+            den = jnp.where(new_d > 0, new_d, f32(1.0))[..., None]
             row = buf[sp, so].astype(f32) * sc2[sp][..., None] / den
             buf = buf.at[dp, do].set(
-                jnp.clip(jnp.round(row), -127, 127).astype(buf.dtype))
+                jnp.clip(jnp.round(row), -QMAX, QMAX).astype(buf.dtype))
             return buf, sc2
 
         def commit(caches, page_tables, src, dst):
@@ -1423,6 +1438,72 @@ class Executor:
                 out["verify"] = self.verify_fn().lower(
                     tr, ntr, caches, tables, pos, depths, mask, ids)
         return out
+
+    def dtype_plan(self, entries: Optional[Sequence[str]] = None, *,
+                   kv_dtype: Optional[str] = None) -> Dict[str, Dict]:
+        """The DECLARED per-entry numerics plan, in HLO dtype names —
+        what numcheck's HLO arm diffs each lowered module against
+        (analysis/numcheck.py). Pure metadata from the graph's weight
+        declarations and cache specs; nothing is traced or compiled.
+
+        Per entry: "compute" (the dtype float math runs at — f32, since
+        abstract_params promotes bf16/f16 declarations to f32 master
+        weights and that is what every entry is lowered against),
+        "accum" (contraction accumulation dtype; always f32 — narrower
+        is hlo-accum-downgrade), "kv" (the paged pool payload dtype for
+        the paged entries; s8 carries the scale sidecar), "allowed"
+        (every float/payload dtype the entry may legitimately touch —
+        converts outside this set are hlo-unplanned-convert), and
+        "allow_f64": False everywhere (f64 anywhere is a silent
+        weak-type promotion, hlo-unexpected-f64)."""
+        known = ("train_step", "eval_step", "paged_decode", "verify")
+        if entries is None:
+            entries = ["train_step", "eval_step"]
+            if self.can_paged_decode():
+                entries += ["paged_decode", "verify"]
+        unknown = sorted(set(entries) - set(known))
+        if unknown:
+            raise ValueError(f"unknown entry point(s) {unknown}; "
+                             f"known: {list(known)}")
+        declared = {"f32"}  # master weights / loss math
+        for ws in self.weight_specs().values():
+            for decl in ws.values():
+                dt = jnp.dtype(decl.shape.dtype.jnp_dtype)  # fflint: host-ok (dtype metadata, no device dispatch)
+                if jnp.issubdtype(dt, jnp.floating):  # fflint: host-ok (dtype metadata, no device dispatch)
+                    declared.add(_HLO_DTYPE_NAMES.get(dt.name, dt.name))
+        from flexflow_tpu.paged.quant import kv_dtype_info
+
+        info = kv_dtype_info(kv_dtype)
+        if info is not None:
+            kv_name = _HLO_DTYPE_NAMES.get(info[0], info[0])
+        else:
+            # the cache-spec default: the attention input's own dtype
+            attn = [n for n in self.topo
+                    if n.op_type in (OpType.MULTIHEAD_ATTENTION,
+                                     OpType.RING_ATTENTION)]
+            kv_name = "bf16"
+            if attn:
+                ins = self.graph.input_shapes(attn[0])
+                if ins:
+                    dt = jnp.dtype(ins[0].dtype.jnp_dtype)
+                    kv_name = _HLO_DTYPE_NAMES.get(dt.name, dt.name)
+        plan: Dict[str, Dict] = {}
+        for entry in entries:
+            allowed = set(declared)
+            kv = None
+            if entry in ("paged_decode", "verify"):
+                kv = kv_name
+                allowed.add(kv_name)
+                if kv_name == "s8":
+                    allowed.add("f32")  # dequant target / scale sidecar
+            plan[entry] = {
+                "compute": "f32",
+                "accum": "f32",
+                "kv": kv,
+                "allowed": sorted(allowed),
+                "allow_f64": False,
+            }
+        return plan
 
     # ------------------------------------------------------------------
 

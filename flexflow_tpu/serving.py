@@ -323,11 +323,19 @@ def http_serve(server: Server, port: int = 8000, model_name: str = "model",
                 ready = getattr(server, "_running", True)
                 self._send(200 if ready else 503, {"ready": bool(ready)})
             elif self.path == f"/v2/models/{model_name}":
-                self._send(200, {
+                meta = {
                     "name": model_name,
                     "platform": "flexflow_tpu",
                     "requests_served": server.requests_served,
-                })
+                }
+                # paged servers declare their numerics: the per-entry
+                # compute/accum/kv dtype plan + whether the live pool
+                # matches it (ff_dtype_plan_ok; numcheck's HLO arm
+                # audits the same plan against the lowered programs)
+                if generation_server is not None and hasattr(
+                        generation_server, "_model_block"):
+                    meta["model"] = generation_server._model_block()
+                self._send(200, meta)
             elif self.path == f"/v2/models/{model_name}/metrics":
                 payload = {
                     "server": {"requests_served": server.requests_served},

@@ -1,7 +1,7 @@
 """fflint static-analysis subsystem (flexflow_tpu.analysis): pass
-registry, the seven passes (consistency / rulesat / hostsync /
-hloaudit / poolcheck / shapecheck / racecheck), the seeded-defect
-regression fixtures from
+registry, the eight passes (consistency / rulesat / hostsync /
+hloaudit / poolcheck / shapecheck / racecheck / numcheck), the
+seeded-defect regression fixtures from
 ISSUE 3 (a misdeclared cost-model comm-spec reintroducing the ulysses
 h_deg bug shape, an unsatisfiable corpus rule, a host-sync in a decode
 loop), ISSUE 4 (a zeroed priced comm event the lowered-HLO diff must
@@ -20,8 +20,11 @@ tier-vs-scheduler lock acquisition order, a prefill->decode handoff
 that submits the same request twice — which racecheck's lint arm and
 bounded interleaving model checker must each catch with a named
 finding, the dynamic ones with minimal replayable interleaving
-traces), strategy-file import validation, and the CLI strict gate
-tier-1 rides on."""
+traces) and ISSUE 19 (numcheck's seeded numerics defects — a dropped
+scale-sidecar read, a forced f64 promotion with its derivation chain,
+an HLO module whose dots accumulate narrower than the declared dtype
+plan — plus the budget-catalog arm), strategy-file import validation,
+and the CLI strict gate tier-1 rides on."""
 
 import json
 import os
@@ -1080,7 +1083,7 @@ def test_poolcheck_registered_and_in_default_gate():
     # the CLI default gate includes poolcheck (hloaudit stays opt-in)
     with open(os.path.join(REPO, "tools", "fflint.py")) as f:
         src = f.read()
-    assert '"poolcheck")' in src.split("DEFAULT_PASSES")[1][:200]
+    assert '"poolcheck"' in src.split("DEFAULT_PASSES")[1][:250]
 
 
 def test_poolcheck_model_clean_and_fully_explored_on_real_pool():
@@ -1481,7 +1484,7 @@ def test_shapecheck_registered_and_in_default_gate():
     defaults = src.split("DEFAULT_PASSES")[1][:250]
     assert '"shapecheck"' in defaults
     # shapecheck joins the default gate WITHOUT displacing poolcheck
-    assert '"poolcheck")' in defaults
+    assert '"poolcheck"' in defaults
     # --since selection knows shapecheck's source roots
     assert '"shapecheck":' in src.split("PASS_ROOTS")[1]
 
@@ -1709,8 +1712,8 @@ def test_racecheck_registered_and_in_default_gate():
     # delegates its lock lint to racecheck's inferred model)
     with open(os.path.join(REPO, "tools", "fflint.py")) as f:
         src = f.read()
-    head = src.split("DEFAULT_PASSES")[1][:200]
-    assert '"racecheck"' in head and '"poolcheck")' in head
+    head = src.split("DEFAULT_PASSES")[1][:250]
+    assert '"racecheck"' in head and '"poolcheck"' in head
 
 
 def test_racecheck_lint_flags_dropped_lock_tier_mutation(tmp_path):
@@ -1891,3 +1894,319 @@ def test_fflint_since_selects_racecheck_and_demotes_to_lint_arm():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# numcheck: dtype-flow & scale-discipline static analysis, the
+# low-precision gate (ISSUE 19)
+
+
+def test_numcheck_registered_and_in_default_gate():
+    assert "numcheck" in available_passes()
+    with open(os.path.join(REPO, "tools", "fflint.py")) as f:
+        src = f.read()
+    defaults = src.split("DEFAULT_PASSES")[1][:300]
+    assert '"numcheck"' in defaults
+    # numcheck joins the default gate WITHOUT displacing the others
+    assert '"poolcheck"' in defaults and '"shapecheck"' in defaults
+    # --since selection knows numcheck's source roots
+    assert '"numcheck":' in src.split("PASS_ROOTS")[1]
+
+
+def test_numcheck_flags_dropped_sidecar_read(tmp_path):
+    """Seeded defect 1: a "k" payload read in a function with no trace
+    of the scale sidecar — the compute-site extension of poolcheck's
+    scale invariant. The paired variant (touches "_scale") and the
+    metadata read (["k"].dtype) stay silent."""
+    from flexflow_tpu.analysis import numcheck
+
+    bad = tmp_path / "attention.py"
+    bad.write_text(textwrap.dedent("""\
+        class S:
+            def _gather(self, bufs, tables):
+                kg = bufs["k"][tables]
+                return self._dense(kg)
+
+            def _gather_paired(self, bufs, tables):
+                kg = bufs["k"][tables] * bufs["k_scale"][tables]
+                return self._dense(kg)
+
+            def _dtype_name(self, bufs):
+                return str(bufs["k"].dtype)
+    """))
+    findings = numcheck.scan_file(str(bad), rel="paged/attention.py")
+    errs = [f for f in findings if f.code == "scale-unpaired-access"]
+    assert [(f.severity, f.where) for f in errs] == \
+        [("error", "paged/attention.py:3")], \
+        [(f.code, f.where) for f in findings]
+    assert "k_scale" in errs[0].message and "_gather" in errs[0].message
+
+
+def test_numcheck_flags_f64_promotion_with_chain(tmp_path):
+    """Seeded defect 2: a forced float64 in a decode-path fixture must
+    produce dtype-silent-promotion carrying the derivation chain line
+    by line, and the same scan replays to the same finding."""
+    from flexflow_tpu.analysis import numcheck
+
+    bad = tmp_path / "scheduler.py"
+    bad.write_text(textwrap.dedent("""\
+        class S:
+            def _decode_tick(self, q, k, pos):
+                posf = pos.astype(jnp.float64)
+                scale = posf * 0.125
+                logits = jnp.einsum("bhd,btd->bht", q, k)
+                return logits * scale
+    """))
+    findings = numcheck.scan_file(str(bad), rel="paged/scheduler.py")
+    errs = [f for f in findings if f.code == "dtype-silent-promotion"]
+    assert len(errs) == 1, [(f.code, f.where) for f in findings]
+    err = errs[0]
+    assert err.severity == "error"
+    assert err.where == "paged/scheduler.py:4"
+    # the derivation chain walks creation -> use, by line
+    assert "line 3" in err.message and "float64" in err.message
+    assert "line 4" in err.message
+    # replay: the same scan on the same file reproduces the finding
+    replayed = numcheck.scan_file(str(bad), rel="paged/scheduler.py")
+    assert [(f.code, f.where, f.message) for f in replayed] == \
+        [(f.code, f.where, f.message) for f in findings]
+
+
+def test_numcheck_flags_int8_payload_meeting_float_op(tmp_path):
+    """int8 provenance reaching an einsum with no dequant on the path
+    is the scale-less-garbage error; an explicit astype back to float
+    (the dequant discipline) silences it."""
+    from flexflow_tpu.analysis import numcheck
+
+    bad = tmp_path / "attention.py"
+    bad.write_text(textwrap.dedent("""\
+        class S:
+            def _bad(self, raw, q):
+                qpool = raw.astype(jnp.int8)
+                return jnp.einsum("btd,bsd->bts", q, qpool)
+
+            def _ok(self, raw, q):
+                qpool = raw.astype(jnp.int8)
+                kg = qpool.astype(jnp.float32)
+                return jnp.einsum("btd,bsd->bts", q, kg)
+    """))
+    findings = numcheck.scan_file(str(bad), rel="paged/attention.py")
+    errs = [f for f in findings if f.code == "dtype-silent-promotion"]
+    assert [(f.severity, f.where) for f in errs] == \
+        [("error", "paged/attention.py:4")], \
+        [(f.code, f.where) for f in findings]
+    assert "int8" in errs[0].message and "line 3" in errs[0].message
+
+
+def test_numcheck_accum_unspecified_and_cast_in_loop(tmp_path):
+    """bf16 operands in a matmul without preferred_element_type warn
+    (the accumulation dtype is XLA's choice); passing it silences the
+    warning; an .astype inside a host loop is the info finding."""
+    from flexflow_tpu.analysis import numcheck
+
+    src = tmp_path / "mlp.py"
+    src.write_text(textwrap.dedent("""\
+        class S:
+            def _mlp(self, x, w):
+                xb = x.astype(jnp.bfloat16)
+                return jnp.matmul(xb, w)
+
+            def _mlp_pinned(self, x, w):
+                xb = x.astype(jnp.bfloat16)
+                return jnp.matmul(xb, w,
+                                  preferred_element_type=jnp.float32)
+
+            def _host(self, items, w):
+                outs = []
+                for it in items:
+                    outs.append(it.astype(jnp.float32))
+                return outs
+    """))
+    findings = numcheck.scan_file(str(src), rel="ops/mlp.py")
+    codes = [(f.code, f.where) for f in findings]
+    assert ("dtype-accum-unspecified", "ops/mlp.py:4") in codes, codes
+    warn = [f for f in findings if f.code == "dtype-accum-unspecified"]
+    assert len(warn) == 1 and warn[0].severity == "warning"
+    assert "preferred_element_type" in warn[0].message
+    info = [f for f in findings if f.code == "dtype-cast-in-loop"]
+    assert [(f.severity, f.where) for f in info] == \
+        [("info", "ops/mlp.py:14")], codes
+
+
+def test_numcheck_pragma_suppresses_and_stale_flagged(tmp_path):
+    from flexflow_tpu.analysis import numcheck
+
+    src = tmp_path / "attention.py"
+    src.write_text(textwrap.dedent("""\
+        class S:
+            def _gather(self, bufs, tables):
+                kg = bufs["k"][tables]  # fflint: dtype-ok (fp pool)
+                return self._dense(kg)
+
+            def _quiet(self, x):  # fflint: dtype-ok (stale)
+                return x + 1
+    """))
+    findings = numcheck.scan_file(str(src), rel="paged/attention.py")
+    codes = [(f.code, f.where) for f in findings]
+    assert ("scale-unpaired-access", "paged/attention.py:3") not in codes
+    assert codes == [("stale-pragma", "paged/attention.py:6")], findings
+
+
+def test_numcheck_repo_hot_paths_clean_and_sites_seen():
+    """The shipped hot paths scan clean, and the site inventory proves
+    the scan actually engaged them — payload reads in the layered
+    decode cache and pool-introspection paths, accumulation ops in the
+    kernels (a clean scan of zero sites would prove nothing)."""
+    from flexflow_tpu.analysis import numcheck
+
+    paths = numcheck.default_src_paths()
+    findings = numcheck.scan_paths(paths)
+    assert findings == [], [(f.code, f.where) for f in findings]
+    base = os.path.join(REPO, "flexflow_tpu")
+    att = numcheck.dtype_flow_sites(
+        os.path.join(base, "paged", "attention.py"))
+    kinds = {s["kind"] for s in att}
+    assert "accum-op" in kinds, att
+    ops = numcheck.dtype_flow_sites(
+        os.path.join(base, "ops", "jax_ops.py"))
+    scopes = {s["scope"] for s in ops if s["kind"] == "payload-read"}
+    assert "_pipeline" in scopes, scopes
+    sched = numcheck.dtype_flow_sites(
+        os.path.join(base, "paged", "scheduler.py"))
+    scopes = {s["scope"] for s in sched if s["kind"] == "payload-read"}
+    assert "__init__" in scopes, scopes
+
+
+def test_numcheck_hlo_arm_flags_downgrade_f64_and_unplanned_convert():
+    """Seeded defect 3 (the HLO side): against a plan declaring f32
+    accumulation and a {f32, bf16} dtype set, a module whose dots
+    accumulate bf16 is hlo-accum-downgrade, an f64 instruction is
+    hlo-unexpected-f64, and an f16 convert is hlo-unplanned-convert —
+    each carrying the observed-vs-plan witness."""
+    from flexflow_tpu.analysis import numcheck
+
+    hlo = textwrap.dedent("""\
+        HloModule jit_step
+        fused {
+          %p = bf16[8,16]{1,0} parameter(0)
+          %q = bf16[16,4]{1,0} parameter(1)
+          %d = bf16[8,4]{1,0} dot(%p, %q), lhs_contracting_dims={1}
+          %w = f64[8,4]{1,0} convert(bf16[8,4]{1,0} %d)
+          %h = f16[8,4]{1,0} convert(bf16[16,4]{1,0} %q)
+        }
+    """)
+    num = numcheck.extract_numerics(hlo)
+    assert num["dots"] == {"bf16": 1}
+    assert num["f64_lines"] >= 1
+    plan = {"compute": "f32", "accum": "f32", "kv": None,
+            "allowed": ["bf16", "f32"], "allow_f64": False}
+    findings = numcheck.diff_dtype_plan("subj", "train_step", plan, num)
+    codes = {f.code: f for f in findings}
+    assert set(codes) == {"hlo-accum-downgrade", "hlo-unexpected-f64",
+                          "hlo-unplanned-convert"}, codes
+    down = codes["hlo-accum-downgrade"]
+    assert down.severity == "error" and down.where == "subj:train_step"
+    assert "bf16" in down.message and "f32" in down.message
+    assert codes["hlo-unexpected-f64"].severity == "error"
+    assert codes["hlo-unplanned-convert"].severity == "warning"
+    # the same module against a plan that DECLARES what it does is clean
+    ok_plan = {"compute": "bf16", "accum": "bf16", "kv": None,
+               "allowed": ["bf16", "f32", "f16"], "allow_f64": True}
+    assert numcheck.diff_dtype_plan("subj", "train_step", ok_plan,
+                                    num) == []
+
+
+def test_numcheck_executor_dtype_plan_and_real_lowering():
+    """Executor.dtype_plan() declares f32 compute/accum (master
+    weights), the pool payload dtype per paged entry (s8 + f32 dequant
+    targets for int8), and never f64 — and the llama baseline's REAL
+    lowered paged_decode diffs clean against it while a zeroed
+    (all-bf16) plan mutation makes the same module fail with
+    hlo-accum-downgrade."""
+    pytest.importorskip("jax")
+    from flexflow_tpu.analysis import numcheck
+    from flexflow_tpu.analysis.baselines import build_baseline_executor
+    from flexflow_tpu.analysis.hloaudit import lower_executor_modules
+
+    executor, _, _, _ = build_baseline_executor("llama_tp_dp")
+    plan = executor.dtype_plan(kv_dtype="int8")
+    pd = plan["paged_decode"]
+    assert pd["compute"] == "f32" and pd["accum"] == "f32"
+    assert pd["kv"] == "s8" and not pd["allow_f64"]
+    assert {"s8", "f32"} <= set(pd["allowed"])
+    assert plan["train_step"]["kv"] is None
+
+    mods = lower_executor_modules(executor, entries=["paged_decode"],
+                                  subject="llama_tp_dp")
+    mod = mods["paged_decode"]
+    assert "hlo_text" in mod, mod
+    num = numcheck.extract_numerics(mod["hlo_text"])
+    assert num["dots"], "no dots parsed from the lowered module"
+    clean = numcheck.diff_dtype_plan(
+        "llama_tp_dp", "paged_decode",
+        executor.dtype_plan()["paged_decode"], num)
+    assert clean == [], [(f.code, f.message[:90]) for f in clean]
+    # mutation: a plan zeroed down to bf16 accumulation must reject the
+    # very same f32-accumulating module the honest plan accepts...
+    # nothing accumulates NARROWER than bf16 here, so flip the check:
+    # claim f64 accumulation and the observed f32 dots are a downgrade
+    wide = {"compute": "f64", "accum": "f64", "kv": None,
+            "allowed": ["f64"], "allow_f64": True}
+    flagged = numcheck.diff_dtype_plan("llama_tp_dp", "paged_decode",
+                                       wide, num)
+    assert any(f.code == "hlo-accum-downgrade" for f in flagged), \
+        [(f.code, f.where) for f in flagged]
+
+
+def test_numcheck_budget_arm_validates_catalog(monkeypatch):
+    """The shipped catalog is healthy; a non-finite band and a deleted
+    required band each become named budget findings."""
+    from flexflow_tpu.analysis import num_budgets, numcheck
+
+    assert num_budgets.validate_catalog() == {}
+    assert numcheck.budget_findings() == []
+
+    broken = dict(num_budgets.BUDGETS)
+    broken["int8-kv-mixed-batch"] = num_budgets.Budget(
+        float("nan"), "abs", ("tests",), "broken band")
+    del broken["kv-canary-shadow-delta"]
+    monkeypatch.setattr(num_budgets, "BUDGETS", broken)
+    findings = numcheck.budget_findings()
+    codes = {(f.code, f.where) for f in findings}
+    assert ("budget-invalid",
+            "analysis/num_budgets.py:int8-kv-mixed-batch") in codes
+    assert ("budget-missing",
+            "analysis/num_budgets.py:kv-canary-shadow-delta") in codes
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_numcheck_pass_summary_and_since_selection(tmp_path):
+    """The registered pass fills the scan inventory (files seen, site
+    counts, budget count), and --since maps hot-path diffs onto
+    numcheck."""
+    ctx = AnalysisContext(subject="numerics")
+    report = run_passes(["numcheck"], ctx, Report())
+    assert report.findings == [], \
+        [(f.code, f.where) for f in report.findings]
+    s = ctx.numcheck_summary
+    assert s["files_scanned"] > 10
+    assert s["sites"]["accum-op"] > 0
+    assert s["sites"]["payload-read"] > 0
+    assert s["budgets"] >= 8
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import importlib.util as u
+
+        spec = u.spec_from_file_location(
+            "ff_lint_nc", os.path.join(REPO, "tools", "fflint.py"))
+        m = u.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        cand = list(m.DEFAULT_PASSES)
+        for path in ("flexflow_tpu/paged/quant.py",
+                     "flexflow_tpu/ops/jax_ops.py",
+                     "flexflow_tpu/runtime/executor.py"):
+            assert "numcheck" in m.passes_for_changes([path], cand), path
+        assert m.passes_for_changes(["docs/paged.md"], cand) == []
+    finally:
+        sys.path.remove(os.path.join(REPO, "tools"))

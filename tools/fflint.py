@@ -28,7 +28,16 @@ Default run (what tier-1 gates on through tests/test_analysis.py):
     cross-thread protocols (prefill→decode handoff, tier spill/fetch,
     drain-and-swap) — interleaving counterexamples become error
     findings with minimal replayable schedules (also JSON artifacts
-    under --trace-dir).
+    under --trace-dir);
+  - numcheck: the low-precision gate's fast arms — the AST dtype-flow
+    lint over the serving hot paths (paged/, spec/, runtime/executor,
+    ops/, disagg/: dtype-silent-promotion with the derivation chain,
+    scale-unpaired-access, dtype-accum-unspecified, dtype-cast-in-loop,
+    with `# fflint: dtype-ok` pragmas) and the tolerance-budget arm
+    validating analysis/num_budgets.py. Its HLO numerics arm (diff each
+    lowered entry's convert/dot dtypes against Executor.dtype_plan())
+    rides the hloaudit driver: `--passes numcheck,hloaudit`, with
+    --dtype-plan FILE writing the plan-vs-observed diff artifact.
 
 The hloaudit pass — AOT-compile every BASELINE config's real entry
 points (train/eval/paged-decode/verify) and diff the optimized HLO's
@@ -53,6 +62,7 @@ Usage:
                          [--rules FILE] [--no-baseline-reach]
                          [--write-coverage] [--out FILE] [--sarif FILE]
                          [--hlo-dump DIR] [--trace-dir DIR]
+                         [--dtype-plan FILE]
 
   --strategy FILE --config NAME   validate an exported/imported strategy
                                   file against the named BASELINE config's
@@ -64,6 +74,9 @@ Usage:
                                   (CI uploads this artifact)
   --hlo-dump DIR                  (hloaudit) write each entry point's
                                   optimized HLO to DIR for offline diffs
+  --dtype-plan FILE               (numcheck + hloaudit) write the
+                                  per-subject dtype plan-vs-observed
+                                  numerics diff as a JSON artifact
 """
 
 import argparse
@@ -116,9 +129,14 @@ def _consistency(report, names, strategy_file=None):
     return graphs
 
 
-def _hloaudit(report, names, hlo_dump=None):
+def _hloaudit(report, names, hlo_dump=None, numcheck=False,
+              dtype_plan_out=None):
     """Lower + XLA-compile each BASELINE config's entry points on the
-    local CPU mesh and diff them against the priced-events manifest."""
+    local CPU mesh and diff them against the priced-events manifest.
+    With `numcheck`, numcheck's HLO numerics arm rides the same
+    lowerings: each subject's modules are diffed against its Executor's
+    declared dtype plan, and the plan-vs-observed diff is written to
+    `dtype_plan_out` as a JSON artifact when given."""
     from flexflow_tpu.analysis import AnalysisContext, run_passes
     from flexflow_tpu.analysis.baselines import (
         build_baseline_executor,
@@ -129,6 +147,7 @@ def _hloaudit(report, names, hlo_dump=None):
     from flexflow_tpu.search.machine_model import TPUMachineModel
 
     programs = {}
+    dtype_plans = {}
     for name in (names or known_subject_names()):
         executor, graph, strategy, axis_sizes = \
             build_baseline_executor(name)
@@ -141,10 +160,24 @@ def _hloaudit(report, names, hlo_dump=None):
         ctx = AnalysisContext(graph=graph, strategy=strategy,
                               axis_sizes=axis_sizes, cost_model=cm,
                               subject=name, hlo_modules=mods)
-        run_passes(["hloaudit"], ctx, report)
+        if numcheck:
+            ctx.numcheck_dtype_plan = executor.dtype_plan()
+        run_passes(["hloaudit"] + (["numcheck"] if numcheck else []),
+                   ctx, report)
         if ctx.hlo_summary:
             programs.update(ctx.hlo_summary)
+        if ctx.numcheck_summary:
+            dtype_plans.update(ctx.numcheck_summary)
     report.stats.setdefault("hloaudit", {})["programs"] = programs
+    if numcheck:
+        report.stats.setdefault("numcheck", {})["dtype_plans"] = \
+            dtype_plans
+        if dtype_plan_out:
+            with open(dtype_plan_out, "w") as f:
+                json.dump(dtype_plans, f, indent=1, sort_keys=True)
+            print(f"wrote dtype plan-vs-observed diff for "
+                  f"{len(dtype_plans)} subject(s) to {dtype_plan_out}",
+                  file=sys.stderr)
 
 
 def _rulesat(report, rules_path, baseline_graphs):
@@ -186,7 +219,7 @@ def write_coverage_classification(classification):
 # hloaudit XLA-compiles every config (minutes) — selected explicitly,
 # never part of the default invocation tier-1 rides on
 DEFAULT_PASSES = ("consistency", "rulesat", "hostsync", "shapecheck",
-                  "racecheck", "poolcheck")
+                  "racecheck", "poolcheck", "numcheck")
 
 # source roots per pass, for --since REV changed-files selection: a pass
 # runs only when the diff touches one of its roots (repo-relative file
@@ -219,6 +252,12 @@ PASS_ROOTS = {
                    "flexflow_tpu/obs", "flexflow_tpu/analysis",
                    "flexflow_tpu/serving_autopilot.py",
                    "tools/fflint.py"),
+    # AST dtype-flow + budget arms only here (fast); the HLO numerics
+    # arm rides hloaudit's opt-in lowering driver
+    "numcheck": ("flexflow_tpu/paged", "flexflow_tpu/spec",
+                 "flexflow_tpu/runtime", "flexflow_tpu/ops",
+                 "flexflow_tpu/disagg", "flexflow_tpu/analysis",
+                 "tools/fflint.py"),
 }
 
 
@@ -284,6 +323,11 @@ def main(argv=None):
     ap.add_argument("--hlo-dump", default=None, dest="hlo_dump",
                     help="(hloaudit) dump each optimized HLO module to "
                          "this directory")
+    ap.add_argument("--dtype-plan", default=None, dest="dtype_plan",
+                    help="(numcheck, with hloaudit selected) write the "
+                         "per-subject dtype plan-vs-observed HLO "
+                         "numerics diff to this JSON file (CI uploads "
+                         "it as an artifact)")
     ap.add_argument("--since", default=None, metavar="REV",
                     help="changed-files mode: run only the passes whose "
                          "source roots intersect `git diff REV`; "
@@ -403,8 +447,18 @@ def main(argv=None):
                       f"{len(ctx.shapecheck_summary['catalogs'])} "
                       f"config(s) to {args.shape_catalog}",
                       file=sys.stderr)
+    if "numcheck" in passes:
+        from flexflow_tpu.analysis import AnalysisContext, run_passes
+
+        ctx = AnalysisContext(subject="numerics")
+        run_passes(["numcheck"], ctx, report)
+        if ctx.numcheck_summary:
+            report.stats.setdefault("numcheck", {}).update(
+                ctx.numcheck_summary)
     if "hloaudit" in passes:
-        _hloaudit(report, names, hlo_dump=args.hlo_dump)
+        _hloaudit(report, names, hlo_dump=args.hlo_dump,
+                  numcheck="numcheck" in passes,
+                  dtype_plan_out=args.dtype_plan)
 
     if args.write_coverage and classification:
         counts = write_coverage_classification(classification)
