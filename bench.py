@@ -559,6 +559,73 @@ def bench_decode() -> dict:
         f"{n_req} short prompts (4..8 tokens), {max_new} new tokens "
         f"each, page_size={page}")
 
+    # universal-megastep A/B (ISSUE 20): the SAME mixed prefill-heavy/
+    # decode-heavy fixture (the ragged A/B's mixed-length sample: half
+    # short, half chunk-spanning prompts) served three ways — the
+    # one-tick host loop, the decode-only fused megastep (prefill
+    # chunks force one-tick dispatches while in flight), and the
+    # universal megastep with overlapped host dispatch (chunks and
+    # drafted chains ride the fused while_loop; admission runs while
+    # the device computes). Reported per arm: decode tokens/sec, host
+    # roundtrips per decoded token, and TTFT p95. The acceptance bar is
+    # universal strictly dominating decode-only on BOTH rt/token and
+    # tokens/sec on this mixed traffic.
+    _log("decode bench: universal megastep A/B "
+         "(legacy vs decode-fused vs universal+overlap)")
+    fused_ab = {}
+    fused_outs = {}
+    arms = (("legacy", dict(megastep_ticks=1)),
+            ("decode_fused", dict(megastep_ticks=8)),
+            ("universal", dict(megastep_ticks=8, megastep_mixed=True,
+                               overlap_dispatch=True)))
+    for label, kwargs in arms:
+        server = ff.serve_generation(slots=4, max_len=max_len, paged=True,
+                                     page_size=page, prefill_chunk=chunk,
+                                     **kwargs)
+        try:
+            # catalog-driven warmup: every launch family this arm can
+            # dispatch compiles off the clock
+            server.warm_launch_shapes()
+            m0 = server.metrics()
+            t0 = time.perf_counter()
+            futs = [server.submit(p, max_new_tokens=max_new)
+                    for p in mixed]
+            outs = [f.result(timeout=1200) for f in futs]
+            dt = time.perf_counter() - t0
+            m = server.metrics()
+        finally:
+            server.stop()
+        fused_outs[label] = outs
+        rt = m["megastep"]["host_roundtrips"] \
+            - m0["megastep"]["host_roundtrips"]
+        dtok = m["megastep"]["decode_tokens"] \
+            - m0["megastep"]["decode_tokens"]
+        ttfts = [r["ttft_s"] for r in m["requests"]
+                 if r["ttft_s"] is not None]
+        fused_ab[label] = {
+            "decode_tokens_per_sec": round(
+                sum(len(o) for o in outs) / dt, 2),
+            "host_roundtrips_per_token": round(rt / dtok, 4) if dtok
+            else 0.0,
+            "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 6),
+            "host_overlap_ratio": round(
+                float(m["megastep"]["host_overlap_ratio"]), 4),
+            "megastep_breaks": dict(m["megastep"]["breaks"]),
+        }
+    fused_ab["greedy_streams_matched"] = sum(
+        int(np.array_equal(a, b) and np.array_equal(a, c))
+        for a, b, c in zip(fused_outs["legacy"],
+                           fused_outs["decode_fused"],
+                           fused_outs["universal"]))
+    fused_ab["universal_dominates_decode_fused"] = bool(
+        fused_ab["universal"]["host_roundtrips_per_token"]
+        < fused_ab["decode_fused"]["host_roundtrips_per_token"]
+        and fused_ab["universal"]["decode_tokens_per_sec"]
+        > fused_ab["decode_fused"]["decode_tokens_per_sec"])
+    fused_ab["fixture"] = (
+        f"{len(mixed)} mixed-length requests (half short, half "
+        f"{chunk}+ tokens), prefill_chunk={chunk}, page_size={page}")
+
     # searched-vs-default A/B (ISSUE 12): run the serving-strategy
     # search at a small budget on the smoke profile, then serve BOTH the
     # hand default and the searched winner on the plain fixture —
@@ -771,6 +838,7 @@ def bench_decode() -> dict:
         "prefix_cache": prefix_metrics,
         "ragged_packing": ragged_ab,
         "megastep": mega_ab,
+        "fused_megastep": fused_ab,
         "servesearch": searched_ab,
         "quantized_kv": quant_ab,
         "profiles": production,

@@ -418,6 +418,7 @@ def enumerate_catalog(*, slots: int, max_len: int, paged: bool = True,
                       page_size: int = 64,
                       prefill_chunk: int = 64, ragged_pack: bool = True,
                       megastep_ticks: int = 1,
+                      megastep_mixed: bool = False,
                       spec_max_nodes: Optional[int] = None,
                       spec_depth: Optional[int] = None,
                       num_pages: Optional[int] = None,
@@ -455,8 +456,19 @@ def enumerate_catalog(*, slots: int, max_len: int, paged: bool = True,
             else:
                 ragged |= {(slots, T)}
         entry("ragged_step", ragged)
-        if megastep_ticks > 1:
+        if megastep_ticks > 1 and not megastep_mixed:
             entry("megastep", [(slots, int(megastep_ticks))])
+        if megastep_mixed:
+            # the universal megastep compiles ONE program per config:
+            # its launch window is the derived max over the prefill
+            # window and the on-device drafted chain (depth+1); it
+            # replaces the pure-decode megastep even at ticks == 1
+            # (the fusion of mixed rows is the point, not the tick
+            # count)
+            wl = max(min(int(window_rows), int(prefill_chunk)),
+                     (int(spec_depth) if spec_depth else 0) + 1)
+            entry("megastep_mixed",
+                  [(slots, int(megastep_ticks), wl)])
         if spec_max_nodes:
             depth = int(spec_depth) if spec_depth else 1
             entry("paged_commit", [(slots, depth + 1)])
@@ -481,6 +493,7 @@ def enumerate_catalog(*, slots: int, max_len: int, paged: bool = True,
             "prefill_chunk": int(prefill_chunk) if paged else None,
             "ragged_pack": bool(ragged_pack),
             "megastep_ticks": int(megastep_ticks),
+            "megastep_mixed": bool(megastep_mixed),
             "spec_max_nodes": int(spec_max_nodes) if spec_max_nodes else None,
             "spec_depth": int(spec_depth) if spec_depth else None,
             "num_pages": int(num_pages) if num_pages else None,
@@ -503,6 +516,7 @@ def catalog_for_strategy(strategy, *, slots: int, max_len: int) -> Dict:
         page_size=kw["page_size"], prefill_chunk=kw["prefill_chunk"],
         ragged_pack=kw["ragged_pack"],
         megastep_ticks=kw["megastep_ticks"],
+        megastep_mixed=kw.get("megastep_mixed", False),
         spec_max_nodes=sp.max_nodes if sp else None,
         spec_depth=sp.depth if sp else None,
         num_pages=kw["num_pages"], kv_dtype=kw["kv_dtype"])
@@ -572,6 +586,9 @@ DEFAULT_CONFIGS = {
                        prefill_chunk=32, ragged_pack=True),
     "paged_megastep": dict(slots=4, max_len=128, page_size=16,
                            prefill_chunk=32, megastep_ticks=8),
+    "paged_mixed": dict(slots=4, max_len=128, page_size=16,
+                        prefill_chunk=32, megastep_ticks=8,
+                        megastep_mixed=True),
     "paged_spec": dict(slots=4, max_len=128, page_size=16,
                        prefill_chunk=32, spec_max_nodes=9, spec_depth=4),
     "paged_legacy": dict(slots=4, max_len=128, page_size=16,

@@ -1218,6 +1218,8 @@ class FFModel:
                          preemption: bool = True, prefix_cache: bool = True,
                          prefill_chunk: int = 64, speculate=None,
                          ragged_pack: bool = True, megastep_ticks: int = 1,
+                         megastep_mixed: bool = False,
+                         overlap_dispatch: bool = False,
                          kv_dtype: str = "auto",
                          request_record_limit=None, serve_strategy=None,
                          search_budget=None, traffic="smoke",
@@ -1239,7 +1241,12 @@ class FFModel:
         depth+1 tokens emitted per step. `megastep_ticks=N` (paged, no
         speculate) fuses up to N decode ticks into one jitted dispatch
         with zero host syncs in the inner loop — token output stays
-        identical (docs/paged.md "Decode megasteps").
+        identical (docs/paged.md "Decode megasteps");
+        `megastep_mixed=True` makes the megastep UNIVERSAL — mid-prefill
+        chunks and on-device drafted spec chains fuse into the same
+        dispatch — and `overlap_dispatch=True` runs the next tick's
+        admission work in the shadow of the in-flight dispatch
+        (docs/paged.md "Universal megasteps").
         `search_budget=N` auto-tunes the paged/spec/megastep knobs with
         the serving-strategy search against the `traffic` profile before
         serving; `serve_strategy` applies a previously searched
@@ -1268,7 +1275,9 @@ class FFModel:
                    num_pages=num_pages, preemption=preemption,
                    prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
                    speculate=speculate, ragged_pack=ragged_pack,
-                   megastep_ticks=megastep_ticks, kv_dtype=kv_dtype,
+                   megastep_ticks=megastep_ticks,
+                   megastep_mixed=megastep_mixed,
+                   overlap_dispatch=overlap_dispatch, kv_dtype=kv_dtype,
                    request_record_limit=request_record_limit,
                    serve_strategy=serve_strategy,
                    search_budget=search_budget, traffic=traffic,

@@ -86,6 +86,8 @@ class PagedGenerationServer(_GenerationServerBase):
                  preemption: bool = True, table_slack_tokens: int = 0,
                  prefix_cache: bool = True, prefill_chunk: int = 64,
                  ragged_pack: bool = True, megastep_ticks: int = 1,
+                 megastep_mixed: bool = False,
+                 overlap_dispatch: bool = False,
                  request_record_limit: Optional[int] = None,
                  kv_dtype: str = "auto",
                  reqlog_capacity: Optional[int] = None,
@@ -138,6 +140,19 @@ class PagedGenerationServer(_GenerationServerBase):
         if self.megastep_ticks < 1:
             raise ValueError(
                 f"megastep_ticks must be >= 1, got {megastep_ticks}")
+        # megastep_mixed: the UNIVERSAL megastep — mid-prefill chunk
+        # rows and on-device drafted spec chains fuse into the same
+        # while_loop as decode rows (docs/paged.md "Universal
+        # megasteps"), so a tick with a chunk in flight no longer drops
+        # to host granularity. overlap_dispatch additionally runs the
+        # next tick's admission work while the fused dispatch is in
+        # flight, fencing on the one device_get.
+        self.megastep_mixed = bool(megastep_mixed)
+        self.overlap_dispatch = bool(overlap_dispatch)
+        if self.overlap_dispatch and not self.megastep_mixed:
+            raise ValueError(
+                "overlap_dispatch overlaps host work with the in-flight "
+                "MIXED megastep dispatch; pass megastep_mixed=True")
         # kv_dtype: "auto" pools at the model's dtype; "int8" stores
         # quantized pages with the per-(page, head) scale sidecar inside
         # the same caches dict (paged/quant.py), so copy_page/defrag/
@@ -169,8 +184,32 @@ class PagedGenerationServer(_GenerationServerBase):
                 "FF_TPU_KV_QUANT_DEBUG=1: forcing megastep_ticks=1 so "
                 "the fp32 shadow cache observes every tick")
             self.megastep_ticks = 1
+        if self._kv_quant_debug and self.megastep_mixed:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "FF_TPU_KV_QUANT_DEBUG=1: forcing megastep_mixed=False "
+                "so the fp32 shadow cache observes every launch")
+            self.megastep_mixed = False
+            self.overlap_dispatch = False
         self._megastep = (ex.paged_megastep_fn(self.megastep_ticks, eos_id)
-                          if self.megastep_ticks > 1 else None)
+                          if self.megastep_ticks > 1
+                          and not self.megastep_mixed else None)
+        # the universal megastep's fused launch window: chunk pieces are
+        # capped at the packed-prefill window, drafted chains at
+        # depth + 1 rows (0 on the non-speculative server)
+        spec_cfg = getattr(self, "spec", None)
+        self._spec_depth = int(spec_cfg.depth) if spec_cfg is not None \
+            else 0
+        self._mixed_window = min(self._chunk_rows, self.prefill_chunk)
+        self._mixed_fn = (ex.paged_mixed_megastep_fn(
+            self.megastep_ticks, eos_id, window=self._mixed_window,
+            depth=self._spec_depth) if self.megastep_mixed else None)
+        # device-resident (slots, Lbuf + 1) token ledger for the mixed
+        # megastep (column Lbuf is the masked-scatter trash column);
+        # None = dirty, rebuilt from host truth on next dispatch
+        self._seq_cols = self.max_pages_per_seq * self.page_size
+        self._seq_dev = None
         self._caches = ex.init_paged_kv_cache(num_pages, self.page_size,
                                               dtype=pool_dt)
         self._caches_ref = (ex.init_paged_kv_cache(
@@ -253,7 +292,11 @@ class PagedGenerationServer(_GenerationServerBase):
         self._g_rt_tok = self.registry.gauge("host_roundtrips_per_token")
         self._c_break = {
             r: self.registry.counter(f"megastep_break_{r}_total")
-            for r in ("finish", "page", "limit")}
+            for r in ("finish", "page", "limit", "chunk", "verify")}
+        # overlap-dispatch accounting: host work done in the shadow of
+        # the in-flight fused dispatch over the whole dispatch wait
+        # (host work time / (host work time + fence time))
+        self._g_overlap = self.registry.gauge("host_overlap_ratio")
         # one gate decision, surfaced: which attention path this server's
         # launches take (evaluated host-side at init — the gate only
         # depends on shapes/dtype/backend/env, all fixed for the server's
@@ -394,6 +437,7 @@ class PagedGenerationServer(_GenerationServerBase):
             "prefill_chunk": self.prefill_chunk,
             "ragged_pack": self.ragged_pack,
             "megastep_ticks": self.megastep_ticks,
+            "megastep_mixed": self.megastep_mixed,
             # num_pages is fixed at pool construction; the loop thread
             # never resizes the pool
             "num_pages": self.pool.num_pages,  # fflint: lock-ok (immutable)
@@ -457,6 +501,9 @@ class PagedGenerationServer(_GenerationServerBase):
                 if self._c_rows.value else 0.0),
             "megastep": {
                 "ticks_max": self.megastep_ticks,
+                "mixed": self.megastep_mixed,
+                "overlap_dispatch": self.overlap_dispatch,
+                "host_overlap_ratio": float(self._g_overlap.value),
                 "host_roundtrips": int(self._c_rt.value),
                 "decode_tokens": int(self._c_dtok.value),
                 "host_roundtrips_per_token": (
@@ -709,6 +756,8 @@ class PagedGenerationServer(_GenerationServerBase):
             spec_width=(spec.width if spec is not None else 0),
             spec_depth=(spec.depth if spec is not None else 0),
             megastep_ticks=self.megastep_ticks,
+            megastep_mixed=self.megastep_mixed,
+            overlap_dispatch=self.overlap_dispatch,
             ragged_pack=self.ragged_pack,
             pool_fraction=round(frac, 6),
             kv_dtype=self.kv_dtype,
@@ -1091,6 +1140,33 @@ class PagedGenerationServer(_GenerationServerBase):
 
     def _mark_temps_dirty(self):
         self._temps_dev = None
+        # slot occupancy changed -> the mixed-megastep token ledger no
+        # longer matches host truth; rebuilt on next dispatch. (Page
+        # growth/defrag only move PAGES, never tokens, so the tables
+        # dirty flag does not imply a seq rebuild.)
+        self._seq_dev = None
+
+    def _seq_device(self):
+        """The (slots, Lbuf + 1) committed-token ledger on device for
+        the mixed megastep: row s holds slot s's prompt + generated
+        tokens (the FULL prompt for a mid-prefill slot, so chunk rows
+        gather from it), column Lbuf is the masked-scatter trash
+        column. Between dispatches the megastep's own seq output is
+        reused; any admission/release/eviction rebuilds from host
+        truth."""
+        import jax.numpy as jnp
+
+        if self._seq_dev is None:
+            seq = np.zeros((self.slots, self._seq_cols + 1), np.int32)
+            for s in range(self.slots):
+                req = self._active[s]
+                if req is None:
+                    continue
+                toks = (req.prefill_seq if self._mid_prefill(s)
+                        else req.seq_tokens())
+                seq[s, :len(toks)] = toks
+            self._seq_dev = jnp.asarray(seq)
+        return self._seq_dev
 
     def _tables_device(self):
         """The (slots, max_pages) page-table matrix on device, uploaded
@@ -1416,6 +1492,13 @@ class PagedGenerationServer(_GenerationServerBase):
         import jax
         import jax.numpy as jnp
 
+        if self._caches_ref is not None:
+            # DYNAMIC stand-down, not a construction-time choice: a
+            # kv_quant_canary window can open on any admission mid-serve
+            # and the fp32 shadow must observe every launch — delegate
+            # this dispatch to the one-tick path (which replays against
+            # the shadow) no matter which call site asked for a megastep
+            return self._decode_tick(live, tr, ntr)
         t0 = time.monotonic()
         sp = obs.span("megastep").__enter__()
         if sp:
@@ -1474,11 +1557,234 @@ class PagedGenerationServer(_GenerationServerBase):
         self._h_mega.observe(n)
         self._c_break[reason].inc()
         if sp:
-            sp.set(ticks=n, break_reason=reason)
+            sp.set(ticks=n, break_reason=reason, fused_rows=n * len(live))
         sp.__exit__(None, None, None)
         dt = time.monotonic() - t0
         # per-tick effective latency: the histogram stays comparable
         # across megastep widths (the A/B's p50/p95 read)
+        self._h_tick.observe(dt / max(n, 1))
+        self._h_tokens.observe(len(live))
+        led = obs.ledger()
+        if led is not None:
+            led.record("decode", dt, batch=len(live), width=max(n, 1))
+
+    # -- universal (mixed) megastep ---------------------------------------
+
+    def _mixed_spec_slot(self, req) -> bool:
+        """Whether a decoding slot drafts an on-device speculative chain
+        inside the mixed megastep. Base server: never (no SpecConfig);
+        the speculative subclass drafts on greedy slots."""
+        return False
+
+    def _on_mixed_spec_tick(self, req, emitted: int):
+        """Hook: one drafting slot's tick committed `emitted` tokens
+        (accepted prefix + bonus). The speculative subclass feeds its
+        acceptance counters; the base server never drafts."""
+
+    def _overlap_window(self):
+        """Host work run in the SHADOW of the in-flight mixed dispatch
+        (overlap_dispatch=True), against a one-deep staged snapshot of
+        scheduler state: admission of pending requests. Admission is
+        structurally safe here — it only touches FREE slots and FREE
+        pages (never a live slot's table row, so no bookkeeping runs
+        against a page table the in-flight dispatch is using), it never
+        preempts, and its device work (COW clone, scale reset, tier
+        fetches, canary snapshot) chains on the in-flight arrays by
+        data dependency. Page growth, eviction and defrag stay strictly
+        AFTER the fence (the next _tick_prep) — the racecheck `dispatch`
+        protocol model explores exactly this ownership discipline."""
+        with obs.span("overlap_admit"):
+            self._admit_pending()
+
+    def _mixed_dispatch(self, live, tr, ntr) -> bool:
+        """Dispatch this tick as ONE universal megastep when the mode is
+        on and no canary shadow window is open (the shadow must observe
+        every launch, so an open window stands the fused path down
+        dynamically — same discipline as _decode_megastep's guard).
+        Returns True when the tick was handled."""
+        if self._mixed_fn is None or self._caches_ref is not None:
+            return False
+        self._mixed_megastep(live, tr, ntr)
+        return True
+
+    def _mixed_megastep(self, live, tr, ntr):
+        """Up to `megastep_ticks` MIXED ticks in one jitted dispatch
+        (Executor.paged_mixed_megastep_fn): decode rows, mid-prefill
+        chunk rows and on-device drafted spec chains ride the same
+        while_loop carry, and the host consumes one (ticks, slots, E)
+        token buffer per dispatch. With overlap_dispatch the host runs
+        the next tick's admission work while the device computes and
+        only then blocks on the fence (the single device_get), exporting
+        host_overlap_ratio.
+
+        Break reasons extend the decode megastep's: `chunk` hands
+        control back after a prefill chunk COMPLETES (page publication
+        + first-token bookkeeping are host work — poolcheck's model),
+        `verify` when a drafting slot's next chain would cross its
+        allocated pages; `finish`/`page`/`limit` mean what they mean on
+        the pure-decode path. The first token of a completing prefill
+        is sampled ON DEVICE with the tick's shared rng split, so the
+        sampled stream is megastep-width invariant (N vs 1) by the same
+        one-split-per-tick argument as the decode megastep."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        sp = obs.span("megastep").__enter__()
+        if sp:
+            sp.set(live=len(live), pages_in_use=self.pool.pages_in_use)
+        P = self.page_size
+        W = self._mixed_window
+        D = self._spec_depth
+        pos = np.zeros((self.slots,), np.int32)
+        pfp = np.zeros((self.slots,), np.int32)
+        pft = np.zeros((self.slots,), np.int32)
+        rem = np.zeros((self.slots,), np.int32)
+        cap = np.zeros((self.slots,), np.int32)
+        dec_act = np.zeros((self.slots,), np.bool_)
+        pf_act = np.zeros((self.slots,), np.bool_)
+        spec_m = np.zeros((self.slots,), np.bool_)
+        for s in live:
+            req = self._active[s]
+            cap[s] = len(req.pages) * P
+            if self._mid_prefill(s):
+                pf_act[s] = True
+                pfp[s] = req.prefill_pos
+                pft[s] = req.prefill_target
+                rem[s] = req.max_new  # the first token counts
+            else:
+                dec_act[s] = True
+                pos[s] = req.pos
+                rem[s] = req.max_new - len(req.tokens)
+                spec_m[s] = self._mixed_spec_slot(req)
+        caches, seq_d, out, cnt, done, pf_fin, rng, ticks = \
+            self._mixed_fn(
+                tr, ntr, self._caches, self._tables_device(),
+                self._seq_device(), jnp.asarray(pos), jnp.asarray(pfp),
+                jnp.asarray(pft), self._temps_device(),
+                jnp.asarray(rem), jnp.asarray(cap),
+                jnp.asarray(dec_act), jnp.asarray(pf_act),
+                jnp.asarray(spec_m), self._rng)
+        # hand the carry forward immediately (async dispatch): the next
+        # tick's inputs chain on these by data dependency
+        self._caches = caches
+        self._rng = rng
+        self._seq_dev = seq_d
+        host_s = 0.0
+        if self.overlap_dispatch:
+            h0 = time.monotonic()
+            self._overlap_window()
+            host_s = time.monotonic() - h0
+        f0 = time.monotonic()
+        # the ONE host sync of the dispatch — the fence. Everything that
+        # reads the token buffer (the bookkeeping replay below) runs
+        # strictly after it: single token-buffer owner.
+        out_np, cnt_np, done_np, pf_np, n = jax.device_get(
+            (out, cnt, done, pf_fin, ticks))
+        fence_s = time.monotonic() - f0
+        if self.overlap_dispatch:
+            wait = host_s + fence_s
+            self._g_overlap.set(host_s / wait if wait > 0 else 0.0)
+        n = int(n)
+        if n == 0:
+            # defensive only: the device refused the first tick (a
+            # capacity race _ensure_pages should have prevented). Run
+            # one legacy host-granularity tick so the loop always makes
+            # progress; no rng split was consumed by the empty dispatch.
+            sp.__exit__(None, None, None)
+            pre, dec = self._split_live(live)
+            if pre:
+                self._prefill_tick(pre, tr, ntr)
+            if dec:
+                self._decode_tick(dec, tr, ntr)
+            return
+        pf_slots = [s for s in live if pf_act[s]]
+        dec_slots = [s for s in live if dec_act[s]]
+        if pf_slots:
+            self.prefill_ticks += 1
+            if dec_slots:
+                for s in pf_slots:
+                    self._active[s].decode_overlap_ticks += n
+        # replay host bookkeeping tick by tick in the one-tick order;
+        # chunk completions and finishes only land on the last executed
+        # tick (the loop breaks on them), so slot release can never race
+        # an earlier tick's replay
+        fused = 0
+        dtok = 0
+        for t in range(n):
+            self._steps += 1
+            last = t == n - 1
+            for s in pf_slots:
+                req = self._active[s]
+                if req is None or req.prefill_pos >= req.prefill_target:
+                    continue
+                take = min(W, req.prefill_target - req.prefill_pos)
+                fused += take
+                req.prefill_pos += take
+                req.prefill_tokens += take
+                self._publish_prefix(req, req.prefill_pos)
+                if pf_np[s] and last:
+                    # mirror _prefill_tick's completion sequence — tail
+                    # published while seq_tokens() still equals
+                    # prefill_seq, THEN the device-sampled first token
+                    self._publish_tail(req)
+                    self._first_token_from_device(
+                        s, req, int(out_np[t, s, 0]))
+                    self._finish_if_done(s)
+                    if self._active[s] is not None:
+                        self._on_prefill_complete(s)
+            for s in dec_slots:
+                req = self._active[s]
+                if req is None:
+                    continue
+                fused += (D + 1) if spec_m[s] else 1
+                c = int(cnt_np[t, s])
+                for j in range(c):
+                    tok = int(out_np[t, s, j])
+                    req.pos += 1
+                    req.tokens.append(tok)
+                    self._tokens[s] = tok
+                dtok += c
+                if spec_m[s]:
+                    self._on_mixed_spec_tick(req, c)
+                if c:
+                    self._publish_prefix(req, req.pos)
+                    self._finish_if_done(s)
+        self._on_megastep_resume()
+        if done_np.any():
+            reason = "finish"
+        elif pf_np.any():
+            reason = "chunk"
+        elif n < self.megastep_ticks:
+            # the blocking slot needs page growth: a drafting slot that
+            # cannot fit its next chain is a verify break, a plain
+            # decode row crossing its pages a page break (cap is the
+            # dispatch-time capacity — the same value the device cond
+            # tested against the advanced positions)
+            blocked_spec = any(
+                spec_m[s] and self._active[s] is not None
+                and self._active[s].pos + D + 1 > cap[s]
+                for s in dec_slots)
+            reason = "verify" if blocked_spec else "page"
+        else:
+            reason = "limit"
+        Wl = max(W, D + 1)
+        rows = n * self.slots * Wl
+        padded = rows - fused
+        self._c_rows.inc(rows)
+        self._c_pad.inc(padded)
+        self._g_waste.set(padded / rows if rows else 0.0)
+        self._c_rt.inc()
+        self._c_dtok.inc(dtok)
+        if self._c_dtok.value:
+            self._g_rt_tok.set(self._c_rt.value / self._c_dtok.value)
+        self._h_mega.observe(n)
+        self._c_break[reason].inc()
+        if sp:
+            sp.set(ticks=n, break_reason=reason, fused_rows=fused,
+                   pf_slots=len(pf_slots), dec_slots=len(dec_slots))
+        sp.__exit__(None, None, None)
+        dt = time.monotonic() - t0
         self._h_tick.observe(dt / max(n, 1))
         self._h_tokens.observe(len(live))
         led = obs.ledger()
@@ -1497,15 +1803,16 @@ class PagedGenerationServer(_GenerationServerBase):
             live = self._tick_prep()
             if live is None:
                 continue
+            if self._mixed_dispatch(live, tr, ntr):
+                continue
             pre, dec = self._split_live(live)
             if pre:
                 self._prefill_tick(pre, tr, ntr)
             if dec:
-                # an open canary window needs the one-tick path: the
-                # fp32 shadow must observe every launch, and a megastep
-                # would run N ticks it never sees
-                if (self._megastep is not None and not pre
-                        and self._caches_ref is None):
+                if self._megastep is not None and not pre:
+                    # _decode_megastep stands down by itself while a
+                    # canary window is open (the fp32 shadow must
+                    # observe every launch)
                     self._decode_megastep(dec, tr, ntr)
                 else:
                     self._decode_tick(dec, tr, ntr)

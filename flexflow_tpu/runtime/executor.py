@@ -1049,6 +1049,206 @@ class Executor:
             self._megastep_fns.pop(next(iter(self._megastep_fns)))
         return fn
 
+    def paged_mixed_megastep_fn(self, max_ticks: int, eos_id=None,
+                                window: int = 1, depth: int = 0):
+        """jitted UNIVERSAL megastep: up to `max_ticks` fused ticks that
+        carry decode rows, MID-PREFILL chunk rows and on-device drafted
+        speculative chains in the same `jax.lax.while_loop` — the mixed
+        generalisation of `paged_megastep_fn` (flexflow_tpu.paged
+        megastep driver, mixed mode).
+
+        (params, pools, page_tables, seq, pos, pf_pos, pf_target, temps,
+         remaining, cap_rows, dec_active, pf_active, spec_mask, rng) ->
+            (new_pools, new_seq, out_tokens, out_counts, done, pf_fin,
+             new_rng, ticks)
+
+        `seq` is the device-resident (slots, Lbuf + 1) token ledger —
+        column Lbuf is a write-only trash column for masked scatters;
+        columns 0..pos hold each slot's committed tokens (prompt rows
+        preloaded by the host through pf_target - 1). Every per-tick
+        input a row needs is GATHERED from it: decode rows feed
+        seq[pos], prefill rows feed seq[pf_pos..pf_pos+take-1], and
+        greedy `spec_mask` rows draft a width-1 unigram chain (the D
+        tokens after the most recent earlier occurrence of seq[pos])
+        so verify -> accept -> commit rides the carry. Emitted tokens
+        scatter back into `seq`, so piece i+1 of a chunk and tick t+1
+        of a chain always read tick t's commits.
+
+        Per tick the row mix maps onto ONE ragged launch of window
+        Wl = max(window, depth + 1): q_lens per slot are `take` for a
+        live prefill row, depth+1 for a drafting row, 1 for plain
+        decode, 0 idle; `depths` is the chain arange and `anc` the
+        triangular chain relation, both constant. Acceptance is the
+        device argmax walk over the drafted prefix; every emitted token
+        is the greedy argmax continuation (or the shared-split sample
+        on temp > 0 rows), so token identity vs the one-tick path holds
+        by construction regardless of draft quality. Rejected-draft K/V
+        rows sit past the advanced write head: masked until the next
+        tick's depth+1 consecutive writes (starting exactly at the
+        first stale row) overwrite them before attention runs.
+
+        The loop stops BEFORE any tick it cannot run alone — a finished
+        slot (remaining exhausted / eos), a slot whose next rows would
+        cross `cap_rows` (page growth is host bookkeeping) — and stops
+        AFTER a tick in which a prefill chunk COMPLETES (`pf_fin`), so
+        the host publishes pages and flips the slot to decode before
+        re-dispatch (poolcheck's publication model stays intact: the
+        break IS the `chunk` reason). A completing chunk samples its
+        first token on device with the tick's shared rng split; plain
+        decode rows emit 1 token/tick and drafting rows up to depth+1
+        (`out_tokens` is (max_ticks, slots, depth+1), -1-padded, with
+        `out_counts` the per-tick emission counts). One
+        `jax.random.split` per tick keeps picks invariant in max_ticks.
+        Compiled once per (max_ticks, eos, window, depth, slots)."""
+        key = (int(max_ticks), eos_id, int(window), int(depth), "mixed")
+        fn = self._megastep_fns.pop(key, None)
+        if fn is not None:
+            self._megastep_fns[key] = fn  # refresh LRU recency
+            return fn
+        from flexflow_tpu.serving import pick_tokens  # lazy: no cycle
+
+        N = int(max_ticks)
+        W = max(int(window), 1)
+        D = max(int(depth), 0)
+        Wl = max(W, D + 1)
+        E = D + 1  # emission capacity per slot per tick
+
+        def megastep(trainable, nontrainable, caches, page_tables, seq,
+                     pos, pf_pos, pf_target, temps, remaining, cap_rows,
+                     dec_active, pf_active, spec_mask, rng):
+            slots = pos.shape[0]
+            Lb = seq.shape[1] - 1  # column Lb is the trash column
+            bidx = jnp.arange(slots)[:, None]
+            win = jnp.arange(Wl, dtype=jnp.int32)
+            ej = jnp.arange(E, dtype=jnp.int32)
+            depths = jnp.broadcast_to(win[None, :], (slots, Wl))
+            anc = jnp.broadcast_to(
+                jnp.tril(jnp.ones((Wl, Wl), jnp.bool_))[None],
+                (slots, Wl, Wl))
+            spec_on = (dec_active & spec_mask) if D > 0 else \
+                jnp.zeros_like(dec_active)
+            out0 = jnp.full((N, slots, E), -1, jnp.int32)
+            cnt0 = jnp.zeros((N, slots), jnp.int32)
+
+            def cond(state):
+                t, _c, _s, p, _pf, _rem, done, pf_fin, _rng, _o, _n = \
+                    state
+                # a drafting row writes K/V at p..p+D, decode at p; a
+                # slot that cannot fit hands control back for growth
+                need = jnp.where(spec_on, p + D + 1, p + 1)
+                room = jnp.all(jnp.logical_or(
+                    jnp.logical_not(dec_active), need <= cap_rows))
+                return ((t < N) & jnp.logical_not(jnp.any(done))
+                        & jnp.logical_not(jnp.any(pf_fin)) & room)
+
+            def body(state):
+                t, caches_t, seq_t, p, pfp, rem, _d, _pf, rng_t, out, \
+                    cntb = state
+                pf_live = pf_active & (pfp < pf_target)
+                take = jnp.where(pf_live,
+                                 jnp.minimum(W, pf_target - pfp), 0)
+                q_lens = jnp.where(
+                    pf_live, take,
+                    jnp.where(spec_on, D + 1,
+                              jnp.where(dec_active, 1, 0))
+                ).astype(jnp.int32)
+                base = jnp.where(pf_live, pfp, p)
+                cols = jnp.clip(base[:, None] + win[None, :], 0, Lb)
+                ids = jnp.take_along_axis(seq_t, cols, axis=1)
+                if D > 0:
+                    # width-1 unigram draft: chain after the most
+                    # recent EARLIER occurrence of the last committed
+                    # token, zeros when no match / past the head
+                    idxs = jnp.arange(seq_t.shape[1], dtype=jnp.int32)
+                    last = jnp.take_along_axis(
+                        seq_t, jnp.clip(p, 0, Lb)[:, None], axis=1)
+                    hit = (seq_t == last) & (idxs[None, :] < p[:, None])
+                    j = jnp.max(jnp.where(hit, idxs[None, :], -1),
+                                axis=1)
+                    dcols = (j[:, None] + 1
+                             + jnp.arange(D, dtype=jnp.int32)[None, :])
+                    dvalid = (j[:, None] >= 0) & (dcols <= p[:, None])
+                    draft = jnp.where(
+                        dvalid,
+                        jnp.take_along_axis(
+                            seq_t, jnp.clip(dcols, 0, Lb), axis=1), 0)
+                    chain = jnp.concatenate(
+                        [last, draft,
+                         jnp.zeros((slots, Wl - E), jnp.int32)], axis=1)
+                    ids = jnp.where(spec_on[:, None], chain, ids)
+                cache_out = {}
+                probs, _, _ = self.run_forward(
+                    trainable, nontrainable, (ids,), training=False,
+                    rng=jax.random.key(0), kv_caches=caches_t,
+                    cache_position=base, cache_out=cache_out,
+                    page_tables=page_tables,
+                    ragged=(q_lens, depths, anc),
+                )
+                rng_t, sub = jax.random.split(rng_t)
+                lastrow = jnp.clip(q_lens - 1, 0, Wl - 1)
+                probs_last = jnp.take_along_axis(
+                    probs, lastrow[:, None, None], axis=1)[:, 0, :]
+                picked = pick_tokens(probs_last, temps, sub)
+                completing = pf_live & (pfp + take >= pf_target)
+                emitting = dec_active | completing
+                if D > 0:
+                    preds = jnp.argmax(probs[:, :E, :],
+                                       axis=-1).astype(jnp.int32)
+                    match = (draft == preds[:, :D]) & spec_on[:, None]
+                    acc = jnp.sum(jnp.cumprod(
+                        match.astype(jnp.int32), axis=1), axis=1)
+                    base_cnt = jnp.where(
+                        spec_on, acc + 1,
+                        jnp.where(emitting, 1, 0))
+                    emit = jnp.where(
+                        spec_on[:, None], preds,
+                        jnp.where(ej[None, :] == 0,
+                                  picked[:, None], -1))
+                else:
+                    base_cnt = jnp.where(emitting, 1, 0)
+                    emit = picked[:, None]
+                cnt = jnp.minimum(base_cnt, jnp.maximum(rem, 0))
+                valid = ej[None, :] < cnt[:, None]
+                if eos_id is not None:
+                    is_eos = valid & (emit == eos_id)
+                    first = jnp.min(
+                        jnp.where(is_eos, ej[None, :], E), axis=1)
+                    cnt = jnp.where(first < E,
+                                    jnp.minimum(cnt, first + 1), cnt)
+                    valid = ej[None, :] < cnt[:, None]
+                oldc = jnp.where(completing, pf_target, p + 1)
+                scols = jnp.where(
+                    valid,
+                    jnp.clip(oldc[:, None] + ej[None, :], 0, Lb), Lb)
+                seq2 = seq_t.at[bidx, scols].set(emit)
+                p2 = jnp.where(cnt > 0, oldc + cnt - 1, p)
+                pfp2 = jnp.where(pf_live, pfp + take, pfp)
+                rem2 = jnp.where(emitting, rem - cnt, rem)
+                fin = emitting & (cnt > 0) & (rem2 <= 0)
+                if eos_id is not None:
+                    fin = fin | (first < E)
+                out2 = out.at[t].set(jnp.where(valid, emit, -1))
+                cnt2 = cntb.at[t].set(cnt)
+                return (t + 1, cache_out, seq2, p2, pfp2, rem2, fin,
+                        completing, rng_t, out2, cnt2)
+
+            state = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), caches, seq, pos, pf_pos, remaining,
+                 jnp.zeros_like(dec_active), jnp.zeros_like(pf_active),
+                 rng, out0, cnt0))
+            t, caches, seq, pos, pf_pos, remaining, done, pf_fin, \
+                rng, out, cnt = state
+            return caches, seq, out, cnt, done, pf_fin, rng, t
+
+        fn = self.compile_tracker.wrap(
+            "megastep_mixed", jax.jit(megastep),
+            lambda args, _n=N, _w=Wl: (args[5].shape[0], _n, _w))
+        self._megastep_fns[key] = fn
+        while len(self._megastep_fns) > self.JIT_CACHE_LIMIT:
+            self._megastep_fns.pop(next(iter(self._megastep_fns)))
+        return fn
+
     def paged_commit_fn(self):
         """jitted (pools, page_tables, src, dst) -> pools: copy the
         accepted tree path's K/V rows onto the contiguous committed
@@ -1256,6 +1456,43 @@ class Executor:
                 out = fn(tr, ntr, caches_c, *args, jax.random.key(0))
                 rng_ref = out[3]
                 fn(tr, ntr, caches_c, *args, rng_ref)
+                warmed += 1
+            for S, NT, _WL in entries.get(  # fflint: host-ok (one-time warmup)
+                    "megastep_mixed", {}).get("shapes", ()):
+                # window/depth come from the config echo — the launch
+                # window in the shape tuple is their derived max, kept
+                # in the catalog for the soundness diff only
+                wnd = min(int(cfg.get("window_rows") or 1),
+                          int(cfg.get("prefill_chunk") or 1))
+                dep = int(cfg.get("spec_depth") or 0)
+                fnm = self.paged_mixed_megastep_fn(
+                    int(NT), eos_id, window=wnd, depth=dep)
+                S = int(S)
+                z = jnp.asarray(np.zeros((S,), np.int32))
+                seqz = jnp.asarray(np.zeros(
+                    (S, cols * page_size + 1), np.int32))
+                bT = jnp.asarray(np.ones((S,), np.bool_))
+                bF = jnp.asarray(np.zeros((S,), np.bool_))
+                margs = (jnp.zeros((S, cols), jnp.int32), seqz, z, z, z,
+                         jnp.asarray(np.zeros((S,), np.float32)), z, z,
+                         bT, bF, bF)
+                # dec_active with zero cap_rows: the while_loop compiles
+                # fully but executes zero iterations (same trick as the
+                # decode megastep warm above). UNLIKE the decode
+                # megastep, the mixed one can be the VERY FIRST dispatch
+                # of a serve (prefill rides it), so the virgin
+                # host-uploaded pool (uncommitted) is a reachable cache
+                # input, not just launch outputs (committed)
+                fnm(tr, ntr, caches_u, *margs, jax.random.key(0))
+                out = fnm(tr, ntr, caches_c, *margs, jax.random.key(0))
+                rng_ref = out[6]
+                fnm(tr, ntr, caches_c, *margs, rng_ref)
+                # steady state carries the previous dispatch's seq
+                # ledger (committed) forward; admission dirties it back
+                # to a host upload — warm both combos
+                seq_c = out[1]
+                margs_c = margs[:1] + (seq_c,) + margs[2:]
+                fnm(tr, ntr, caches_c, *margs_c, rng_ref)
                 warmed += 1
             commit = (self.paged_commit_fn()
                       if "paged_commit" in entries else None)

@@ -832,6 +832,12 @@ def graph_cost(graph: Graph, strategy: Dict[str, ShardingView],
 # truth on top of this default.
 HOST_DISPATCH_SECONDS = 5e-5
 
+# Fraction of the per-dispatch host cost that survives overlap_dispatch:
+# the fence (device_get of the token buffer) and the bookkeeping replay
+# stay on the critical path, only the admission/metrics work between
+# dispatch and fence hides in the device's shadow.
+OVERLAP_RESIDUAL = 0.35
+
 
 @dataclasses.dataclass
 class TickPricer:
@@ -911,6 +917,28 @@ class TickPricer:
         comp = (self.token_seconds * rows
                 * self._scale("prefill", batch, chunk=int(chunk_tokens)))
         return comp + self.host_dispatch_s
+
+    def mixed_dispatch(self, live_rows: float, chunk_tokens: int = 0,
+                       tree_nodes: int = 0, padded_rows: float = 0.0,
+                       megastep: float = 1.0,
+                       overlap: bool = False) -> float:
+        """Seconds for ONE universal-fused dispatch of `megastep` MIXED
+        ticks: every fused tick launches the live decode rows (each
+        `tree_nodes` wide when a drafted spec chain rides the row, else
+        1), the in-flight prefill chunk's `chunk_tokens` rows, and the
+        padding. The host is paid once per DISPATCH — the universal
+        megastep's whole point is that mixed traffic amortizes it too —
+        and `overlap` further discounts it to OVERLAP_RESIDUAL because
+        the admission/metrics slice of the host work runs in the shadow
+        of the in-flight device computation."""
+        width = max(int(tree_nodes), 1)
+        rows = (max(live_rows, 0.0) * width + max(int(chunk_tokens), 0)
+                + max(padded_rows, 0.0) * self.pad_row_cost)
+        comp = (self.token_seconds * max(rows, 1.0) * max(megastep, 1.0)
+                * self._scale("decode", live_rows, chunk=int(chunk_tokens),
+                              width=max(megastep, 1.0)))
+        host = self.host_dispatch_s * (OVERLAP_RESIDUAL if overlap else 1.0)
+        return comp + host
 
     def fetch_seconds(self, page_bytes: float, pages: int = 1) -> float:
         """Seconds to move `pages` spilled KV pages (each `page_bytes`

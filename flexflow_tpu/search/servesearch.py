@@ -110,6 +110,8 @@ class ServeStrategy:
     spec_width: int = 0
     spec_depth: int = 0
     megastep_ticks: int = 1
+    megastep_mixed: bool = False
+    overlap_dispatch: bool = False
     ragged_pack: bool = True
     pool_fraction: float = 1.0
     kv_dtype: str = "auto"
@@ -137,10 +139,16 @@ class ServeStrategy:
             raise ValueError(
                 f"spec_width/spec_depth must both be 0 or both >= 1, got "
                 f"{self.spec_width}x{self.spec_depth}")
-        if self.spec_width >= 1 and self.megastep_ticks > 1:
+        if self.overlap_dispatch and not self.megastep_mixed:
+            raise ValueError(
+                "overlap_dispatch overlaps host work with the in-flight "
+                "MIXED megastep dispatch; it requires megastep_mixed")
+        if (self.spec_width >= 1 and self.megastep_ticks > 1
+                and not self.megastep_mixed):
             raise ValueError(
                 "speculative decoding and megastep_ticks > 1 are mutually "
-                "exclusive (the fused decode loop cannot host verify ticks)")
+                "exclusive (the fused decode loop cannot host verify "
+                "ticks) — unless megastep_mixed fuses verify on device")
         # typo'd dtypes fail HERE, not as a silently-fp32 served pool
         from flexflow_tpu.paged.quant import kv_dtype_info
 
@@ -172,6 +180,8 @@ class ServeStrategy:
             "prefill_chunk": self.prefill_chunk,
             "ragged_pack": self.ragged_pack,
             "megastep_ticks": self.megastep_ticks,
+            "megastep_mixed": self.megastep_mixed,
+            "overlap_dispatch": self.overlap_dispatch,
             "num_pages": num_pages,
             "speculate": self.spec_config(),
             "kv_dtype": self.kv_dtype,
@@ -184,8 +194,13 @@ class ServeStrategy:
         mesh = ",".join(f"{a}={s}" for a, s in self.mesh) or "compiled mesh"
         tier = (f"tier {self.host_tier_pages}p"
                 if self.host_tier_pages else "tier off")
+        mega = f"megastep {self.megastep_ticks}"
+        if self.megastep_mixed:
+            mega += " mixed"
+        if self.overlap_dispatch:
+            mega += "+overlap"
         return (f"page {self.page_size} + chunk {self.prefill_chunk} + "
-                f"megastep {self.megastep_ticks} + {spec} + "
+                f"{mega} + {spec} + "
                 f"{'packed' if self.ragged_pack else 'legacy'} + "
                 f"pool {self.pool_fraction:g} + kv {self.kv_dtype} + "
                 f"{tier} + {mesh}")
@@ -445,9 +460,51 @@ class ServePricer:
             launch_rows = max(slots, self._bucket(live))
         padded = max(launch_rows - live, 0.0)
 
+        # -- chunked prefill padding (both dispatch models below) -------
+        uncached_mean = (1.0 - share) * mean_p
+        uncached_p95 = (1.0 - share) * p95_p
+        if s.ragged_pack:
+            w = min(_prefill_window_rows(), chunk)
+            pad_pre = -(-chunk // w) * w - chunk
+        else:
+            pad_pre = self._bucket(chunk) - chunk
+
         # -- decode dispatch: megastep fusion or spec verify ------------
         spec = s.spec_config()
-        if spec is not None:
+        if s.megastep_mixed:
+            # universal megastep: chunk rows and on-device drafted spec
+            # chains ride the SAME fused while_loop dispatch, so mixed
+            # ticks amortize the host exactly like pure-decode ones
+            if spec is not None:
+                # the device drafts a width-1 unigram chain per tick
+                accepted = SpecConfig(
+                    width=1, depth=spec.depth).expected_tokens_per_step(
+                        self.acceptance_rate)
+                nodes = spec.depth + 1
+            else:
+                accepted = 1.0
+                nodes = 1
+            # a fused run breaks when ANY live slot finishes
+            # (~accepted/new_t per tick each), crosses a page boundary
+            # (~1/page each), or completes its prefill chunk run (the
+            # `chunk`/`verify` break reasons fold into the same rate)
+            p_break = live * (1.0 / page + accepted / new_t)
+            fused = 1.0
+            if s.megastep_ticks > 1:
+                fused = min(float(s.megastep_ticks),
+                            max(1.0, 1.0 / max(p_break, 1e-9)))
+            t_disp = pricer.mixed_dispatch(
+                live, tree_nodes=nodes, padded_rows=padded,
+                megastep=fused, overlap=s.overlap_dispatch)
+            tokens_per_dispatch = fused * accepted
+            # a tick with a chunk in flight rides the SAME fused launch
+            # — the host is paid once per RUN, not once per chunk tick
+            t_mixed = pricer.mixed_dispatch(
+                live, chunk_tokens=chunk, tree_nodes=nodes,
+                padded_rows=padded + pad_pre, megastep=fused,
+                overlap=s.overlap_dispatch) / fused
+            t_pre = t_mixed
+        elif spec is not None:
             accepted = spec.expected_tokens_per_step(self.acceptance_rate)
             t_disp = pricer.verify_dispatch(live, spec.max_nodes,
                                             padded_rows=padded)
@@ -469,18 +526,13 @@ class ServePricer:
             t_tick1 = pricer.decode_dispatch(live, padded_rows=padded,
                                              megastep=1.0)
 
-        # -- chunked prefill: TTFT and per-tick padding -----------------
-        uncached_mean = (1.0 - share) * mean_p
-        uncached_p95 = (1.0 - share) * p95_p
-        if s.ragged_pack:
-            w = min(_prefill_window_rows(), chunk)
-            pad_pre = -(-chunk // w) * w - chunk
-        else:
-            pad_pre = self._bucket(chunk) - chunk
-        t_pre = pricer.prefill_tick(chunk, padded_rows=pad_pre)
-        # a tick with a chunk in flight runs the prefill launch AND the
-        # one-tick decode for everyone else (megasteps never fire then)
-        t_mixed = t_pre + t_tick1
+        # -- chunked prefill: TTFT -------------------------------------
+        if not s.megastep_mixed:
+            t_pre = pricer.prefill_tick(chunk, padded_rows=pad_pre)
+            # a tick with a chunk in flight runs the prefill launch AND
+            # the one-tick decode for everyone else (megasteps never
+            # fire then)
+            t_mixed = t_pre + t_tick1
         chunks_mean = max(math.ceil(uncached_mean / chunk), 1)
         chunks_p95 = max(math.ceil(uncached_p95 / chunk), 1)
         ttft = chunks_p95 * t_mixed + self.host_dispatch_s
@@ -544,8 +596,10 @@ class _Knob:
 
 def default_space(*, max_len: int) -> Dict[str, List]:
     """The searched knob values. `spec` is a joint (width, depth) knob
-    so half-set speculation can never be proposed; layout values are
-    appended by the search when candidate meshes are given."""
+    so half-set speculation can never be proposed, and `fuse` a joint
+    (megastep_mixed, overlap_dispatch) knob so overlap-without-mixed
+    can never be proposed; layout values are appended by the search
+    when candidate meshes are given."""
     return {
         "page_size": [p for p in (8, 16, 32, 64, 128) if p <= max_len]
         or [max_len],
@@ -553,6 +607,7 @@ def default_space(*, max_len: int) -> Dict[str, List]:
         or [max_len],
         "spec": [(0, 0), (2, 2), (2, 4), (4, 4)],
         "megastep_ticks": [1, 2, 4, 8, 16],
+        "fuse": [(False, False), (True, False), (True, True)],
         "ragged_pack": [True, False],
         "pool_fraction": [1.0, 0.75, 0.5, 0.25],
         "kv_dtype": ["auto", "int8"],
@@ -843,6 +898,7 @@ def search_serve_strategy(
         "prefill_chunk": default.prefill_chunk,
         "spec": (default.spec_width, default.spec_depth),
         "megastep_ticks": default.megastep_ticks,
+        "fuse": (default.megastep_mixed, default.overlap_dispatch),
         "ragged_pack": default.ragged_pack,
         "pool_fraction": default.pool_fraction,
         "kv_dtype": default.kv_dtype,
@@ -854,7 +910,7 @@ def search_serve_strategy(
             vals.insert(0, dval)
     knobs = [(name, values[name]) for name in
              ("page_size", "prefill_chunk", "spec", "megastep_ticks",
-              "ragged_pack", "pool_fraction", "kv_dtype",
+              "fuse", "ragged_pack", "pool_fraction", "kv_dtype",
               "host_tier_pages")]
     if len(priced) > 1:
         knobs.append(("mesh", [lay.mesh_key for lay in priced]))
@@ -866,7 +922,10 @@ def search_serve_strategy(
         kv = {name: table.views[i][k]
               for i, (name, k) in enumerate(zip(names, assign))}
         w, d = kv.pop("spec")
+        mixed, overlap = kv.pop("fuse")
         return ServeStrategy(spec_width=w, spec_depth=d,
+                             megastep_mixed=mixed,
+                             overlap_dispatch=overlap,
                              mesh=kv.pop("mesh", default.mesh), **kv)
 
     cache: Dict[Tuple[int, ...], Tuple[float, Optional[Dict]]] = {}

@@ -894,6 +894,23 @@ class _GenerationServerBase:
                 0.0, self._compile_tracker.compile_seconds_total
                 - req.compile_s_at_submit)
 
+    def _first_token_from_device(self, slot: int, req: _GenRequest,
+                                 tok: int):
+        """Commit a request's FIRST token when the device already
+        sampled it (the mixed megastep samples a completing prefill's
+        first token on device with the tick's shared rng split — the
+        host rng stream is NOT consumed, keeping megastep-width
+        invariance). Same bookkeeping as _sample_first_token minus the
+        host-side pick."""
+        req.pos = len(req.seq_tokens())  # before the append below
+        req.tokens.append(tok)
+        self._tokens[slot] = tok
+        if req.first_token_t is None:
+            req.first_token_t = time.monotonic()
+            req.first_compile_s = max(
+                0.0, self._compile_tracker.compile_seconds_total
+                - req.compile_s_at_submit)
+
     def _admit_common(self, req: _GenRequest, slot: int, padded_len: int,
                       scatter_rows):
         """Bucketed prefill + first-token sample, shared by the dense and
@@ -1246,6 +1263,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
                      speculate=None,
                      ragged_pack: bool = True,
                      megastep_ticks: int = 1,
+                     megastep_mixed: bool = False,
+                     overlap_dispatch: bool = False,
                      request_record_limit: Optional[int] = None,
                      kv_dtype: str = "auto",
                      serve_strategy=None,
@@ -1300,9 +1319,24 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
     returns to the host scheduler only when a slot finishes, a page
     fills, or N ticks elapse (docs/paged.md "Decode megasteps"). Token
     output is identical to the one-tick loop, greedy and sampled alike;
-    the default N=1 keeps the per-tick host loop. Ticks with mid-prefill
-    chunks in flight keep host granularity either way, so chunk
-    completion always resumes the host between ticks.
+    the default N=1 keeps the per-tick host loop. Without
+    `megastep_mixed`, ticks with mid-prefill chunks in flight keep host
+    granularity, so chunk completion always resumes the host between
+    ticks.
+
+    `megastep_mixed=True` (paged only) makes the megastep UNIVERSAL
+    (docs/paged.md "Universal megasteps"): mid-prefill chunk rows and —
+    with `speculate` — on-device drafted spec chains ride the SAME
+    fused while_loop as decode rows, so mixed traffic no longer drops
+    to host granularity. Control returns on the extra `chunk` break
+    reason only when a chunk COMPLETES (page publication + first-token
+    bookkeeping stay host work), and `verify` when a drafting slot
+    needs page growth. Greedy and fixed-seed sampled output stay
+    token-identical to the one-tick loop. `overlap_dispatch=True`
+    additionally overlaps the next tick's admission work with the
+    in-flight dispatch and only then consumes the token buffer (the
+    `host_overlap_ratio` gauge tracks how much host time the overlap
+    hides); it requires megastep_mixed.
 
     `request_record_limit` bounds how many completed requests keep their
     per-request metric record (default _GenerationServerBase
@@ -1377,6 +1411,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
         prefill_chunk = kw["prefill_chunk"]
         ragged_pack = kw["ragged_pack"]
         megastep_ticks = kw["megastep_ticks"]
+        megastep_mixed = kw.get("megastep_mixed", False)
+        overlap_dispatch = kw.get("overlap_dispatch", False)
         speculate = kw["speculate"]
         kv_dtype = kw["kv_dtype"]
         if kw["num_pages"] is not None:
@@ -1389,11 +1425,21 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
     if megastep_ticks < 1:
         raise ValueError(
             f"megastep_ticks must be >= 1, got {megastep_ticks}")
-    if megastep_ticks > 1 and (not paged or speculate is not None):
+    if megastep_mixed and not paged:
+        raise ValueError(
+            "megastep_mixed fuses the paged mixed tick; pass paged=True")
+    if overlap_dispatch and not megastep_mixed:
+        raise ValueError(
+            "overlap_dispatch overlaps host work with the in-flight "
+            "MIXED megastep dispatch; pass megastep_mixed=True")
+    if (megastep_ticks > 1 and not megastep_mixed
+            and (not paged or speculate is not None)):
         raise ValueError(
             "megastep_ticks > 1 rides the paged one-tick decode loop; "
             "pass paged=True and no speculate (the speculative server's "
-            "verify step already emits multiple tokens per dispatch)")
+            "verify step already emits multiple tokens per dispatch), "
+            "or megastep_mixed=True to fuse spec verify into the "
+            "universal megastep")
     if speculate is not None:
         if not paged:
             raise ValueError(
@@ -1406,6 +1452,9 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             seed=seed, page_size=page_size, num_pages=num_pages,
             preemption=preemption, prefix_cache=prefix_cache,
             prefill_chunk=prefill_chunk, ragged_pack=ragged_pack,
+            megastep_ticks=megastep_ticks,
+            megastep_mixed=megastep_mixed,
+            overlap_dispatch=overlap_dispatch,
             request_record_limit=request_record_limit,
             kv_dtype=kv_dtype, reqlog_capacity=reqlog_capacity,
             slo=slo, slo_dump_dir=slo_dump_dir,
@@ -1420,6 +1469,8 @@ def serve_generation(ff, slots: int = 4, max_len: int = 512,
             page_size=page_size, num_pages=num_pages, preemption=preemption,
             prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
             ragged_pack=ragged_pack, megastep_ticks=megastep_ticks,
+            megastep_mixed=megastep_mixed,
+            overlap_dispatch=overlap_dispatch,
             request_record_limit=request_record_limit,
             kv_dtype=kv_dtype, reqlog_capacity=reqlog_capacity,
             slo=slo, slo_dump_dir=slo_dump_dir,

@@ -55,6 +55,9 @@ class SpeculativePagedServer(PagedGenerationServer):
                  num_pages: Optional[int] = None, preemption: bool = True,
                  prefix_cache: bool = True, prefill_chunk: int = 64,
                  ragged_pack: bool = True,
+                 megastep_ticks: int = 1,
+                 megastep_mixed: bool = False,
+                 overlap_dispatch: bool = False,
                  request_record_limit: Optional[int] = None,
                  kv_dtype: str = "auto",
                  reqlog_capacity: Optional[int] = None,
@@ -84,6 +87,9 @@ class SpeculativePagedServer(PagedGenerationServer):
                          prefix_cache=prefix_cache,
                          prefill_chunk=prefill_chunk,
                          ragged_pack=ragged_pack,
+                         megastep_ticks=megastep_ticks,
+                         megastep_mixed=megastep_mixed,
+                         overlap_dispatch=overlap_dispatch,
                          request_record_limit=request_record_limit,
                          kv_dtype=kv_dtype,
                          reqlog_capacity=reqlog_capacity,
@@ -143,23 +149,43 @@ class SpeculativePagedServer(PagedGenerationServer):
         }
         return m
 
+    # -- universal megastep hooks ------------------------------------------
+
+    def _mixed_spec_slot(self, req) -> bool:
+        # greedy slots draft an on-device width-1 n-gram chain inside
+        # the mixed megastep; temperature>0 slots decode one token/tick
+        # (exactness under sampling needs rejection sampling)
+        return req.temperature <= 0.0
+
+    def _on_mixed_spec_tick(self, req, emitted: int):
+        # one drafting slot's fused verify→commit tick: the device
+        # emitted the accepted draft prefix + the correcting/bonus
+        # token. accepted = emitted-1 under-counts by at most one on
+        # the rare max_new/EOS-cut tick (the host cannot see how much
+        # of the cut run was verified draft), which only DEFLATES the
+        # acceptance metrics — never inflates them.
+        D = max(self._spec_depth, 1)
+        accepted = max(emitted - 1, 0)
+        self.spec_steps += 1
+        self.spec_drafted += D
+        self.spec_accepted += accepted
+        self.spec_emitted += emitted
+        req.spec_steps += 1
+        req.spec_drafted += D
+        req.spec_accepted += accepted
+        req.spec_emitted += emitted
+        h = getattr(self, "_h_accept", None)
+        if h is not None:
+            h.observe(accepted / D)
+
     # -- the speculative tick ----------------------------------------------
 
     def _loop_body(self, tr, ntr):
-        import jax
-        import jax.numpy as jnp
-
-        from flexflow_tpu.spec.tree import (
-            accept_greedy,
-            ancestor_masks,
-            build_tree,
-        )
-
-        T = self.spec.max_nodes
-        C = self.spec.depth + 1  # max rows committed per tick (path+bonus)
         while not self._stop.is_set():
             live = self._tick_prep()
             if live is None:
+                continue
+            if self._mixed_dispatch(live, tr, ntr):
                 continue
             # chunked prefill rides the same tick structure as the base
             # loop: mid-prefill slots advance one budgeted chunk, then
@@ -176,175 +202,188 @@ class SpeculativePagedServer(PagedGenerationServer):
                 # single-token tick instead of a max_nodes-wide verify
                 self._decode_tick(live, tr, ntr)
                 continue
+            self._spec_tick(live, tr, ntr)
 
-            # draft: one tree WORK ITEM per live greedy slot.
-            # temperature>0 slots skip the drafter entirely — their
-            # accept path is the root's sample only, so they pack as
-            # single-row decode items instead of max_nodes-wide trees
-            # (drafts would be paid for and thrown away, and would
-            # dilute the acceptance metrics). Idle and mid-prefill slots
-            # pack NOTHING under ragged_pack (the pre-ragged layout
-            # carried a full tree of null-page scratch for every slot;
-            # ragged_pack=False keeps that for the bench baseline, as
-            # q_len-0 items).
-            t0 = time.monotonic()
-            tick_drafted = 0
-            sp = obs.span("draft").__enter__()
-            order = live if self.ragged_pack else list(range(self.slots))
-            slots_of = []   # item index -> slot
-            trees = {}
-            tree_rows = []  # item indexes carrying a real tree
-            parents = []
-            for s in order:
-                req = self._active[s]
-                if req is None:
-                    slots_of.append(s)      # legacy filler: q_len 0
-                    continue
-                if s not in live or req.temperature > 0.0:
-                    slots_of.append(s)      # 1-row (or filler) item
-                    continue
-                chains = self.drafter.draft(req.seq_tokens(),
-                                            self.spec.width,
-                                            self.spec.depth)
-                tree = build_tree(req.tokens[-1], chains, T,
-                                  max_depth=self.spec.depth)
-                trees[s] = tree
-                tree_rows.append(len(slots_of))
-                parents.append(tree.parents)
-                slots_of.append(s)
-                drafted = tree.n_nodes - 1
-                self.spec_drafted += drafted
-                req.spec_drafted += drafted
-                tick_drafted += drafted
-            if sp:
-                sp.set(live=len(live), width=T, drafted=tick_drafted)
-            sp.__exit__(None, None, None)
-            anc = (ancestor_masks(np.stack(parents)) if parents
-                   else np.zeros((0, T, T), bool))
-            pos = np.array([self._active[s].pos if self._active[s] else 0
-                            for s in range(self.slots)], np.int32)
+    def _spec_tick(self, live, tr, ntr):
+        import jax
+        import jax.numpy as jnp
 
-            # items: a tree (q_len = its real node count — padding nodes
-            # are skipped work whose writes land in the null page), one
-            # committed-token row for a sampled slot, or a q_len-0
-            # filler. Mid-prefill slots pack no item, so their partially
-            # filled pages are never a write target — the table-nulling
-            # trick is gone
-            items = []
-            ti = iter(range(len(tree_rows)))
-            for i, s in enumerate(slots_of):
-                req = self._active[s]
-                if s in trees:
-                    k = next(ti)
-                    tree = trees[s]
-                    items.append((s, req.pos,
-                                  tree.tokens[:tree.n_nodes],
-                                  tree.depths, anc[k]))
-                elif req is not None and s in live:
-                    items.append((s, req.pos, [req.tokens[-1]],
-                                  None, None))
-                else:
-                    items.append((s, 0, [], None, None))
-            sp = obs.span("verify").__enter__()
-            if sp:
-                sp.set(live=len(live), width=T,
-                       pages_in_use=self.pool.pages_in_use)
-            probs, padded, total = self._launch(items, T, tr, ntr)
-            self._g_waste.set(padded / total if total else 0.0)
-            if sp:
-                sp.set(padded_rows=padded, total_rows=total)
-            for s in self._admit_order:
-                if self._mid_prefill(s):
-                    self._active[s].decode_overlap_ticks += 1
+        from flexflow_tpu.spec.tree import (
+            accept_greedy,
+            ancestor_masks,
+            build_tree,
+        )
 
-            # accept: greedy argmax walk. Both reductions run ON DEVICE —
-            # per-node argmaxes for the walk and the root rows' _pick for
-            # temperature>0 slots (one rng split per tick, same
-            # discipline as the non-speculative servers) — so only
-            # (items, max_nodes) + (slots,) ints cross to the host, never
-            # the (items, max_nodes, vocab) probs. The root rows scatter
-            # back to slot order on device so the shared slot-shaped
-            # _pick program serves packed launches of any size
-            temps = np.array(
-                [self._active[s].temperature if self._active[s] else 0.0
-                 for s in range(self.slots)], np.float32)
-            self._rng, sub = jax.random.split(self._rng)
-            idx = jnp.asarray(np.array(slots_of, np.int32))  # fflint: host-ok (per-tick batch transfer)
-            root = jnp.zeros((self.slots, probs.shape[-1]), probs.dtype)  # fflint: host-ok (per-tick scratch alloc)
-            root = root.at[idx].set(probs[:, 0, :])  # fflint: cow-ok (fresh logits scatter buffer, never a pool page)
-            preds = np.asarray(jnp.argmax(probs, axis=-1))  # (items, T)  # fflint: host-ok (on-device reduction, one sync per tick)
-            temps_d = jnp.asarray(temps)  # fflint: host-ok (per-tick batch transfer)
-            sampled = np.asarray(self._pick(root, temps_d, sub))  # fflint: host-ok (per-tick batch transfer)
-            sp.__exit__(None, None, None)  # verify: closes at host sync
-            item_of = {s: i for i, s in enumerate(slots_of)}
-            plans = {}
-            for s in live:
-                req = self._active[s]
-                if req.temperature > 0.0:
-                    plans[s] = ([0], [], int(sampled[s]))
-                else:
-                    path, emitted = accept_greedy(trees[s],
-                                                  preds[item_of[s]])
-                    plans[s] = (path, emitted[:-1], emitted[-1])
-            self._steps += 1
-            self.spec_steps += 1
+        T = self.spec.max_nodes
+        C = self.spec.depth + 1  # max rows committed per tick (path+bonus)
+        # draft: one tree WORK ITEM per live greedy slot.
+        # temperature>0 slots skip the drafter entirely — their
+        # accept path is the root's sample only, so they pack as
+        # single-row decode items instead of max_nodes-wide trees
+        # (drafts would be paid for and thrown away, and would
+        # dilute the acceptance metrics). Idle and mid-prefill slots
+        # pack NOTHING under ragged_pack (the pre-ragged layout
+        # carried a full tree of null-page scratch for every slot;
+        # ragged_pack=False keeps that for the bench baseline, as
+        # q_len-0 items).
+        t0 = time.monotonic()
+        tick_drafted = 0
+        sp = obs.span("draft").__enter__()
+        order = live if self.ragged_pack else list(range(self.slots))
+        slots_of = []   # item index -> slot
+        trees = {}
+        tree_rows = []  # item indexes carrying a real tree
+        parents = []
+        for s in order:
+            req = self._active[s]
+            if req is None:
+                slots_of.append(s)      # legacy filler: q_len 0
+                continue
+            if s not in live or req.temperature > 0.0:
+                slots_of.append(s)      # 1-row (or filler) item
+                continue
+            chains = self.drafter.draft(req.seq_tokens(),
+                                        self.spec.width,
+                                        self.spec.depth)
+            tree = build_tree(req.tokens[-1], chains, T,
+                              max_depth=self.spec.depth)
+            trees[s] = tree
+            tree_rows.append(len(slots_of))
+            parents.append(tree.parents)
+            slots_of.append(s)
+            drafted = tree.n_nodes - 1
+            self.spec_drafted += drafted
+            req.spec_drafted += drafted
+            tick_drafted += drafted
+        if sp:
+            sp.set(live=len(live), width=T, drafted=tick_drafted)
+        sp.__exit__(None, None, None)
+        anc = (ancestor_masks(np.stack(parents)) if parents
+               else np.zeros((0, T, T), bool))
+        pos = np.array([self._active[s].pos if self._active[s] else 0
+                        for s in range(self.slots)], np.int32)
 
-            # commit: accepted path rows -> contiguous committed rows
-            # (unused entries self-copy; built before tables mutate)
-            sp = obs.span("commit").__enter__()
-            a0, e0 = self.spec_accepted, self.spec_emitted
-            src = np.repeat(pos[:, None], C, axis=1)
-            dst = src.copy()
-            for s in live:
-                req = self._active[s]
-                path, verified, bonus = plans[s]
-                emitted = verified + [int(bonus)]
-                emitted = emitted[:req.max_new - len(req.tokens)]
-                if self.eos_id is not None and self.eos_id in emitted:
-                    emitted = emitted[:emitted.index(self.eos_id) + 1]
-                L = len(emitted)
-                # accepted = verified draft tokens actually EMITTED (the
-                # max_new/EOS cut above must not inflate acceptance)
-                accepted = min(len(verified), L)
-                self.spec_accepted += accepted
-                req.spec_accepted += accepted
-                src[s, :L] = req.pos + np.asarray(path[:L], np.int32)
-                dst[s, :L] = req.pos + np.arange(L, dtype=np.int32)
-                req.pos += L
-                req.tokens.extend(int(t) for t in emitted)
-                self._tokens[s] = emitted[-1]
-                req.spec_steps += 1
-                req.spec_emitted += L
-                self.spec_emitted += L
-            self._caches = self._commit(self._caches,
-                                        self._tables_device(),
-                                        jnp.asarray(src),  # fflint: host-ok (per-tick batch transfer)
-                                        jnp.asarray(dst))  # fflint: host-ok (per-tick batch transfer)
-            if self._caches_ref is not None:
-                # quant-debug shadow (scheduler._launch) must see the
-                # same accepted-row commit; the fp pool takes the plain
-                # copy path inside the same jitted program
-                self._caches_ref = self._commit(
-                    self._caches_ref, self._tables_device(),
-                    jnp.asarray(src), jnp.asarray(dst))  # fflint: host-ok (per-tick batch transfer)
-            for s in live:
-                # publish AFTER the commit: only rows below the advanced
-                # write head are committed K/V — tree scratch rows past
-                # it must never reach the prefix cache (the tree-slack
-                # pages stay private until pos actually crosses them)
-                self._publish_prefix(self._active[s], self._active[s].pos)
-                self._finish_if_done(s)
-            emitted = self.spec_emitted - e0
-            if sp:
-                sp.set(emitted=emitted,
-                       accepted=self.spec_accepted - a0)
-            sp.__exit__(None, None, None)
-            dt = time.monotonic() - t0
-            self._h_tick.observe(dt)
-            self._h_tokens.observe(emitted)
-            if tick_drafted:
-                self._h_accept.observe((self.spec_accepted - a0)
-                                       / tick_drafted)
-            led = obs.ledger()
-            if led is not None:
-                led.record("verify", dt, batch=len(live), width=T)
+        # items: a tree (q_len = its real node count — padding nodes
+        # are skipped work whose writes land in the null page), one
+        # committed-token row for a sampled slot, or a q_len-0
+        # filler. Mid-prefill slots pack no item, so their partially
+        # filled pages are never a write target — the table-nulling
+        # trick is gone
+        items = []
+        ti = iter(range(len(tree_rows)))
+        for i, s in enumerate(slots_of):
+            req = self._active[s]
+            if s in trees:
+                k = next(ti)
+                tree = trees[s]
+                items.append((s, req.pos,
+                              tree.tokens[:tree.n_nodes],
+                              tree.depths, anc[k]))
+            elif req is not None and s in live:
+                items.append((s, req.pos, [req.tokens[-1]],
+                              None, None))
+            else:
+                items.append((s, 0, [], None, None))
+        sp = obs.span("verify").__enter__()
+        if sp:
+            sp.set(live=len(live), width=T,
+                   pages_in_use=self.pool.pages_in_use)
+        probs, padded, total = self._launch(items, T, tr, ntr)
+        self._g_waste.set(padded / total if total else 0.0)
+        if sp:
+            sp.set(padded_rows=padded, total_rows=total)
+        for s in self._admit_order:
+            if self._mid_prefill(s):
+                self._active[s].decode_overlap_ticks += 1
+
+        # accept: greedy argmax walk. Both reductions run ON DEVICE —
+        # per-node argmaxes for the walk and the root rows' _pick for
+        # temperature>0 slots (one rng split per tick, same
+        # discipline as the non-speculative servers) — so only
+        # (items, max_nodes) + (slots,) ints cross to the host, never
+        # the (items, max_nodes, vocab) probs. The root rows scatter
+        # back to slot order on device so the shared slot-shaped
+        # _pick program serves packed launches of any size
+        temps = np.array(
+            [self._active[s].temperature if self._active[s] else 0.0
+             for s in range(self.slots)], np.float32)
+        self._rng, sub = jax.random.split(self._rng)
+        idx = jnp.asarray(np.array(slots_of, np.int32))
+        root = jnp.zeros((self.slots, probs.shape[-1]), probs.dtype)
+        root = root.at[idx].set(probs[:, 0, :])  # fflint: cow-ok (fresh logits scatter buffer, never a pool page)
+        preds = np.asarray(jnp.argmax(probs, axis=-1))  # (items, T)
+        temps_d = jnp.asarray(temps)
+        sampled = np.asarray(self._pick(root, temps_d, sub))
+        sp.__exit__(None, None, None)  # verify: closes at host sync
+        item_of = {s: i for i, s in enumerate(slots_of)}
+        plans = {}
+        for s in live:
+            req = self._active[s]
+            if req.temperature > 0.0:
+                plans[s] = ([0], [], int(sampled[s]))
+            else:
+                path, emitted = accept_greedy(trees[s],
+                                              preds[item_of[s]])
+                plans[s] = (path, emitted[:-1], emitted[-1])
+        self._steps += 1
+        self.spec_steps += 1
+
+        # commit: accepted path rows -> contiguous committed rows
+        # (unused entries self-copy; built before tables mutate)
+        sp = obs.span("commit").__enter__()
+        a0, e0 = self.spec_accepted, self.spec_emitted
+        src = np.repeat(pos[:, None], C, axis=1)
+        dst = src.copy()
+        for s in live:
+            req = self._active[s]
+            path, verified, bonus = plans[s]
+            emitted = verified + [int(bonus)]
+            emitted = emitted[:req.max_new - len(req.tokens)]
+            if self.eos_id is not None and self.eos_id in emitted:
+                emitted = emitted[:emitted.index(self.eos_id) + 1]
+            L = len(emitted)
+            # accepted = verified draft tokens actually EMITTED (the
+            # max_new/EOS cut above must not inflate acceptance)
+            accepted = min(len(verified), L)
+            self.spec_accepted += accepted
+            req.spec_accepted += accepted
+            src[s, :L] = req.pos + np.asarray(path[:L], np.int32)
+            dst[s, :L] = req.pos + np.arange(L, dtype=np.int32)
+            req.pos += L
+            req.tokens.extend(int(t) for t in emitted)
+            self._tokens[s] = emitted[-1]
+            req.spec_steps += 1
+            req.spec_emitted += L
+            self.spec_emitted += L
+        self._caches = self._commit(self._caches,
+                                    self._tables_device(),
+                                    jnp.asarray(src),
+                                    jnp.asarray(dst))
+        if self._caches_ref is not None:
+            # quant-debug shadow (scheduler._launch) must see the
+            # same accepted-row commit; the fp pool takes the plain
+            # copy path inside the same jitted program
+            self._caches_ref = self._commit(
+                self._caches_ref, self._tables_device(),
+                jnp.asarray(src), jnp.asarray(dst))
+        for s in live:
+            # publish AFTER the commit: only rows below the advanced
+            # write head are committed K/V — tree scratch rows past
+            # it must never reach the prefix cache (the tree-slack
+            # pages stay private until pos actually crosses them)
+            self._publish_prefix(self._active[s], self._active[s].pos)
+            self._finish_if_done(s)
+        emitted = self.spec_emitted - e0
+        if sp:
+            sp.set(emitted=emitted,
+                   accepted=self.spec_accepted - a0)
+        sp.__exit__(None, None, None)
+        dt = time.monotonic() - t0
+        self._h_tick.observe(dt)
+        self._h_tokens.observe(emitted)
+        if tick_drafted:
+            self._h_accept.observe((self.spec_accepted - a0)
+                                   / tick_drafted)
+        led = obs.ledger()
+        if led is not None:
+            led.record("verify", dt, batch=len(live), width=T)

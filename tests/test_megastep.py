@@ -318,3 +318,209 @@ def test_megastep_with_chunked_prefill_mixed_batch(lm):
         server.stop()
     for w, g in zip(want, got):
         np.testing.assert_array_equal(w, g)
+
+
+# ---------------------------------------------------------------------------
+# universal (mixed) megasteps: chunked prefill + spec verify fused into
+# the device loop (megastep_mixed=True), host work overlapped with the
+# in-flight dispatch (overlap_dispatch=True)
+
+
+@pytest.mark.parametrize("n_ticks", [1, 4, 8])
+def test_mixed_megastep_greedy_identity_vs_dense(lm, n_ticks):
+    """Universal megastep: prefill chunks ride the SAME fused dispatch
+    as decode rows, the device loop breaking back only when a chunk
+    completes (`chunk` break) — a mixed batch of short and chunk-
+    spanning prompts stays dense-identical at every fusion width."""
+    ff, lcfg = lm
+    rs = np.random.RandomState(21)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 9, 5, 14)]
+    want = [ff.generate(p[None, :], max_new_tokens=12)[0] for p in prompts]
+    server = ff.serve_generation(slots=4, max_len=64, paged=True,
+                                 page_size=4, prefill_chunk=4,
+                                 megastep_ticks=n_ticks,
+                                 megastep_mixed=True)
+    try:
+        futs = [server.submit(p, max_new_tokens=12) for p in prompts]
+        got = [f.result(timeout=600) for f in futs]
+        m = server.metrics()
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    ms = m["megastep"]
+    assert ms["mixed"] is True
+    # multi-chunk prompts (9 and 14 tokens at chunk=4) complete their
+    # chunks inside fused dispatches and hand control back each time
+    assert ms["breaks"]["chunk"] > 0
+    assert ms["decode_tokens"] > 0
+    if n_ticks > 1:
+        assert ms["host_roundtrips"] < (
+            ms["decode_tokens"] + sum(len(p) for p in prompts))
+
+
+def test_mixed_megastep_sampled_identity_vs_one_tick(lm):
+    """Fixed-seed sampling through the universal megastep is fusion-
+    width invariant even with prefill chunks interleaved: completing
+    prefills sample their first token ON DEVICE, so the host rng split
+    chain is untouched by where chunk completions land."""
+    ff, lcfg = lm
+    rs = np.random.RandomState(22)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 9, 5, 14)]
+    temps = (0.8, 0.0, 0.7, 0.9)
+    outs = {}
+    for n in (1, 4):
+        server = ff.serve_generation(slots=4, max_len=64, paged=True,
+                                     page_size=4, prefill_chunk=4,
+                                     seed=3, megastep_ticks=n,
+                                     megastep_mixed=True)
+        try:
+            futs = [server.submit(p, max_new_tokens=12, temperature=t)
+                    for p, t in zip(prompts, temps)]
+            outs[n] = [f.result(timeout=600) for f in futs]
+        finally:
+            server.stop()
+    for a, b in zip(outs[1], outs[4]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_mixed_megastep_spec_greedy_identity_vs_dense(lm):
+    """Speculative verify fuses too: greedy slots draft the n-gram
+    chain ON DEVICE inside the megastep (spec_mask), so a speculative
+    server's mixed batch — chunked prefill + greedy spec decode +
+    sampled decode in one dispatch — stays dense-identical and fills
+    the speculative counters."""
+    from flexflow_tpu.spec import SpecConfig
+
+    ff, lcfg = lm
+    rs = np.random.RandomState(23)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 9, 5, 14)]
+    temps = (0.0, 0.6, 0.0, 0.0)
+    want = [ff.generate(p[None, :], max_new_tokens=12)[0]
+            for p, t in zip(prompts, temps) if t == 0.0]
+    server = ff.serve_generation(slots=4, max_len=64, paged=True,
+                                 page_size=4, prefill_chunk=4, seed=5,
+                                 megastep_ticks=4, megastep_mixed=True,
+                                 speculate=SpecConfig(width=2, depth=3))
+    try:
+        futs = [server.submit(p, max_new_tokens=12, temperature=t)
+                for p, t in zip(prompts, temps)]
+        got = [f.result(timeout=600) for f in futs]
+        m = server.metrics()
+    finally:
+        server.stop()
+    greedy = [g for g, t in zip(got, temps) if t == 0.0]
+    for w, g in zip(want, greedy):
+        np.testing.assert_array_equal(w, g)
+    spec = m["speculative"]
+    assert spec["steps"] > 0
+    assert spec["draft_tokens"] >= spec["steps"]
+
+
+def test_mixed_megastep_overlap_identity_and_observability(lm):
+    """overlap_dispatch=True: the host runs next-tick admission while
+    the device computes, then fences on one device_get. Output identity
+    is untouched, the host_overlap_ratio gauge lands in [0, 1], and the
+    megastep spans carry fused_rows."""
+    from flexflow_tpu import obs
+
+    ff, lcfg = lm
+    rs = np.random.RandomState(24)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 9, 5, 14)]
+    want = [ff.generate(p[None, :], max_new_tokens=12)[0] for p in prompts]
+    rec = obs.enable()
+    try:
+        server = ff.serve_generation(slots=4, max_len=64, paged=True,
+                                     page_size=4, prefill_chunk=4,
+                                     megastep_ticks=4,
+                                     megastep_mixed=True,
+                                     overlap_dispatch=True)
+        try:
+            futs = [server.submit(p, max_new_tokens=12) for p in prompts]
+            got = [f.result(timeout=600) for f in futs]
+            m = server.metrics()
+        finally:
+            server.stop()
+    finally:
+        obs.disable()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    ms = m["megastep"]
+    assert ms["overlap_dispatch"] is True
+    assert 0.0 <= ms["host_overlap_ratio"] <= 1.0
+    attrs = [e[4] for e in rec.events if e[0] == "megastep"]
+    assert attrs and all("fused_rows" in a for a in attrs)
+    assert any(a["fused_rows"] > 0 for a in attrs)
+    # the overlapped admission window is its own span
+    assert any(e[0] == "overlap_admit" for e in rec.events)
+
+
+def test_mixed_megastep_pool_invariants_at_every_resume(lm):
+    """The universal megastep coarsens host bookkeeping further (chunk
+    state lives in the device carry between resumes) — the poolcheck
+    invariant catalog must still hold at every host-resume point, under
+    page pressure forcing growth between dispatches."""
+    from flexflow_tpu.paged.scheduler import PagedGenerationServer
+
+    resumes = []
+
+    class CheckedServer(PagedGenerationServer):
+        def _on_megastep_resume(self):
+            owners = {}
+            for s in self._admit_order:
+                req = self._active[s]
+                if req is not None and req.pages:
+                    owners[s] = list(req.pages)
+            self.pool.check_invariants(owners=owners)
+            resumes.append(len(owners))
+
+    ff, lcfg = lm
+    rs = np.random.RandomState(25)
+    prompts = [rs.randint(0, lcfg.vocab_size, (n,)).astype(np.int32)
+               for n in (3, 9, 5, 14)]
+    want = [ff.generate(p[None, :], max_new_tokens=10)[0] for p in prompts]
+    server = CheckedServer(ff, slots=3, max_len=64, page_size=4,
+                           num_pages=24, prefill_chunk=4,
+                           megastep_ticks=8, megastep_mixed=True)
+    try:
+        futs = [server.submit(p, max_new_tokens=10) for p in prompts]
+        got = [f.result(timeout=600) for f in futs]
+    finally:
+        server.stop()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert len(resumes) > 0
+
+
+def test_megastep_canary_stand_down_dynamic(lm):
+    """kv_quant_canary windows open on ANY admission mid-serve — both
+    megastep flavors must stand down dynamically (not just when
+    configured off at construction) so the fp32 shadow observes every
+    launch. With canary=1 the window is open for the whole request:
+    every dispatch takes the one-tick path, no fused break is ever
+    recorded, and output stays dense-identical."""
+    ff, lcfg = lm
+    rs = np.random.RandomState(26)
+    p = rs.randint(0, lcfg.vocab_size, (5,)).astype(np.int32)
+    want = ff.generate(p[None, :], max_new_tokens=10)[0]
+    for kwargs in (dict(megastep_ticks=8),
+                   dict(megastep_ticks=8, megastep_mixed=True)):
+        server = ff.serve_generation(slots=2, max_len=64, paged=True,
+                                     page_size=16, prefill_chunk=8,
+                                     kv_quant_canary=1, **kwargs)
+        try:
+            got = server.generate(p, max_new_tokens=10)
+            m = server.metrics()
+        finally:
+            server.stop()
+        np.testing.assert_array_equal(want, got)
+        assert m["kv_quant_canary"]["windows"] == 1, kwargs
+        ms = m["megastep"]
+        # stood down for the window's whole lifetime: one-tick loop,
+        # no megastep dispatch ever broke back
+        assert sum(ms["breaks"].values()) == 0, (kwargs, ms)
+        assert ms["decode_tokens"] > 0

@@ -1,11 +1,12 @@
 """racecheck's dynamic arm (ISSUE 18): the bounded interleaving model
-checker over the three threaded serving protocols, plus the live-code
+checker over the threaded serving protocols, plus the live-code
 stress companion that ties the abstract models back to the real
 DisaggPair and ServingAutopilot.
 
 Contracts under test: every protocol model — prefill->decode handoff,
-concurrent spill/fetch/admission against the bounded host tier, and
-drain-and-swap under live submits — is FULLY explored violation-free at
+concurrent spill/fetch/admission against the bounded host tier,
+drain-and-swap under live submits, and the overlapped megastep
+dispatch fence (ISSUE 20) — is FULLY explored violation-free at
 the default context-switch bound (the explored/distinct state counts
 are pinned: a model edit that shrinks the space is as suspicious as one
 that breaks an invariant); sleep-set pruning is sound (the pruned and
@@ -41,6 +42,7 @@ _CLEAN_SPACE = {
     "handoff": (53, 48),
     "swap": (149, 117),
     "tierpool": (16, 15),
+    "dispatch": (58, 40),
 }
 
 
@@ -73,6 +75,8 @@ def test_sleep_set_pruning_is_sound():
     ("tierpool", "fetch_no_remove", "tier-partition"),
     ("swap", "unlocked_submit", "future-dropped"),
     ("swap", "no_safepoint_join", "swap-during-handoff"),
+    ("dispatch", "read_before_fence", "dispatch-buffer-owner"),
+    ("dispatch", "admit_steals_live_page", "stale-page-table"),
 ])
 def test_seeded_mutation_produces_named_minimal_counterexample(
         model, mutation, invariant):

@@ -632,3 +632,60 @@ def test_serve_strategy_rejects_explicit_speculate():
         ff.serve_generation(slots=2, max_len=32,
                             serve_strategy=ServeStrategy(page_size=8),
                             speculate=SpecConfig(width=2, depth=2))
+
+
+def test_mixed_megastep_pricing_and_search_chooses_fuse(graph):
+    """ISSUE-20 acceptance (search arm): on the mixed-length profile
+    the universal megastep prices strictly better step by step —
+    legacy < mixed < mixed+overlap on throughput, never worse on TTFT
+    (TickPricer.mixed_dispatch amortizes the host once per fused RUN
+    and discounts the overlapped dispatch by OVERLAP_RESIDUAL) — and
+    the search's joint `fuse` knob actually lands there."""
+    import dataclasses
+
+    lay = PricedLayout(axis_sizes={}, strategy={}, step_s=1e-3,
+                       base_tokens=256, mem_bytes=1e6, kv_token_bytes=512,
+                       mode="test", kv_token_elems=128, kv_scale_elems=16)
+    stats = traffic_mod.get_profile("mixed-length").prompt_stats()
+    pr = ServePricer([lay], stats, slots=4, max_len=128)
+    base = ServeStrategy(page_size=32, prefill_chunk=64, megastep_ticks=8)
+    legacy, mixed, overlap = (
+        pr.metrics(base),
+        pr.metrics(dataclasses.replace(base, megastep_mixed=True)),
+        pr.metrics(dataclasses.replace(base, megastep_mixed=True,
+                                       overlap_dispatch=True)))
+    assert legacy["tokens_per_s"] < mixed["tokens_per_s"] \
+        < overlap["tokens_per_s"]
+    assert mixed["ttft_p95_s"] <= legacy["ttft_p95_s"]
+    assert overlap["ttft_p95_s"] <= mixed["ttft_p95_s"]
+
+    res = search_serve_strategy(graph=graph, cost=_cost(),
+                                traffic="mixed-length", budget=160,
+                                seed=0, slots=4, max_len=128)
+    assert res.best.megastep_mixed is True
+    assert res.best.overlap_dispatch is True
+    assert res.improvement > 0.0
+    res.best.validate(max_len=128)
+
+
+def test_strategy_fuse_knob_validation_and_roundtrip():
+    """overlap_dispatch without megastep_mixed is rejected; spec plus
+    megastep_ticks>1 is only legal under the mixed megastep (the fused
+    loop drafts on device); both knobs survive the JSON round trip and
+    show in describe()."""
+    with pytest.raises(ValueError, match="overlap_dispatch"):
+        ServeStrategy(overlap_dispatch=True).validate(max_len=128)
+    ServeStrategy(megastep_mixed=True, megastep_ticks=8, spec_width=2,
+                  spec_depth=4).validate(max_len=128)
+    with pytest.raises(ValueError, match="megastep"):
+        ServeStrategy(megastep_ticks=8, spec_width=2,
+                      spec_depth=4).validate(max_len=128)
+    s = ServeStrategy(megastep_mixed=True, overlap_dispatch=True,
+                      megastep_ticks=4)
+    back = ServeStrategy.from_json(json.loads(json.dumps(s.to_json())))
+    assert back == s
+    assert "mixed" in s.describe() and "overlap" in s.describe()
+    kw = s.to_server_kwargs(slots=4, max_len=128)
+    assert kw["megastep_mixed"] is True
+    assert kw["overlap_dispatch"] is True
+    assert "fuse" in default_space(max_len=128)

@@ -60,6 +60,13 @@ def test_warmed_serving_observes_only_catalog_shapes_and_never_recompiles(
     flavors = (
         dict(megastep_ticks=4),
         dict(speculate=SpecConfig(width=2, depth=2)),
+        # the universal (mixed) megastep family: chunk rows and drafted
+        # chains fuse into one dispatch — its (slots, ticks, window)
+        # launch shape must be enumerated and warmed like the rest
+        dict(megastep_ticks=4, megastep_mixed=True),
+        dict(megastep_ticks=4, megastep_mixed=True,
+             overlap_dispatch=True,
+             speculate=SpecConfig(width=2, depth=2)),
     )
     for kwargs in flavors:
         server = ff.serve_generation(slots=2, max_len=32, paged=True,
